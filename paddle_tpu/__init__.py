@@ -1,0 +1,81 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A from-scratch rebuild of the capabilities of PaddlePaddle (reference:
+/root/reference, circa v0.10/v0.11) designed TPU-first on JAX/XLA:
+
+* The *program-as-data* spine of the reference's Fluid generation
+  (reference: paddle/framework/program_desc.h:28, executor.cc:73) is kept as
+  the user-facing IR — a ``Program`` of ``Block``s of ``Op``s — but instead of
+  a serial per-op C++ interpreter, the whole program is traced into a single
+  XLA computation with ``jax.jit`` and compiled once per (program, feed-shape)
+  signature.  The MXU sees one fused graph, not 170 kernel launches.
+* Autograd does not reimplement per-op grad makers (reference:
+  framework/backward.cc:353) — ``append_backward`` marks gradient variables
+  and the executor derives them with ``jax.value_and_grad`` over the traced
+  forward section.  Every op in the library is therefore differentiable for
+  free.
+* Distribution replaces the reference's four communication backends (v1
+  pserver sockets, Go pserver/master, fluid gRPC send/recv, NCCL — SURVEY.md
+  §2.6) with XLA collectives over a ``jax.sharding.Mesh`` (``paddle_tpu.parallel``).
+* Variable-length sequences (the reference's LoD, lod_tensor.h:34-83) become
+  padded-plus-length tensors with masked sequence ops — static shapes that XLA
+  can tile onto the MXU.
+
+Public API intentionally mirrors the reference's fluid Python surface
+(python/paddle/v2/fluid/__init__.py): ``layers``, ``optimizer``, ``Executor``,
+``Program``, ``default_main_program`` ...
+"""
+
+from . import core
+from .core import (
+    Program,
+    Block,
+    Operator,
+    Variable,
+    Parameter,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    unique_name,
+    Executor,
+    Scope,
+    global_scope,
+    scope_guard,
+    CPUPlace,
+    TPUPlace,
+)
+from . import ops  # noqa: F401  (registers every op implementation)
+from . import layers
+from . import nets
+from . import initializer
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import backward
+from .backward import append_backward
+from . import evaluator
+from . import metrics
+from . import io
+from .io import save_params, load_params, save_persistables, load_persistables, \
+    save_inference_model, load_inference_model
+from .data_feeder import DataFeeder
+from .param_attr import ParamAttr
+from . import profiler
+from . import parallel
+from . import distributed
+from . import reader
+from . import framework  # compat alias namespace
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Program", "Block", "Operator", "Variable", "Parameter",
+    "default_main_program", "default_startup_program", "program_guard",
+    "unique_name", "Executor", "Scope", "global_scope", "scope_guard",
+    "CPUPlace", "TPUPlace", "layers", "nets", "initializer", "optimizer",
+    "regularizer", "clip", "backward", "append_backward", "evaluator",
+    "metrics", "io", "save_params", "load_params", "save_persistables",
+    "load_persistables", "save_inference_model", "load_inference_model",
+    "DataFeeder", "ParamAttr", "profiler", "parallel", "distributed",
+    "reader",
+]

@@ -1,0 +1,66 @@
+"""append_backward: declare gradients for a loss.
+
+Reference: fluid/backward.py:257 drives C++ per-op GradOpDescMakers
+(framework/backward.cc:353-415) to emit an explicit grad-op section, handling
+sub-blocks, var renaming and sum-insertion for multi-consumer grads.
+
+TPU-native redesign: one ``backward`` pseudo-op is appended; at lowering time
+the Executor wraps the entire forward slice in ``jax.value_and_grad``
+(core/executor.py:_run_backward).  XLA's reverse-mode pass handles fan-out
+summation, sub-block (scan/while) differentiation, and recomputation
+scheduling — the whole per-op grad-maker machinery is unnecessary.  Gradient
+variables are declared here so they can be fetched and consumed by optimizer
+ops under their reference names (``<param>@GRAD``).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .core.program import Parameter, Program, Variable, grad_var_name
+from .core.program import default_main_program
+
+
+def append_backward(loss: Variable,
+                    parameter_list: Optional[Sequence] = None,
+                    no_grad_set: Optional[set] = None,
+                    callbacks=None) -> List[Tuple[Variable, Variable]]:
+    """Returns [(parameter, gradient_var)] like fluid backward.py:257."""
+    program: Program = loss.block.program
+    block = program.global_block()
+    no_grad = {n if isinstance(n, str) else n.name for n in (no_grad_set or ())}
+
+    if parameter_list is not None:
+        params = []
+        for p in parameter_list:
+            name = p if isinstance(p, str) else p.name
+            params.append(block.var(name))
+    else:
+        params = [p for p in program.all_parameters()
+                  if getattr(p, "trainable", True)]
+    params = [p for p in params if p.name not in no_grad]
+    if not params:
+        raise ValueError("append_backward: no trainable parameters found")
+
+    grad_vars = []
+    for p in params:
+        g = block.create_var(
+            name=grad_var_name(p.name), shape=p.shape, dtype=p.dtype,
+            persistable=False, stop_gradient=True)
+        grad_vars.append(g)
+
+    block.append_op(
+        type="backward",
+        inputs={"Loss": [loss]},
+        outputs={"Grads": [g.name for g in grad_vars]},
+        attrs={"loss": loss.name, "params": [p.name for p in params]},
+    )
+    return list(zip(params, grad_vars))
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """fluid.gradients analog for a single scalar target."""
+    t = targets[0] if isinstance(targets, (list, tuple)) else targets
+    pairs = append_backward(t, parameter_list=[
+        i.name if isinstance(i, Variable) else i
+        for i in (inputs if isinstance(inputs, (list, tuple)) else [inputs])])
+    return [g for _, g in pairs]

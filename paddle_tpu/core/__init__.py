@@ -1,0 +1,26 @@
+"""Core IR + runtime: Program/Block/Op/Var, registry, Executor, Scope."""
+
+from . import unique_name
+from .types import VarType, convert_dtype, is_floating, is_integral
+from .program import (
+    Program, Block, Operator, Variable, Parameter,
+    default_main_program, default_startup_program, program_guard,
+    reset_default_programs, grad_var_name, GRAD_SUFFIX, LEN_SUFFIX,
+)
+from .registry import register_op, get_op_impl, has_op, registered_ops
+from .scope import Scope, global_scope, scope_guard, reset_global_scope
+from .executor import (
+    Executor, Place, CPUPlace, TPUPlace, CUDAPlace,
+    Env, LoweringContext, interpret_ops, run_op,
+)
+
+__all__ = [
+    "unique_name", "VarType", "convert_dtype", "is_floating", "is_integral",
+    "Program", "Block", "Operator", "Variable", "Parameter",
+    "default_main_program", "default_startup_program", "program_guard",
+    "reset_default_programs", "grad_var_name", "GRAD_SUFFIX", "LEN_SUFFIX",
+    "register_op", "get_op_impl", "has_op", "registered_ops",
+    "Scope", "global_scope", "scope_guard", "reset_global_scope",
+    "Executor", "Place", "CPUPlace", "TPUPlace", "CUDAPlace",
+    "Env", "LoweringContext", "interpret_ops", "run_op",
+]

@@ -1,0 +1,396 @@
+"""Executor: lowers a Program into one jitted XLA computation and runs it.
+
+The reference Executor walks OpDescs one C++ kernel at a time
+(paddle/framework/executor.cc:73-129, operator.cc:405-475).  The TPU-native
+redesign instead *traces* the whole block through the registered JAX lowerings
+into a single ``jax.jit`` function per (program-version, feed-signature):
+
+    run(program, feed, fetch_list)
+        └── compiled fn: (feeds, persistable-state, step) -> (fetches, state')
+
+* Persistable vars (parameters, optimizer moments, evaluator states) live in a
+  ``Scope`` between steps and are threaded functionally with buffer donation —
+  the analog of the reference Scope (scope.h:38) without mutation-under-jit.
+* A program containing a ``backward`` op (inserted by ``append_backward``) is
+  split at that op: the forward slice is interpreted inside
+  ``jax.value_and_grad`` so each forward op runs exactly once and every
+  gradient ``X@GRAD`` var is produced by XLA's reverse-mode pass — replacing
+  the reference's per-op GradOpDescMakers (framework/backward.cc:353-415).
+* Random ops derive keys from (program seed, op position, step counter) so
+  dropout masks differ per step but runs are reproducible — the analog of the
+  reference's per-op seed attrs.
+* ``check_nan_inf`` mirrors FLAGS_check_nan_inf (executor.cc:25-27,116-124)
+  using post-run host checks on fetches/state (debug aid; off by default).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .program import Block, Operator, Program, Variable, grad_var_name
+from .registry import get_op_impl
+from .scope import Scope, global_scope
+
+logger = logging.getLogger("paddle_tpu")
+
+
+# ---------------------------------------------------------------------------
+# Places — the analog of platform::Place (place.h:25-63).  On JAX, placement
+# is owned by XLA/shardings; Place is kept for API parity and to select the
+# default device.
+# ---------------------------------------------------------------------------
+class Place:
+    platform: Optional[str] = None
+
+    def device(self):
+        if self.platform is None:
+            return jax.devices()[0]
+        try:
+            return jax.devices(self.platform)[0]
+        except RuntimeError:
+            return jax.devices()[0]
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class CPUPlace(Place):
+    platform = "cpu"
+
+
+class TPUPlace(Place):
+    """The seam the reference left for new backends (SURVEY §2.5 platform)."""
+    platform = None  # default backend (TPU when present)
+
+
+# CUDAPlace alias for scripts written against the reference API surface.
+CUDAPlace = TPUPlace
+
+
+# ---------------------------------------------------------------------------
+# Environment: per-block name -> traced value, with parent lookup
+# (the trace-time analog of Scope::FindVar's parent chain, scope.h:58).
+# ---------------------------------------------------------------------------
+class Env:
+    def __init__(self, block: Block, parent: Optional["Env"] = None):
+        self.block = block
+        self.parent = parent
+        self.local: Dict[str, object] = {}
+
+    def get(self, name: str):
+        e: Optional[Env] = self
+        while e is not None:
+            if name in e.local:
+                return e.local[name]
+            e = e.parent
+        raise KeyError(f"variable {name!r} has no value; is it fed, "
+                       f"initialized by the startup program, or produced by "
+                       f"an earlier op?")
+
+    def has(self, name: str) -> bool:
+        e: Optional[Env] = self
+        while e is not None:
+            if name in e.local:
+                return True
+            e = e.parent
+        return False
+
+    def set(self, name: str, value):
+        # Write to the nearest env level that either already BINDS the name
+        # (loop-carry bindings made by while/rnn lowerings must capture body
+        # writes locally, not leak into the parent trace) or DECLARES it
+        # (fluid write-through semantics for sub-blocks).
+        e: Optional[Env] = self
+        while e is not None:
+            if name in e.local or name in e.block.vars:
+                e.local[name] = value
+                return
+            e = e.parent
+        self.local[name] = value
+
+    def snapshot(self) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        e: Optional[Env] = self
+        chain = []
+        while e is not None:
+            chain.append(e)
+            e = e.parent
+        for e in reversed(chain):
+            out.update(e.local)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Lowering context passed to op implementations
+# ---------------------------------------------------------------------------
+class LoweringContext:
+    def __init__(self, program: Program, base_key, is_test: bool = False):
+        self.program = program
+        self.base_key = base_key      # traced PRNG key folding in the step
+        self.is_test = is_test
+        self.op: Optional[Operator] = None
+        self.env: Optional[Env] = None
+        self._op_uid = 0
+
+    def rng(self, offset: int = 0):
+        """Per-op-instance PRNG key: stable across steps in structure, varied
+        by the step counter folded into base_key by the executor."""
+        seed = int(self.op.attrs.get("seed", 0) or 0) if self.op else 0
+        k = jax.random.fold_in(self.base_key, self._op_uid)
+        if seed:
+            k = jax.random.fold_in(k, seed)
+        if offset:
+            k = jax.random.fold_in(k, offset)
+        return k
+
+    def block(self, idx: int) -> Block:
+        return self.program.blocks[idx]
+
+    def interpret_block(self, block_idx: int, env: Env):
+        interpret_ops(self.program.blocks[block_idx].ops, env, self)
+
+    def child_env(self, block_idx: int, parent_env: Env) -> Env:
+        return Env(self.program.blocks[block_idx], parent=parent_env)
+
+    def get_len(self, name: str):
+        """Sequence-length companion of a lod_level>0 var, or None."""
+        ln = name + "@LEN"
+        return self.env.get(ln) if self.env.has(ln) else None
+
+    def set_len(self, name: str, lens):
+        """Emit the sequence-length companion for an output var."""
+        self.env.local[name + "@LEN"] = lens
+
+
+# ---------------------------------------------------------------------------
+# Interpreter
+# ---------------------------------------------------------------------------
+def _normalize_outputs(op: Operator, result) -> Dict[str, List]:
+    if result is None:
+        return {}
+    if not isinstance(result, dict):
+        # single unnamed output: bind to the single output slot
+        slots = [s for s, ns in op.outputs.items() if ns]
+        if len(slots) != 1:
+            raise ValueError(f"op {op.type}: ambiguous single-value return")
+        result = {slots[0]: result}
+    norm: Dict[str, List] = {}
+    for slot, val in result.items():
+        norm[slot] = val if isinstance(val, list) else [val]
+    return norm
+
+
+def run_op(op: Operator, env: Env, ctx: LoweringContext):
+    impl = get_op_impl(op.type)
+    ins = {slot: [env.get(n) for n in names]
+           for slot, names in op.inputs.items() if names}
+    prev_op, prev_env = ctx.op, ctx.env
+    ctx.op, ctx.env = op, env
+    ctx._op_uid += 1
+    try:
+        result = impl(ctx, ins, op.attrs)
+    finally:
+        ctx.op, ctx.env = prev_op, prev_env
+    outs = _normalize_outputs(op, result)
+    for slot, names in op.outputs.items():
+        if not names:
+            continue
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        if len(vals) != len(names):
+            raise ValueError(
+                f"op {op.type} slot {slot}: produced {len(vals)} values for "
+                f"{len(names)} outputs {names}")
+        for n, v in zip(names, vals):
+            if v is not None:
+                env.set(n, v)
+
+
+def interpret_ops(ops: Sequence[Operator], env: Env, ctx: LoweringContext):
+    for op in ops:
+        run_op(op, env, ctx)
+
+
+def interpret_block_with_backward(block: Block, env: Env, ctx: LoweringContext):
+    """Interpret a block, splitting at a top-level ``backward`` op so the
+    forward slice runs exactly once inside jax.value_and_grad."""
+    bw_idx = next((i for i, op in enumerate(block.ops)
+                   if op.type == "backward"), None)
+    if bw_idx is None:
+        interpret_ops(block.ops, env, ctx)
+        return
+    pre, bw_op, post = block.ops[:bw_idx], block.ops[bw_idx], block.ops[bw_idx + 1:]
+    _run_backward(pre, bw_op, env, ctx)
+    interpret_ops(post, env, ctx)
+
+
+def _run_backward(forward_ops: Sequence[Operator], bw_op: Operator,
+                  env: Env, ctx: LoweringContext):
+    """Lower the ``backward`` pseudo-op inserted by append_backward.
+
+    attrs: loss (var name), params (list of var names to differentiate).
+    Produces ``<p>@GRAD`` for every p in params and materializes every forward
+    var in ``env`` from the primal pass (so later fetches/ops see them).
+    """
+    loss_name = bw_op.attrs["loss"]
+    wrt_names = list(bw_op.attrs["params"])
+    init = env.snapshot()
+    wrt_vals = {n: init[n] for n in wrt_names}
+    block = env.block
+
+    def f(wrt):
+        fenv = Env(block)
+        fenv.local.update(init)
+        fenv.local.update(wrt)
+        interpret_ops(forward_ops, fenv, ctx)
+        loss = fenv.get(loss_name)
+        if loss.ndim > 0:
+            if loss.size != 1:
+                raise ValueError(
+                    f"append_backward loss {loss_name!r} must be a scalar, "
+                    f"got shape {loss.shape}")
+            loss = loss.reshape(())
+        return loss, fenv.local
+
+    (loss_val, fwd_vals), grads = jax.value_and_grad(f, has_aux=True)(wrt_vals)
+    for name, val in fwd_vals.items():
+        env.set(name, val)
+    env.set(loss_name, loss_val)
+    for n in wrt_names:
+        g = grads[n]
+        env.set(grad_var_name(n), g)
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+class Executor:
+    """Compile-and-run a Program (reference: fluid/executor.py:56-119).
+
+    ``use_jit=False`` runs the interpreter eagerly op-by-op — the debugging
+    analog of the reference's serial executor (and of jax.disable_jit).
+    """
+
+    def __init__(self, place: Optional[Place] = None, use_jit: bool = True,
+                 check_nan_inf: bool = False):
+        self.place = place or TPUPlace()
+        self.use_jit = use_jit
+        self.check_nan_inf = check_nan_inf
+        self._cache: Dict = {}
+        self._step = 0
+
+    # -- public ------------------------------------------------------------
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, object]] = None,
+            fetch_list: Optional[Sequence] = None,
+            scope: Optional[Scope] = None,
+            return_numpy: bool = True,
+            is_test: bool = False):
+        from .program import default_main_program
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        scope = scope or global_scope()
+
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_list]
+
+        # normalize feeds to arrays with declared dtypes
+        gb = program.global_block()
+        feed_arrays: Dict[str, jnp.ndarray] = {}
+        for name, val in feed.items():
+            arr = np.asarray(val)
+            if gb.has_var(name):
+                want = gb.var(name).dtype
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            feed_arrays[name] = arr
+
+        state_keys = self._state_keys(program, scope)
+        state = {k: scope.get(k) for k in state_keys}
+
+        sig = (id(program), program.version,
+               tuple(sorted((n, a.shape, str(a.dtype))
+                            for n, a in feed_arrays.items())),
+               tuple(fetch_names), tuple(sorted(state_keys)), is_test)
+        fn = self._cache.get(sig)
+        if fn is None:
+            fn = self._build(program, sorted(feed_arrays), fetch_names,
+                             sorted(state_keys), is_test)
+            self._cache[sig] = fn
+
+        step = self._step
+        self._step += 1
+        fetches, new_state = fn(feed_arrays, state, step)
+
+        for k, v in new_state.items():
+            scope.set(k, v)
+
+        if self.check_nan_inf:
+            self._nan_check(fetch_names, fetches)
+
+        if return_numpy:
+            fetches = [np.asarray(f) if f is not None else None
+                       for f in fetches]
+        return fetches
+
+    # -- internals ---------------------------------------------------------
+    def _state_keys(self, program: Program, scope: Scope) -> List[str]:
+        """Persistable vars referenced by the program that exist in scope."""
+        referenced = set()
+        for b in program.blocks:
+            for op in b.ops:
+                referenced.update(op.input_names)
+                referenced.update(op.output_names)
+        keys = []
+        for name in referenced:
+            v = None
+            for b in program.blocks:
+                if name in b.vars:
+                    v = b.vars[name]
+                    break
+            if v is not None and v.persistable and scope.has(name):
+                keys.append(name)
+        return keys
+
+    def _build(self, program: Program, feed_names: List[str],
+               fetch_names: List[str], state_keys: List[str], is_test: bool):
+        persistable_names = sorted(
+            {v.name for b in program.blocks for v in b.vars.values()
+             if v.persistable} | set(state_keys))
+
+        def fn(feed_arrays, state, step):
+            base_key = jax.random.fold_in(
+                jax.random.PRNGKey(program.random_seed), step)
+            env = Env(program.global_block())
+            env.local.update(state)
+            env.local.update(feed_arrays)
+            ctx = LoweringContext(program, base_key, is_test=is_test)
+            interpret_block_with_backward(program.global_block(), env, ctx)
+            fetches = [env.get(n) if env.has(n) else None for n in fetch_names]
+            new_state = {k: env.get(k) for k in persistable_names
+                         if env.has(k)}
+            return fetches, new_state
+
+        if not self.use_jit:
+            return fn
+        jfn = jax.jit(fn, donate_argnums=(1,))
+        return jfn
+
+    def _nan_check(self, names, fetches):
+        for n, f in zip(names, fetches):
+            if f is None:
+                continue
+            a = np.asarray(f)
+            if np.issubdtype(a.dtype, np.floating) and not np.all(np.isfinite(a)):
+                raise FloatingPointError(
+                    f"NaN/Inf detected in fetched var {n!r} "
+                    f"(check_nan_inf, analog of FLAGS_check_nan_inf)")
+
+    def close(self):
+        self._cache.clear()

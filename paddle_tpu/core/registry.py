@@ -1,0 +1,60 @@
+"""Operator implementation registry.
+
+The analog of the reference's OpRegistry/OpInfoMap
+(paddle/framework/op_registry.h:148-290, op_info.h:68) — but an "op kernel"
+here is a *JAX lowering*: a Python function that maps traced ``jax.Array``
+inputs to outputs using jnp/lax (and Pallas for hand-tuned kernels).  There is
+exactly one kernel per op — XLA owns device placement, layout, and dtype
+specialization, so the reference's OpKernelType dispatch key
+(op_kernel_type.h:27-73) and DataTransform machinery (data_transform.h:37) are
+unnecessary.
+
+Because gradients are derived with ``jax.vjp`` over these lowerings, there are
+no separate grad-op registrations (contrast REGISTER_OP's auto grad-op maker,
+op_registry.h:148).
+
+Implementation signature::
+
+    @register_op("elementwise_add")
+    def _add(ctx, ins, attrs):
+        return {"Out": ins["X"][0] + ins["Y"][0]}
+
+* ``ins``  — dict slot -> list of input values (arrays / nested, per OpDesc).
+* return   — dict slot -> value or list of values; normalized by the executor.
+* ``ctx``  — LoweringContext: rng keys, sub-block interpretation, env access.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_OP_IMPLS: Dict[str, Callable] = {}
+
+
+def register_op(*names: str):
+    """Register a lowering for one or more op type names."""
+
+    def deco(fn):
+        for n in names:
+            if n in _OP_IMPLS:
+                raise ValueError(f"op {n!r} registered twice")
+            _OP_IMPLS[n] = fn
+        return fn
+
+    return deco
+
+
+def get_op_impl(name: str) -> Callable:
+    try:
+        return _OP_IMPLS[name]
+    except KeyError:
+        raise NotImplementedError(
+            f"No lowering registered for op type {name!r}. "
+            f"Registered: {sorted(_OP_IMPLS)[:20]}...") from None
+
+
+def has_op(name: str) -> bool:
+    return name in _OP_IMPLS
+
+
+def registered_ops():
+    return sorted(_OP_IMPLS)

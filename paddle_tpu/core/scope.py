@@ -1,0 +1,90 @@
+"""Scope: name -> device array storage for persistable variables.
+
+Analog of the reference's hierarchical Scope (paddle/framework/scope.h:38-88),
+holding parameters, optimizer accumulators, and evaluator states between
+``Executor.run`` calls.  Values are ``jax.Array``s living on device; the
+executor threads them through the jitted step function functionally (donated
+in, returned out), so there is no in-place mutation inside a compiled step —
+the scope is the mutable boundary *between* steps.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self.parent = parent
+
+    def new_scope(self) -> "Scope":
+        return Scope(parent=self)
+
+    def set(self, name: str, value):
+        self._vars[name] = value
+
+    def get(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        raise KeyError(name)
+
+    def has(self, name: str) -> bool:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def find_var(self, name: str):
+        return self.get(name) if self.has(name) else None
+
+    def keys(self):
+        return list(self._vars)
+
+    def items(self):
+        return self._vars.items()
+
+    def delete(self, name: str):
+        self._vars.pop(name, None)
+
+    def numpy(self, name: str) -> np.ndarray:
+        return np.asarray(self.get(name))
+
+    def clear(self):
+        self._vars.clear()
+
+    def __contains__(self, name):
+        return self.has(name)
+
+    def __len__(self):
+        return len(self._vars)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old
+
+
+def reset_global_scope():
+    global _global_scope
+    _global_scope = Scope()

@@ -1,0 +1,72 @@
+"""Data types and variable types.
+
+Mirrors the reference's dtype enum (framework.proto:91-105: BOOL..FP64 plus
+FP16) and variable-type enum (framework.proto:108-127), mapped onto numpy/JAX
+dtypes.  BF16 is added as a first-class dtype because it is the native MXU
+input type on TPU.
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class VarType(enum.Enum):
+    """Variable kinds (reference: framework.proto:108-127)."""
+
+    LOD_TENSOR = "lod_tensor"
+    SELECTED_ROWS = "selected_rows"       # sparse gradient rows (selected_rows.h:19)
+    FEED_MINIBATCH = "feed_minibatch"
+    FETCH_LIST = "fetch_list"
+    STEP_SCOPES = "step_scopes"
+    LOD_RANK_TABLE = "lod_rank_table"
+    LOD_TENSOR_ARRAY = "lod_tensor_array"
+    RAW = "raw"
+
+
+# Canonical dtype aliases accepted across the API.  Values are numpy dtypes;
+# jnp consumes them directly.
+_DTYPE_ALIASES = {
+    "bool": np.bool_,
+    "int8": np.int8,
+    "uint8": np.uint8,
+    "int16": np.int16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "float16": np.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": np.float32,
+    "float64": np.float64,
+    # reference spellings (framework.proto / fluid data_type.py)
+    "fp16": np.float16,
+    "bf16": jnp.bfloat16,
+    "fp32": np.float32,
+    "fp64": np.float64,
+}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalise any accepted dtype spelling to a numpy dtype object."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in _DTYPE_ALIASES:
+            return np.dtype(_DTYPE_ALIASES[key])
+        return np.dtype(key)
+    if dtype is jnp.bfloat16:
+        return np.dtype(jnp.bfloat16)
+    return np.dtype(dtype)
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in (np.dtype(np.float16), np.dtype(jnp.bfloat16),
+                 np.dtype(np.float32), np.dtype(np.float64))
+
+
+def is_integral(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return np.issubdtype(d, np.integer) or d == np.dtype(np.bool_)

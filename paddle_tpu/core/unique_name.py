@@ -1,0 +1,45 @@
+"""Unique name generator (reference: python/paddle/v2/fluid/framework.py
+``unique_name`` and fluid's UniqueNameGenerator)."""
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+
+_local = threading.local()
+
+
+def _counters():
+    if not hasattr(_local, "counters"):
+        _local.counters = collections.defaultdict(int)
+    return _local.counters
+
+
+def generate(key: str) -> str:
+    c = _counters()
+    name = f"{key}_{c[key]}"
+    c[key] += 1
+    return name
+
+
+# fluid spelling
+unique_name = generate
+
+
+@contextlib.contextmanager
+def guard(new_state=None):
+    """Reset the generator inside the context (used by tests to make
+    programs reproducible)."""
+    old = getattr(_local, "counters", None)
+    _local.counters = new_state if new_state is not None else collections.defaultdict(int)
+    try:
+        yield
+    finally:
+        if old is None:
+            del _local.counters
+        else:
+            _local.counters = old
+
+
+def reset():
+    _local.counters = collections.defaultdict(int)

@@ -1,0 +1,64 @@
+"""DataFeeder: numpy/nested lists -> feed dict (reference:
+fluid/data_feeder.py:55 DataFeeder converting rows to LoDTensors with lod).
+
+Sequence inputs (``lod_level > 0``) arrive as per-row Python lists of
+variable length; they are padded to the batch max (optionally rounded up to a
+bucket multiple so XLA recompiles rarely) and a ``name@LEN`` int32 vector is
+emitted — the TPU-native replacement for LoD offsets.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .core.program import Variable
+
+
+def _round_up(n: int, multiple: int) -> int:
+    if multiple <= 1:
+        return n
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence[Variable], place=None,
+                 program=None, seq_bucket_multiple: int = 8):
+        self.feed_list = list(feed_list)
+        self.place = place
+        self.seq_bucket_multiple = seq_bucket_multiple
+
+    def feed(self, minibatch: Sequence[Sequence]) -> Dict[str, np.ndarray]:
+        """minibatch: list of rows, each row a tuple matching feed_list."""
+        out: Dict[str, np.ndarray] = {}
+        cols = list(zip(*minibatch))
+        assert len(cols) == len(self.feed_list), \
+            f"feed rows have {len(cols)} fields, expected {len(self.feed_list)}"
+        for var, col in zip(self.feed_list, cols):
+            if var.lod_level == 0:
+                arr = np.asarray(col)
+                want = var.shape
+                if want is not None and len(want) == arr.ndim + 1 and \
+                        want[-1] == 1:
+                    arr = arr[..., None]       # label [B] -> [B,1]
+                out[var.name] = arr.astype(var.dtype)
+            elif var.lod_level == 1:
+                lens = np.asarray([len(r) for r in col], np.int32)
+                T = _round_up(int(lens.max()) if len(lens) else 1,
+                              self.seq_bucket_multiple)
+                first = np.asarray(col[0])
+                feat_shape = first.shape[1:] if first.ndim > 1 else ()
+                arr = np.zeros((len(col), T) + feat_shape, dtype=var.dtype)
+                for i, row in enumerate(col):
+                    r = np.asarray(row, dtype=var.dtype)
+                    arr[i, :len(row)] = r
+                if var.shape is not None and len(var.shape) == arr.ndim + 1 \
+                        and var.shape[-1] == 1:
+                    arr = arr[..., None]
+                out[var.name] = arr
+                out[var.name + "@LEN"] = lens
+            else:
+                raise NotImplementedError(
+                    "lod_level>=2 (nested sequences): feed pre-padded arrays "
+                    "with explicit @LEN companions")
+        return out

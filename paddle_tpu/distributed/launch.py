@@ -1,0 +1,43 @@
+"""Multi-host bootstrap (the role of paddle/scripts/cluster_train/paddle.py
+fabric launcher + trainer_id/num_gradient_servers flags, Flags.cpp).
+
+On TPU pods: jax.distributed.initialize() wires all hosts into one XLA
+runtime; afterwards jax.devices() spans the pod and meshes may cross hosts
+(DCN-aware axes)."""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_distributed(coordinator_address: str = None, num_processes: int = None,
+                     process_id: int = None):
+    """Initialize multi-host JAX.  No-op when single-process (the common
+    dev case) or already initialized."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "PADDLE_TPU_COORDINATOR")
+    if coordinator_address is None and num_processes is None:
+        _initialized = True   # single-process mode
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()

@@ -1,0 +1,149 @@
+"""Task-queue master: dataset sharding with fault tolerance.
+
+Reference: go/master/service.go — partition dataset chunks into tasks
+(:106), todo/pending/done queues (:89-106), GetTask (:368) hands out work
+with a timeout, TaskFinished (:411) retires it, TaskFailed (:455) re-queues
+with a per-task failure budget (failureMax :140), state snapshots (:207).
+
+TPU-native: a thread-safe in-process service (multi-host deployments put it
+on process 0 and reach it over the jax.distributed client or any KV store;
+trainers are stateless consumers exactly as in the reference design
+doc/design/cluster_train/README.md)."""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+@dataclasses.dataclass
+class Task:
+    task_id: int
+    chunks: List            # opaque work units (e.g. file shards)
+    epoch: int = 0
+    num_failures: int = 0
+
+
+class Master:
+    def __init__(self, chunks_per_task: int = 1, timeout_s: float = 60.0,
+                 failure_max: int = 3, snapshot_path: Optional[str] = None):
+        self.chunks_per_task = chunks_per_task
+        self.timeout_s = timeout_s
+        self.failure_max = failure_max
+        self.snapshot_path = snapshot_path
+        self._lock = threading.Lock()
+        self.todo: List[Task] = []
+        self.pending = {}           # task_id -> (Task, deadline)
+        self.done: List[Task] = []
+        self.epoch = 0
+        self._next_id = 0
+
+    # -- dataset -----------------------------------------------------------
+    def set_dataset(self, chunks: List):
+        """Partition chunks into tasks (service.go partition :106)."""
+        with self._lock:
+            self.todo = []
+            for i in range(0, len(chunks), self.chunks_per_task):
+                self.todo.append(Task(self._next_id,
+                                      chunks[i:i + self.chunks_per_task],
+                                      self.epoch))
+                self._next_id += 1
+            self.done = []
+            self.pending = {}
+
+    # -- trainer RPCs ------------------------------------------------------
+    def get_task(self) -> Optional[Task]:
+        with self._lock:
+            self._requeue_timeouts()
+            if not self.todo:
+                if not self.pending and self.done:
+                    # epoch finished: recycle for the next pass
+                    self.epoch += 1
+                    for t in self.done:
+                        t.epoch = self.epoch
+                        t.num_failures = 0
+                    self.todo, self.done = self.done, []
+                else:
+                    return None
+            t = self.todo.pop(0)
+            self.pending[t.task_id] = (t, time.time() + self.timeout_s)
+            return t
+
+    def task_finished(self, task_id: int):
+        with self._lock:
+            ent = self.pending.pop(task_id, None)
+            if ent:
+                self.done.append(ent[0])
+            self._snapshot()
+
+    def task_failed(self, task_id: int):
+        """Re-queue unless failure budget exhausted (service.go:455-472)."""
+        with self._lock:
+            ent = self.pending.pop(task_id, None)
+            if not ent:
+                return
+            t = ent[0]
+            t.num_failures += 1
+            if t.num_failures >= self.failure_max:
+                self.done.append(t)     # dropped from training this pass
+            else:
+                self.todo.append(t)
+
+    def _requeue_timeouts(self):
+        now = time.time()
+        for tid in list(self.pending):
+            t, deadline = self.pending[tid]
+            if now > deadline:
+                del self.pending[tid]
+                t.num_failures += 1
+                if t.num_failures < self.failure_max:
+                    self.todo.append(t)
+                else:
+                    self.done.append(t)
+
+    def _snapshot(self):
+        if not self.snapshot_path:
+            return
+        state = {"epoch": self.epoch,
+                 "todo": [dataclasses.asdict(t) for t in self.todo],
+                 "pending": [dataclasses.asdict(t)
+                             for t, _ in self.pending.values()],
+                 "done": [dataclasses.asdict(t) for t in self.done]}
+        with open(self.snapshot_path, "w") as f:
+            json.dump(state, f)
+
+    def restore_snapshot(self):
+        if not self.snapshot_path:
+            return
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        self.epoch = state["epoch"]
+        self.todo = [Task(**t) for t in
+                     state["todo"] + state["pending"]]
+        self.done = [Task(**t) for t in state["done"]]
+
+
+class TaskQueueClient:
+    """Trainer-side helper (go/master client + v2 master.client analog):
+    iterate data via master tasks with automatic finish/fail reporting."""
+
+    def __init__(self, master: Master, chunk_reader: Callable):
+        self.master = master
+        self.chunk_reader = chunk_reader
+
+    def reader(self):
+        def _r():
+            while True:
+                task = self.master.get_task()
+                if task is None:
+                    return
+                try:
+                    for chunk in task.chunks:
+                        yield from self.chunk_reader(chunk)
+                except Exception:
+                    self.master.task_failed(task.task_id)
+                    continue
+                self.master.task_finished(task.task_id)
+        return _r

@@ -1,0 +1,41 @@
+"""DistributeTranspiler API-parity shim.
+
+Reference: fluid/distribute_transpiler.py:51-200 rewrites a local program
+into trainer programs (send_op/recv boundary) + per-pserver optimizer
+programs, placing params round-robin over endpoints.
+
+On TPU there is nothing to transpile: gradient exchange is an XLA collective
+and every chip runs the SAME program.  This class keeps the reference's call
+surface so training scripts port unchanged — ``transpile`` records the mesh
+configuration; ``get_trainer_program`` returns the original program (to be
+run under parallel.DataParallel); ``get_pserver_program`` raises with
+guidance, since the pserver role does not exist."""
+from __future__ import annotations
+
+from ..core.program import Program, default_main_program
+
+
+class DistributeTranspiler:
+    def __init__(self):
+        self.trainer_id = 0
+        self.trainers = 1
+        self.program = None
+
+    def transpile(self, trainer_id=0, program=None, pservers="", trainers=1,
+                  split_method=None):
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self.program = program or default_main_program()
+        return self
+
+    def get_trainer_program(self) -> Program:
+        return self.program
+
+    def get_pserver_program(self, endpoint=None, *a, **kw):
+        raise RuntimeError(
+            "paddle_tpu has no parameter server: gradient exchange runs as "
+            "XLA collectives over the device mesh. Run the trainer program "
+            "under paddle_tpu.parallel.DataParallel (dp mesh axis) instead; "
+            "multi-host setup is paddle_tpu.distributed.init_distributed().")
+
+    get_startup_program = get_pserver_program

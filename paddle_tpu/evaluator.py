@@ -1,0 +1,112 @@
+"""Program-state evaluators (reference: fluid/evaluator.py:21-90 Evaluator
+base with state vars + reset program, Accuracy, ChunkEvaluator).
+
+States are persistable scope vars accumulated by metric ops inside the main
+program; ``eval`` computes the aggregate, ``reset`` zeroes the states.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core.program import default_main_program
+from .core.scope import global_scope
+from .initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+from . import layers
+
+
+class Evaluator:
+    def __init__(self, name, **kwargs):
+        self.helper = LayerHelper(name, **kwargs)
+        self.states = []
+        self.metrics = []
+
+    def _create_state(self, suffix, dtype, shape):
+        var = self.helper.create_global_variable(
+            list(shape), dtype, name=f"{self.helper.name}.{suffix}")
+        self.helper.set_variable_initializer(var, ConstantInitializer(0.0))
+        self.states.append(var)
+        return var
+
+    def reset(self, executor, reset_program=None, scope=None):
+        import jax.numpy as jnp
+        scope = scope or global_scope()
+        for s in self.states:
+            if scope.has(s.name):
+                scope.set(s.name, jnp.zeros_like(scope.get(s.name)))
+
+    def eval(self, executor, eval_program=None, scope=None):
+        raise NotImplementedError
+
+
+class Accuracy(Evaluator):
+    """Streaming accuracy (fluid evaluator.Accuracy)."""
+
+    def __init__(self, input, label, k=1, **kwargs):
+        super().__init__("accuracy_eval", **kwargs)
+        self.total = self._create_state("total", "float32", [1])
+        self.correct = self._create_state("correct", "float32", [1])
+        topk_out, topk_idx = layers.topk(input, k)
+        acc = self.helper.create_variable_for_type_inference("float32", (1,))
+        bc = self.helper.create_variable_for_type_inference("int32")
+        bt = self.helper.create_variable_for_type_inference("int32")
+        self.helper.append_op(
+            type="accuracy",
+            inputs={"Out": [topk_out], "Indices": [topk_idx],
+                    "Label": [label]},
+            outputs={"Accuracy": [acc], "Correct": [bc], "Total": [bt]})
+        # accumulate into states
+        bcf = layers.cast(bc, "float32")
+        btf = layers.cast(bt, "float32")
+        layers.sums([self.total, btf], out=self.total)
+        layers.sums([self.correct, bcf], out=self.correct)
+        self.metrics.append(acc)
+        self.batch_accuracy = acc
+
+    def eval(self, executor, eval_program=None, scope=None):
+        scope = scope or global_scope()
+        total = float(np.asarray(scope.get(self.total.name))[0])
+        correct = float(np.asarray(scope.get(self.correct.name))[0])
+        return np.array([correct / max(total, 1.0)], np.float32)
+
+
+class ChunkEvaluator(Evaluator):
+    """Streaming chunk F1 (fluid evaluator.ChunkEvaluator; chunk_eval_op)."""
+
+    def __init__(self, input, label, chunk_scheme="IOB", num_chunk_types=1,
+                 **kwargs):
+        super().__init__("chunk_eval", **kwargs)
+        self.num_infer = self._create_state("num_infer", "float32", [1])
+        self.num_label = self._create_state("num_label", "float32", [1])
+        self.num_correct = self._create_state("num_correct", "float32", [1])
+        prec = self.helper.create_variable_for_type_inference("float32")
+        rec = self.helper.create_variable_for_type_inference("float32")
+        f1 = self.helper.create_variable_for_type_inference("float32")
+        ni = self.helper.create_variable_for_type_inference("int64")
+        nl = self.helper.create_variable_for_type_inference("int64")
+        nc = self.helper.create_variable_for_type_inference("int64")
+        self.helper.append_op(
+            type="chunk_eval",
+            inputs={"Inference": [input], "Label": [label]},
+            outputs={"Precision": [prec], "Recall": [rec], "F1-Score": [f1],
+                     "NumInferChunks": [ni], "NumLabelChunks": [nl],
+                     "NumCorrectChunks": [nc]},
+            attrs={"chunk_scheme": chunk_scheme,
+                   "num_chunk_types": num_chunk_types})
+        layers.sums([self.num_infer, layers.cast(ni, "float32")],
+                    out=self.num_infer)
+        layers.sums([self.num_label, layers.cast(nl, "float32")],
+                    out=self.num_label)
+        layers.sums([self.num_correct, layers.cast(nc, "float32")],
+                    out=self.num_correct)
+        self.metrics.extend([prec, rec, f1])
+
+    def eval(self, executor, eval_program=None, scope=None):
+        scope = scope or global_scope()
+        ni = float(np.asarray(scope.get(self.num_infer.name))[0])
+        nl = float(np.asarray(scope.get(self.num_label.name))[0])
+        nc = float(np.asarray(scope.get(self.num_correct.name))[0])
+        p = nc / max(ni, 1.0)
+        r = nc / max(nl, 1.0)
+        f1 = 2 * p * r / max(p + r, 1e-6)
+        return np.array([p, r, f1], np.float32)

@@ -1,0 +1,130 @@
+"""Model persistence (reference: fluid/io.py:32-165 — save/load_vars/params/
+persistables via save_op/load_op files-per-var; save_inference_model
+serializing the pruned ProgramDesc).
+
+Format: one ``<name>.npy`` per var in ``dirname`` (mirroring the reference's
+file-per-parameter layout), program serialized as JSON (``__model__``).
+Sharded/async checkpointing for training state lives in
+paddle_tpu.distributed.checkpoint; this module is the simple synchronous
+path.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .core.program import Parameter, Program, Variable, default_main_program
+from .core.scope import Scope, global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "get_inference_program",
+]
+
+
+def _san(name: str) -> str:
+    return name.replace("/", "__")
+
+
+def save_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, scope: Optional[Scope] = None):
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    os.makedirs(dirname, exist_ok=True)
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else str(v)
+        if not scope.has(name):
+            continue
+        np.save(os.path.join(dirname, _san(name) + ".npy"),
+                np.asarray(scope.get(name)))
+
+
+def _is_param(v):
+    return isinstance(v, Parameter)
+
+
+def _is_persistable(v):
+    return v.persistable
+
+
+def save_params(executor=None, dirname=None, main_program=None, scope=None):
+    save_vars(executor, dirname, main_program, predicate=_is_param,
+              scope=scope)
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      scope=None):
+    save_vars(executor, dirname, main_program, predicate=_is_persistable,
+              scope=scope)
+
+
+def load_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, scope: Optional[Scope] = None):
+    import jax.numpy as jnp
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else str(v)
+        path = os.path.join(dirname, _san(name) + ".npy")
+        if os.path.exists(path):
+            scope.set(name, jnp.asarray(np.load(path)))
+
+
+def load_params(executor=None, dirname=None, main_program=None, scope=None):
+    load_vars(executor, dirname, main_program, predicate=_is_param,
+              scope=scope)
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      scope=None):
+    load_vars(executor, dirname, main_program, predicate=_is_persistable,
+              scope=scope)
+
+
+def get_inference_program(target_vars, main_program=None) -> Program:
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    return main_program.prune(target_vars)
+
+
+def save_inference_model(dirname, feeded_var_names: List[str], target_vars,
+                         executor=None, main_program=None, scope=None):
+    """Prune to the inference slice and persist program+params
+    (reference: fluid/io.py:165)."""
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    pruned = main_program.prune(target_vars)
+    pruned = pruned.clone(for_test=True)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "program": pruned.to_dict(),
+        "feed_var_names": list(feeded_var_names),
+        "fetch_var_names": [t.name if isinstance(t, Variable) else str(t)
+                            for t in target_vars],
+    }
+    with open(os.path.join(dirname, "__model__"), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, main_program, scope=scope)
+
+
+def load_inference_model(dirname, executor=None, scope=None):
+    with open(os.path.join(dirname, "__model__")) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta["program"])
+    load_persistables(executor, dirname, program, scope=scope)
+    fetch_vars = [program.global_block().var(n)
+                  for n in meta["fetch_var_names"]
+                  if program.global_block().has_var(n)]
+    return program, meta["feed_var_names"], fetch_vars

@@ -1,0 +1,15 @@
+"""Layer API (reference: fluid/layers/__init__.py re-exports nn, tensor,
+control_flow, io, ops, detection)."""
+
+from .nn import *            # noqa: F401,F403
+from .tensor import (        # noqa: F401
+    create_tensor, create_global_var, sums, assign, fill_constant,
+    fill_constant_batch_size_like, ones, zeros, zeros_like, reverse,
+    argmax, argsort, gather, scatter, shape, range,
+)
+from .control_flow import *  # noqa: F401,F403
+from .io import data         # noqa: F401
+from .ops import *           # noqa: F401,F403
+from .ops import elementwise_binary_dispatch  # noqa: F401
+from . import detection      # noqa: F401
+from .detection import prior_box, box_coder, iou_similarity  # noqa: F401

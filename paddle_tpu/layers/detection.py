@@ -1,0 +1,45 @@
+"""Detection layers (reference: v1 PriorBox/MultiBoxLoss/DetectionOutput
+layers; fluid roi_pool_op, detection_output_op)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["prior_box", "box_coder", "iou_similarity"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=True, clip=True, name=None):
+    helper = LayerHelper("prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    variances = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="prior_box",
+                     inputs={"Input": [input], "Image": [image]},
+                     outputs={"Boxes": [boxes], "Variances": [variances]},
+                     attrs={"min_sizes": list(min_sizes),
+                            "max_sizes": list(max_sizes or []),
+                            "aspect_ratios": list(aspect_ratios or [1.0]),
+                            "variances": list(variance or
+                                              [0.1, 0.1, 0.2, 0.2]),
+                            "flip": flip, "clip": clip})
+    return boxes, variances
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="decode_center_size",
+              name=None):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    helper.append_op(type="box_coder",
+                     inputs={"PriorBox": [prior_box],
+                             "PriorBoxVar": [prior_box_var],
+                             "TargetBox": [target_box]},
+                     outputs={"OutputBox": [out]},
+                     attrs={"code_type": code_type})
+    return out
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out

@@ -1,0 +1,25 @@
+"""Data-layer functions (reference: fluid/layers/io.py data())."""
+from __future__ import annotations
+
+from ..core.program import default_main_program, default_startup_program
+from ..core.types import convert_dtype
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         type=None, main_program=None, stop_gradient=True):
+    """Declare a feed variable.  ``append_batch_size`` prepends -1 like the
+    reference (fluid/layers/io.py).  ``lod_level`` > 0 marks a sequence input:
+    the DataFeeder will supply a padded tensor plus a ``name@LEN`` companion.
+    """
+    prog = main_program or default_main_program()
+    shape = list(shape)
+    if append_batch_size:
+        # padded+lengths representation: a lod_level-k sequence var carries
+        # k dynamic time dims between batch and features (LoD analog)
+        shape = [-1] + [-1] * lod_level + shape
+    var = prog.global_block().create_var(
+        name=name, shape=shape, dtype=convert_dtype(dtype),
+        lod_level=lod_level, stop_gradient=stop_gradient, is_data=True)
+    return var

@@ -1,0 +1,1005 @@
+"""NN layer functions building program ops (reference: fluid/layers/nn.py —
+fc:21, embedding:142, dynamic_lstm:185, conv2d:562, batch_norm:875, sequence
+ops, etc.).  Each function appends ops to the default main program and returns
+output Variables with best-effort inferred shapes (shape inference happens
+here in Python; the reference splits it between compile-time and runtime
+InferShape, shape_inference.h)."""
+from __future__ import annotations
+
+from ..core import unique_name
+from ..core.program import Variable
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "fc", "embedding", "dynamic_lstm", "dynamic_gru", "gru_unit", "lstm_unit",
+    "conv2d", "conv2d_transpose", "pool2d", "batch_norm", "layer_norm",
+    "dropout", "softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "sequence_conv", "sequence_pool", "sequence_softmax", "sequence_expand",
+    "sequence_first_step", "sequence_last_step", "sequence_concat",
+    "sequence_reshape", "sequence_slice", "sequence_reverse", "lod_reset",
+    "topk", "lrn", "maxout", "row_conv", "im2sequence", "one_hot", "reshape",
+    "squeeze", "unsqueeze", "reduce_sum", "reduce_mean", "reduce_max",
+    "reduce_min", "reduce_prod", "split", "l2_normalize", "matmul", "mul",
+    "cos_sim", "scale", "clip", "clip_by_norm", "mean", "accuracy", "auc",
+    "sigmoid_cross_entropy_with_logits", "nce", "hsigmoid", "transpose",
+    "concat", "cast", "dropout", "relu", "elementwise_add", "elementwise_sub",
+    "elementwise_mul", "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "pad", "roi_pool", "smooth_l1", "bilinear_interp",
+    "warpctc", "linear_chain_crf", "crf_decoding", "label_smooth",
+    "autoincreased_step_counter",
+]
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+
+def _conv_out(size, k, p, s, d=1):
+    if size is None or size < 0:
+        return -1
+    ke = d * (k - 1) + 1
+    return (size + 2 * p - ke) // s + 1
+
+
+# ---------------------------------------------------------------------------
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, is_test=False, name=None, use_mkldnn=False):
+    """Fully connected (fluid/layers/nn.py:21): mul + sum + bias + act.
+    Multiple inputs are projected separately and summed."""
+    helper = LayerHelper("fc", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    attrs = param_attr if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * len(inputs)
+    mul_results = []
+    for inp, pa in zip(inputs, attrs):
+        in_dim = 1
+        for s in inp.shape[num_flatten_dims:]:
+            in_dim *= s
+        w = helper.create_parameter(pa, shape=[in_dim, size], dtype=inp.dtype)
+        out_shape = tuple(inp.shape[:num_flatten_dims]) + (size,)
+        tmp = helper.create_variable_for_type_inference(inp.dtype, out_shape)
+        helper.append_op(type="mul", inputs={"X": [inp], "Y": [w]},
+                         outputs={"Out": [tmp]},
+                         attrs={"x_num_col_dims": num_flatten_dims,
+                                "y_num_col_dims": 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(
+            mul_results[0].dtype, mul_results[0].shape)
+        helper.append_op(type="sum", inputs={"X": mul_results},
+                         outputs={"Out": [pre_bias]})
+    pre_act = helper.append_bias_op(pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    """fluid/layers/nn.py:142.  ``is_sparse`` is accepted for parity: the
+    scatter-add gradient of gather already gives SelectedRows-style sparse
+    updates under XLA, so no separate path is needed."""
+    helper = LayerHelper("embedding", param_attr=param_attr, name=name)
+    w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
+    in_shape = input.shape or (-1, 1)
+    if in_shape and in_shape[-1] == 1:
+        out_shape = tuple(in_shape[:-1]) + (size[1],)
+    else:
+        out_shape = tuple(in_shape) + (size[1],)
+    out = helper.create_variable_for_type_inference(
+        dtype, out_shape, lod_level=input.lod_level)
+    helper.append_op(type="lookup_table",
+                     inputs={"W": [w], "Ids": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"is_sparse": is_sparse,
+                            "padding_idx": -1 if padding_idx is None
+                            else padding_idx})
+    if input.lod_level:
+        _copy_len(helper, input, out)
+    return out
+
+
+def _copy_len(helper, src, dst):
+    helper.append_op(type="copy_len", inputs={"X": [src]},
+                     outputs={"Out": [dst]}, attrs={})
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """fluid/layers/nn.py:185 — input is the pre-projected [B,T,4H] tensor
+    (the fc producing it rides the MXU); this op runs the recurrence."""
+    helper = LayerHelper("lstm", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    hidden = size // 4
+    w = helper.create_parameter(param_attr, shape=[hidden, 4 * hidden],
+                                dtype=dtype)
+    bias_size = 4 * hidden + (3 * hidden if use_peepholes else 0)
+    b = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr) or ParamAttr(), shape=[1, bias_size],
+        dtype=dtype, is_bias=True)
+    x = input
+    if is_reverse:
+        x = sequence_reverse(x)
+    B, T = (input.shape or (-1, -1))[:2]
+    hid = helper.create_variable_for_type_inference(
+        dtype, (B, T, hidden), lod_level=input.lod_level)
+    cell = helper.create_variable_for_type_inference(
+        dtype, (B, T, hidden), lod_level=input.lod_level)
+    ins = {"Input": [x], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    helper.append_op(type="lstm", inputs=ins,
+                     outputs={"Hidden": [hid], "Cell": [cell]},
+                     attrs={"use_peepholes": use_peepholes,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation})
+    if is_reverse:
+        hid = sequence_reverse(hid)
+        cell = sequence_reverse(cell)
+    return hid, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, name=None):
+    helper = LayerHelper("gru", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dtype = input.dtype
+    w = helper.create_parameter(param_attr, shape=[size, 3 * size], dtype=dtype)
+    b = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr) or ParamAttr(), shape=[1, 3 * size],
+        dtype=dtype, is_bias=True)
+    x = sequence_reverse(input) if is_reverse else input
+    B, T = (input.shape or (-1, -1))[:2]
+    hid = helper.create_variable_for_type_inference(
+        dtype, (B, T, size), lod_level=input.lod_level)
+    ins = {"Input": [x], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    helper.append_op(type="gru", inputs=ins, outputs={"Hidden": [hid]},
+                     attrs={"gate_activation": gate_activation,
+                            "activation": candidate_activation})
+    if is_reverse:
+        hid = sequence_reverse(hid)
+    return hid
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid", name=None):
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    h = size // 3
+    w = helper.create_parameter(param_attr, shape=[h, size], dtype=dtype)
+    b = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr) or ParamAttr(), shape=[1, size],
+        dtype=dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype, (hidden.shape[0], h))
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="gru_unit",
+                     inputs={"Input": [input], "HiddenPrev": [hidden],
+                             "Weight": [w], "Bias": [b]},
+                     outputs={"Hidden": [out], "Gate": [gate],
+                              "ResetHiddenPrev": [reset]},
+                     attrs={"activation": activation,
+                            "gate_activation": gate_activation})
+    return out, reset, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """fluid lstm_unit: fc([x,h]) -> gates -> lstm_unit op."""
+    size = cell_t_prev.shape[-1]
+    gates = fc([x_t, hidden_t_prev], 4 * size, param_attr=param_attr,
+               bias_attr=bias_attr if bias_attr is not None else True)
+    helper = LayerHelper("lstm_unit_core", name=name)
+    c = helper.create_variable_for_type_inference(x_t.dtype, cell_t_prev.shape)
+    h = helper.create_variable_for_type_inference(x_t.dtype, cell_t_prev.shape)
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": [gates], "C_prev": [cell_t_prev]},
+                     outputs={"C": [c], "H": [h]},
+                     attrs={"forget_bias": forget_bias})
+    return h, c
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           use_cudnn=True, name=None):
+    """fluid/layers/nn.py:562 (use_cudnn accepted+ignored: XLA owns conv
+    algorithm selection)."""
+    helper = LayerHelper("conv2d", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dtype = input.dtype
+    fs = _pair(filter_size)
+    st = _pair(stride)
+    pd = _pair(padding)
+    dl = _pair(dilation)
+    n, c = input.shape[0], input.shape[1]
+    w = helper.create_parameter(
+        param_attr, shape=[num_filters, c // groups, fs[0], fs[1]], dtype=dtype)
+    oh = _conv_out(input.shape[2], fs[0], pd[0], st[0], dl[0])
+    ow = _conv_out(input.shape[3], fs[1], pd[1], st[1], dl[1])
+    out = helper.create_variable_for_type_inference(
+        dtype, (n, num_filters, oh, ow))
+    helper.append_op(type="conv2d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": st, "paddings": pd, "dilations": dl,
+                            "groups": groups})
+    if helper.kwargs.get("bias_attr") is not False:
+        b = helper.create_parameter(
+            ParamAttr._to_attr(bias_attr) or ParamAttr(),
+            shape=[num_filters], dtype=dtype, is_bias=True)
+        out2 = helper.create_variable_for_type_inference(dtype, out.shape)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [out2]}, attrs={"axis": 1})
+        out = out2
+    return helper.append_activation(out)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, param_attr=None,
+                     bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    st = _pair(stride)
+    pd = _pair(padding)
+    dl = _pair(dilation)
+    n, c, h, ww = input.shape
+    if filter_size is None:
+        os = _pair(output_size)
+        fs = [os[0] + 2 * pd[0] - (h - 1) * st[0],
+              os[1] + 2 * pd[1] - (ww - 1) * st[1]]
+    else:
+        fs = _pair(filter_size)
+    w = helper.create_parameter(
+        param_attr, shape=[c, num_filters, fs[0], fs[1]], dtype=dtype)
+    oh = (h - 1) * st[0] - 2 * pd[0] + dl[0] * (fs[0] - 1) + 1 if h > 0 else -1
+    ow = (ww - 1) * st[1] - 2 * pd[1] + dl[1] * (fs[1] - 1) + 1 if ww > 0 else -1
+    out = helper.create_variable_for_type_inference(
+        dtype, (n, num_filters, oh, ow))
+    helper.append_op(type="conv2d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": st, "paddings": pd, "dilations": dl})
+    if helper.kwargs.get("bias_attr") is not False and bias_attr is not False:
+        out2 = helper.create_variable_for_type_inference(dtype, out.shape)
+        b = helper.create_parameter(
+            ParamAttr._to_attr(bias_attr) or ParamAttr(),
+            shape=[num_filters], dtype=dtype, is_bias=True)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [out2]}, attrs={"axis": 1})
+        out = out2
+    return helper.append_activation(out)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool2d", name=name)
+    ks = _pair(pool_size)
+    st = _pair(pool_stride)
+    pd = _pair(pool_padding)
+    n, c, h, w = input.shape
+    if global_pooling:
+        oh = ow = 1
+    else:
+        oh = _conv_out(h, ks[0], pd[0], st[0])
+        ow = _conv_out(w, ks[1], pd[1], st[1])
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (n, c, oh, ow))
+    helper.append_op(type="pool2d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": ks,
+                            "strides": st, "paddings": pd,
+                            "global_pooling": global_pooling,
+                            "exclusive": exclusive})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               moving_mean_name=None, moving_variance_name=None, name=None):
+    """fluid/layers/nn.py:875 — running stats are persistable vars updated by
+    the op's MeanOut/VarianceOut writes."""
+    from ..initializer import ConstantInitializer
+    helper = LayerHelper("batch_norm", name=name)
+    dtype = input.dtype
+    c = input.shape[1]
+    scale = helper.create_parameter(
+        ParamAttr._to_attr(param_attr) or ParamAttr(), shape=[c], dtype=dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr) or ParamAttr(), shape=[c], dtype=dtype,
+        is_bias=True)
+    mean = helper.create_global_variable([c], dtype, name=moving_mean_name)
+    var = helper.create_global_variable([c], dtype, name=moving_variance_name)
+    helper.set_variable_initializer(mean, ConstantInitializer(0.0))
+    helper.set_variable_initializer(var, ConstantInitializer(1.0))
+    saved_mean = helper.create_variable_for_type_inference(dtype)
+    saved_var = helper.create_variable_for_type_inference(dtype)
+    out = helper.create_variable_for_type_inference(dtype, input.shape)
+    helper.append_op(type="batch_norm",
+                     inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                             "Mean": [mean], "Variance": [var]},
+                     outputs={"Y": [out], "MeanOut": [mean.name],
+                              "VarianceOut": [var.name],
+                              "SavedMean": [saved_mean],
+                              "SavedVariance": [saved_var]},
+                     attrs={"momentum": momentum, "epsilon": epsilon,
+                            "is_test": is_test})
+    return helper.append_activation(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..initializer import ConstantInitializer
+    helper = LayerHelper("layer_norm", name=name)
+    dtype = input.dtype
+    norm_shape = [int(_prod(input.shape[begin_norm_axis:]))]
+    ins = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(
+            ParamAttr._to_attr(param_attr) or ParamAttr(), shape=norm_shape,
+            dtype=dtype, default_initializer=ConstantInitializer(1.0))
+        ins["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(
+            ParamAttr._to_attr(bias_attr) or ParamAttr(), shape=norm_shape,
+            dtype=dtype, is_bias=True)
+        ins["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(dtype, input.shape)
+    mean = helper.create_variable_for_type_inference(dtype)
+    var = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="layer_norm", inputs=ins,
+                     outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+                     attrs={"epsilon": epsilon,
+                            "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(out, act)
+
+
+def _prod(t):
+    p = 1
+    for x in t:
+        p *= int(x)
+    return p
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape,
+                                                    lod_level=x.lod_level)
+    mask = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="dropout", inputs={"X": [x]},
+                     outputs={"Out": [out], "Mask": [mask]},
+                     attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+                            "seed": seed or 0,
+                            "dropout_implementation": dropout_implementation})
+    return out
+
+
+# -- simple wrappers --------------------------------------------------------
+def _unary_layer(op_type, x, attrs=None, name=None, out_slot="Out",
+                 lod_from=None):
+    helper = LayerHelper(op_type, name=name)
+    src = lod_from if lod_from is not None else x
+    out = helper.create_variable_for_type_inference(
+        x.dtype, x.shape, lod_level=getattr(src, "lod_level", 0))
+    helper.append_op(type=op_type, inputs={"X": [x]},
+                     outputs={out_slot: [out]}, attrs=attrs or {})
+    return out
+
+
+def softmax(input, axis=-1, use_cudnn=True, name=None):
+    return _unary_layer("softmax", input, {"axis": axis}, name)
+
+
+def relu(x, name=None):
+    return _unary_layer("relu", x, None, name)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = _unary_layer("scale", x, {"scale": float(scale), "bias": float(bias),
+                                    "bias_after_scale": bias_after_scale},
+                       name)
+    if act:
+        return LayerHelper("scale_act").append_activation(out, act)
+    return out
+
+
+def clip(x, min, max, name=None):
+    return _unary_layer("clip", x, {"min": float(min), "max": float(max)}, name)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _unary_layer("clip_by_norm", x, {"max_norm": float(max_norm)}, name)
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, ())
+    helper.append_op(type="mean", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def cast(x, dtype):
+    from ..core.types import convert_dtype
+    helper = LayerHelper("cast")
+    out = helper.create_variable_for_type_inference(
+        convert_dtype(dtype), x.shape, lod_level=x.lod_level)
+    helper.append_op(type="cast", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"out_dtype": convert_dtype(dtype).name})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    shape = list(input[0].shape) if input[0].shape else None
+    if shape is not None:
+        tot = 0
+        ok = True
+        for v in input:
+            if v.shape is None or v.shape[axis] < 0:
+                ok = False
+                break
+            tot += v.shape[axis]
+        shape[axis] = tot if ok else -1
+    out = helper.create_variable_for_type_inference(
+        input[0].dtype, tuple(shape) if shape else None)
+    helper.append_op(type="concat", inputs={"X": input},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose", name=name)
+    shape = tuple(x.shape[p] for p in perm) if x.shape else None
+    out = helper.create_variable_for_type_inference(x.dtype, shape)
+    helper.append_op(type="transpose", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"axis": list(perm)})
+    return out
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, tuple(
+        s if s != 0 else x.shape[i] for i, s in enumerate(shape)))
+    helper.append_op(type="reshape", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"shape": list(shape)})
+    return helper.append_activation(out, act)
+
+
+def squeeze(input, axes, name=None):
+    return _unary_layer("squeeze", input, {"axes": list(axes)}, name)
+
+
+def unsqueeze(input, axes, name=None):
+    return _unary_layer("unsqueeze", input, {"axes": list(axes)}, name)
+
+
+def _reduce_layer(op, input, dim, keep_dim, name):
+    helper = LayerHelper(op, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"keep_dim": keep_dim}
+    if dim is None:
+        attrs["reduce_all"] = True
+    else:
+        attrs["dim"] = dim if isinstance(dim, (list, tuple)) else [dim]
+    helper.append_op(type=op, inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_prod", input, dim, keep_dim, name)
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "axis": dim}
+    else:
+        n = len(num_or_sections)
+        attrs = {"sections": list(num_or_sections), "axis": dim}
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(n)]
+    helper.append_op(type="split", inputs={"X": [input]},
+                     outputs={"Out": outs}, attrs=attrs)
+    return outs
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return _unary_layer("l2_normalize", x,
+                        {"axis": axis, "epsilon": epsilon}, name)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="matmul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"transpose_X": transpose_x,
+                            "transpose_Y": transpose_y, "alpha": alpha})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="mul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"x_num_col_dims": x_num_col_dims,
+                            "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def _elementwise_layer(op, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op, name=name)
+    out = helper.create_variable_for_type_inference(
+        x.dtype, x.shape, lod_level=max(x.lod_level, getattr(y, "lod_level", 0)))
+    helper.append_op(type=op, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return helper.append_activation(out, act)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_pow", x, y, axis, act, name)
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _unary_layer("pad", x, {"paddings": list(paddings),
+                                   "pad_value": float(pad_value)}, name)
+
+
+# -- losses / classification -------------------------------------------------
+def cross_entropy(input, label, soft_label=False, name=None):
+    helper = LayerHelper("cross_entropy", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], 1) if input.shape else None)
+    helper.append_op(type="cross_entropy",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]}, attrs={"soft_label": soft_label})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, name=None):
+    helper = LayerHelper("softmax_with_cross_entropy", name=name)
+    softmax_out = helper.create_variable_for_type_inference(
+        logits.dtype, logits.shape)
+    loss = helper.create_variable_for_type_inference(
+        logits.dtype, (logits.shape[0], 1) if logits.shape else None)
+    helper.append_op(type="softmax_with_cross_entropy",
+                     inputs={"Logits": [logits], "Label": [label]},
+                     outputs={"Softmax": [softmax_out], "Loss": [loss]},
+                     attrs={"soft_label": soft_label})
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, name=None):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="sigmoid_cross_entropy_with_logits",
+                     inputs={"X": [x], "Label": [label]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None,
+              name=None):
+    helper = LayerHelper("smooth_l1_loss", name=name)
+    diff = helper.create_variable_for_type_inference(x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        ins["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        ins["OutsideWeight"] = [outside_weight]
+    helper.append_op(type="smooth_l1_loss", inputs=ins,
+                     outputs={"Out": [out], "Diff": [diff]},
+                     attrs={"sigma": sigma or 1.0})
+    return out
+
+
+def cos_sim(X, Y, name=None):
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]})
+    return out
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """fluid accuracy layer: top-k then accuracy op."""
+    helper = LayerHelper("accuracy", name=name)
+    topk_out, topk_indices = topk(input, k)
+    acc_out = helper.create_variable_for_type_inference("float32", (1,))
+    correct = correct or helper.create_variable_for_type_inference("int32")
+    total = total or helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="accuracy",
+                     inputs={"Out": [topk_out], "Indices": [topk_indices],
+                             "Label": [label]},
+                     outputs={"Accuracy": [acc_out], "Correct": [correct],
+                              "Total": [total]})
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, name=None):
+    helper = LayerHelper("auc", name=name)
+    auc_out = helper.create_variable_for_type_inference("float32", (1,))
+    stat_pos = helper.create_variable_for_type_inference("float32")
+    stat_neg = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="auc",
+                     inputs={"Predict": [input], "Label": [label]},
+                     outputs={"AUC": [auc_out], "StatPosOut": [stat_pos],
+                              "StatNegOut": [stat_neg]},
+                     attrs={"num_thresholds": num_thresholds})
+    return auc_out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    shape = tuple(input.shape[:-1]) + (k,) if input.shape else None
+    values = helper.create_variable_for_type_inference(input.dtype, shape)
+    indices = helper.create_variable_for_type_inference("int64", shape)
+    helper.append_op(type="top_k", inputs={"X": [input]},
+                     outputs={"Out": [values], "Indices": [indices]},
+                     attrs={"k": k})
+    return values, indices
+
+
+def one_hot(input, depth, name=None):
+    helper = LayerHelper("one_hot", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="one_hot", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"depth": depth})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype, label.shape)
+    n = label.shape[-1]
+    helper.append_op(type="scale", inputs={"X": [label]},
+                     outputs={"Out": [out]},
+                     attrs={"scale": 1.0 - epsilon, "bias": epsilon / n})
+    return out
+
+
+def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    mid = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    n, c, h, w = x.shape
+    out = helper.create_variable_for_type_inference(
+        x.dtype, (n, c // groups, h, w))
+    helper.append_op(type="maxout", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"groups": groups})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="roi_pool",
+                     inputs={"X": [input], "ROIs": [rois]},
+                     outputs={"Out": [out], "Argmax": [argmax]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def bilinear_interp(input, out_h, out_w, name=None):
+    helper = LayerHelper("bilinear_interp", name=name)
+    n, c = input.shape[0], input.shape[1]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (n, c, out_h, out_w))
+    helper.append_op(type="bilinear_interp", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"out_h": out_h, "out_w": out_w})
+    return out
+
+
+# -- sequence layers ---------------------------------------------------------
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr,
+                                shape=[filter_size * d, num_filters],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(
+        dtype, tuple(input.shape[:-1]) + (num_filters,),
+        lod_level=input.lod_level)
+    helper.append_op(type="sequence_conv",
+                     inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]},
+                     attrs={"contextStride": filter_stride,
+                            "contextStart": -(filter_size // 2),
+                            "contextLength": filter_size})
+    pre_act = helper.append_bias_op(out)
+    return helper.append_activation(pre_act)
+
+
+def sequence_pool(input, pool_type, name=None):
+    helper = LayerHelper("sequence_pool", name=name)
+    shape = (input.shape[0],) + tuple(input.shape[2:]) if input.shape else None
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    helper.append_op(type="sequence_pool", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_first_step(input, name=None):
+    return sequence_pool(input, "first", name=name)
+
+
+def sequence_last_step(input, name=None):
+    return sequence_pool(input, "last", name=name)
+
+
+def sequence_softmax(input, name=None):
+    return _unary_layer("sequence_softmax", input, None, name, lod_from=input)
+
+
+def sequence_expand(x, y, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, lod_level=1)
+    helper.append_op(type="sequence_expand", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_concat(input, axis=0, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype, lod_level=1)
+    helper.append_op(type="sequence_concat", inputs={"X": input},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    helper = LayerHelper("sequence_reshape", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, lod_level=1)
+    helper.append_op(type="sequence_reshape", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"new_dim": new_dim})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, input.shape, lod_level=1)
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": [input], "Offset": [offset],
+                             "Length": [length]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_reverse(x, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(
+        x.dtype, x.shape, lod_level=x.lod_level)
+    helper.append_op(type="sequence_reverse", inputs={"X": [x]},
+                     outputs={"Y": [out]})
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None, name=None):
+    helper = LayerHelper("lod_reset", name=name)
+    out = helper.create_variable_for_type_inference(
+        x.dtype, x.shape, lod_level=1)
+    ins = {"X": [x]}
+    if y is not None:
+        ins["Y"] = [y]
+    helper.append_op(type="lod_reset", inputs=ins, outputs={"Out": [out]},
+                     attrs={"target_lod": target_lod or []})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None, name=None):
+    helper = LayerHelper("row_conv", param_attr=param_attr, name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr,
+                                shape=[future_context_size + 1, d],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, input.shape, lod_level=input.lod_level)
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out, act)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, lod_level=1)
+    helper.append_op(type="im2sequence", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"kernels": _pair(filter_size),
+                            "strides": _pair(stride),
+                            "paddings": _pair(padding)})
+    return out
+
+
+# -- sparse / sampled ---------------------------------------------------------
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, name=None):
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr) or ParamAttr(),
+        shape=[num_total_classes], dtype=input.dtype, is_bias=True)
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sl = helper.create_variable_for_type_inference(input.dtype)
+    slab = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="nce",
+                     inputs={"Input": [input], "Label": [label],
+                             "Weight": [w], "Bias": [b]},
+                     outputs={"Cost": [cost], "SampleLogits": [sl],
+                              "SampleLabels": [slab]},
+                     attrs={"num_neg_samples": num_neg_samples,
+                            "num_total_classes": num_total_classes})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    helper = LayerHelper("hierarchical_sigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr) or ParamAttr(),
+        shape=[num_classes - 1], dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="hierarchical_sigmoid",
+                     inputs={"X": [input], "Label": [label], "W": [w],
+                             "Bias": [b]},
+                     outputs={"Out": [out], "PreOut": [pre]},
+                     attrs={"num_classes": num_classes})
+    return out
+
+
+# -- structured prediction ----------------------------------------------------
+def linear_chain_crf(input, label, param_attr=None, name=None):
+    """CRF negative log-likelihood (linear_chain_crf_op; v1 CRFLayer).
+    Transition param shape [D+2, D] like the reference (start/end rows)."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr, name=name)
+    ntags = input.shape[-1]
+    transition = helper.create_parameter(
+        param_attr, shape=[ntags + 2, ntags], dtype=input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    emission_exps = helper.create_variable_for_type_inference(input.dtype)
+    transition_exps = helper.create_variable_for_type_inference(input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="linear_chain_crf",
+                     inputs={"Emission": [input], "Transition": [transition],
+                             "Label": [label]},
+                     outputs={"Alpha": [alpha],
+                              "EmissionExps": [emission_exps],
+                              "TransitionExps": [transition_exps],
+                              "LogLikelihood": [ll]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, name=None):
+    helper = LayerHelper("crf_decoding", name=name)
+    transition = helper.main_program.global_block().var(
+        ParamAttr._to_attr(param_attr).name)
+    out = helper.create_variable_for_type_inference("int64", lod_level=1)
+    ins = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        ins["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=ins,
+                     outputs={"ViterbiPath": [out]})
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, name=None):
+    """CTC loss (reference: WarpCTCLayer / warpctc_op) via a lax.scan
+    forward algorithm — no external warp-ctc library."""
+    helper = LayerHelper("warpctc", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="warpctc",
+                     inputs={"Logits": [input], "Label": [label]},
+                     outputs={"Loss": [out]},
+                     attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """fluid layers.autoincreased_step_counter: persistable int64 counter
+    incremented once per executor run."""
+    from ..initializer import ConstantInitializer
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    gb = helper.main_program.global_block()
+    if name in gb.vars:
+        counter = gb.vars[name]
+        counter._already_incremented = getattr(
+            counter, "_already_incremented", True)
+        return counter
+    counter = helper.create_global_variable([1], "int64", name=name)
+    helper.set_variable_initializer(
+        counter, ConstantInitializer(begin - step))
+    helper.append_op(type="increment", inputs={"X": [counter]},
+                     outputs={"Out": [counter]}, attrs={"step": float(step)})
+    return counter

@@ -1,0 +1,71 @@
+"""Auto-generated pass-through layers for simple ops (reference:
+fluid/layers/ops.py auto-registers a layer per OpProto via registry.py).
+Each function creates an output var and appends the op."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+_UNARY_OPS = [
+    "sigmoid", "logsigmoid", "exp", "relu", "tanh", "sqrt", "rsqrt", "abs",
+    "ceil", "floor", "round", "reciprocal", "log", "square", "softplus",
+    "softsign", "brelu", "leaky_relu", "soft_shrink", "hard_shrink", "stanh",
+    "thresholded_relu", "hard_sigmoid", "relu6", "pow", "elu", "gelu",
+    "silu", "swish", "sin", "cos", "sign", "softrelu",
+]
+
+
+def _make_unary(op_type):
+    def layer(x, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(
+            x.dtype, x.shape, lod_level=x.lod_level)
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]}, attrs=attrs)
+        return out
+    layer.__name__ = op_type
+    layer.__doc__ = f"{op_type} activation (auto-registered pass-through)."
+    return layer
+
+
+_g = globals()
+for _op in _UNARY_OPS:
+    if _op not in _g:
+        _g[_op] = _make_unary(_op)
+
+__all__ = list(_UNARY_OPS)
+
+
+def elementwise_binary_dispatch(op, a, b):
+    """Support Variable +-*/ scalars and Variables (math_op_patch analog)."""
+    from ..core.program import Variable
+    from . import nn
+    if isinstance(a, Variable) and isinstance(b, Variable):
+        return getattr(nn, op)(a, b)
+    if isinstance(a, Variable):
+        s = float(b)
+        if op == "elementwise_add":
+            return nn.scale(a, 1.0, s)
+        if op == "elementwise_sub":
+            return nn.scale(a, 1.0, -s)
+        if op == "elementwise_mul":
+            return nn.scale(a, s, 0.0)
+        if op == "elementwise_div":
+            return nn.scale(a, 1.0 / s, 0.0)
+        if op == "elementwise_pow":
+            helper = LayerHelper("pow")
+            out = helper.create_variable_for_type_inference(a.dtype, a.shape)
+            helper.append_op(type="pow", inputs={"X": [a]},
+                             outputs={"Out": [out]}, attrs={"factor": s})
+            return out
+    else:
+        s = float(a)
+        if op == "elementwise_add":
+            return nn.scale(b, 1.0, s)
+        if op == "elementwise_sub":          # s - b
+            return nn.scale(b, -1.0, s)
+        if op == "elementwise_mul":
+            return nn.scale(b, s, 0.0)
+        if op == "elementwise_div":          # s / b
+            rec = _g["reciprocal"](b)
+            return nn.scale(rec, s, 0.0)
+    raise TypeError(f"unsupported operands for {op}: {a!r}, {b!r}")

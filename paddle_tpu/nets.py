@@ -1,0 +1,68 @@
+"""Composite network helpers (reference: fluid/nets.py —
+simple_img_conv_pool, img_conv_group, sequence_conv_pool, glu,
+scaled_dot_product_attention)."""
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+           "glu", "scaled_dot_product_attention"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, act=None, pool_type="max",
+                         param_attr=None):
+    conv_out = layers.conv2d(input, num_filters, filter_size,
+                             param_attr=param_attr, act=act)
+    return layers.pool2d(conv_out, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max"):
+    tmp = input
+    if not isinstance(conv_padding, (list, tuple)):
+        conv_padding = [conv_padding] * len(conv_num_filter)
+    if not isinstance(conv_with_batchnorm, (list, tuple)):
+        conv_with_batchnorm = [conv_with_batchnorm] * len(conv_num_filter)
+    if not isinstance(conv_batchnorm_drop_rate, (list, tuple)):
+        conv_batchnorm_drop_rate = \
+            [conv_batchnorm_drop_rate] * len(conv_num_filter)
+    for i, nf in enumerate(conv_num_filter):
+        local_act = conv_act if not conv_with_batchnorm[i] else None
+        tmp = layers.conv2d(tmp, nf, conv_filter_size,
+                            padding=conv_padding[i], act=local_act,
+                            param_attr=param_attr)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            if conv_batchnorm_drop_rate[i] > 0:
+                tmp = layers.dropout(tmp, conv_batchnorm_drop_rate[i])
+    return layers.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, act="sigmoid",
+                       pool_type="max", param_attr=None):
+    conv_out = layers.sequence_conv(input, num_filters, filter_size,
+                                    param_attr=param_attr, act=act)
+    return layers.sequence_pool(conv_out, pool_type)
+
+
+def glu(input, dim=-1):
+    a, b = layers.split(input, 2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Single-mesh attention block (fluid nets.py analog).  For sharded /
+    ring variants see paddle_tpu.parallel.ring_attention."""
+    d = queries.shape[-1]
+    scaled_q = layers.scale(queries, scale=float(d) ** -0.5)
+    logits = layers.matmul(scaled_q, keys, transpose_y=True)
+    weights = layers.softmax(logits)
+    if dropout_rate > 0.0:
+        weights = layers.dropout(weights, dropout_rate)
+    return layers.matmul(weights, values)

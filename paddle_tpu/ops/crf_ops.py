@@ -1,0 +1,280 @@
+"""Structured-prediction ops: linear-chain CRF, Viterbi decoding, CTC loss,
+chunk/edit-distance evaluation.
+
+Reference: linear_chain_crf_op.cc + crf_decoding_op.cc (fluid),
+LinearChainCRF.cpp / CRFLayer.cpp (v1), WarpCTCLayer.cpp + warpctc wrapper
+(hl_warpctc_wrap.cc), chunk_eval_op.cc, edit_distance_op.cc.
+
+TPU-native: the forward/Viterbi/CTC recursions are lax.scan programs in
+log-space over the padded+lengths batch — fully differentiable via jax.vjp,
+so there is no handwritten backward (the reference implements analytic
+gradients in C++; warp-ctc is an external CUDA lib).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .sequence_ops import _mask, _seq_lens_or_full
+
+NEG = -1e30
+
+
+@register_op("linear_chain_crf")
+def _linear_chain_crf(ctx, ins, attrs):
+    """Emission [B,T,D]; Transition [D+2,D] (row 0: start, row 1: end,
+    rows 2..: pairwise w[prev, cur]); Label [B,T] or [B,T,1].
+    LogLikelihood [B,1] (negative log-likelihood, matching the reference's
+    use as a minimized cost via its sign convention: it returns -logp)."""
+    em = ins["Emission"][0]
+    trans = ins["Transition"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    if label.ndim == 3:
+        label = label.squeeze(-1)
+    lens = _seq_lens_or_full(ctx, em, slot="Emission")
+    B, T, D = em.shape
+    start, end, w = trans[0], trans[1], trans[2:]
+    m = _mask(lens, T, em.dtype)                      # [B,T]
+
+    # partition function: alpha recursion in log space
+    def fwd(alpha, inp):
+        e_t, m_t = inp                                # [B,D], [B]
+        scores = alpha[:, :, None] + w[None] + e_t[:, None, :]
+        new = jax.nn.logsumexp(scores, axis=1)
+        keep = m_t[:, None]
+        return keep * new + (1 - keep) * alpha, None
+
+    alpha0 = start[None] + em[:, 0]
+    em_t = jnp.swapaxes(em, 0, 1)
+    alpha, _ = lax.scan(fwd, alpha0, (em_t[1:], m.T[1:]))
+    logZ = jax.nn.logsumexp(alpha + end[None], axis=1)   # [B]
+
+    # gold score
+    t_idx = jnp.arange(T)
+    gold_em = jnp.take_along_axis(em, label[..., None], axis=2).squeeze(-1)
+    gold_em = jnp.sum(gold_em * m, axis=1)
+    prev = label[:, :-1]
+    cur = label[:, 1:]
+    pair = w[prev, cur] * m[:, 1:]
+    gold_tr = jnp.sum(pair, axis=1)
+    last = jnp.take_along_axis(label, jnp.maximum(lens - 1, 0)[:, None],
+                               axis=1).squeeze(1)
+    gold = gold_em + gold_tr + start[label[:, 0]] + end[last]
+    nll = (logZ - gold)[:, None]
+    ctx.set_len(ctx.op.outputs["LogLikelihood"][0],
+                jnp.ones((B,), jnp.int32))
+    return {"LogLikelihood": nll, "Alpha": alpha,
+            "EmissionExps": jnp.exp(em), "TransitionExps": jnp.exp(trans)}
+
+
+@register_op("crf_decoding")
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (crf_decoding_op.cc).  With Label given, emits
+    correctness indicators like the reference."""
+    em = ins["Emission"][0]
+    trans = ins["Transition"][0]
+    lens = _seq_lens_or_full(ctx, em, slot="Emission")
+    B, T, D = em.shape
+    start, end, w = trans[0], trans[1], trans[2:]
+    m = _mask(lens, T, em.dtype)
+
+    def fwd(carry, inp):
+        score = carry
+        e_t, m_t = inp
+        cand = score[:, :, None] + w[None]
+        best_prev = jnp.argmax(cand, axis=1)
+        new = jnp.max(cand, axis=1) + e_t
+        keep = m_t[:, None]
+        score_out = keep * new + (1 - keep) * score
+        return score_out, best_prev.astype(jnp.int32)
+
+    score0 = start[None] + em[:, 0]
+    em_t = jnp.swapaxes(em, 0, 1)
+    final, backptr = lax.scan(fwd, score0, (em_t[1:], m.T[1:]))
+    final = final + end[None]
+    last_tag = jnp.argmax(final, axis=1).astype(jnp.int32)   # [B]
+
+    # backtrace from each sequence's last position
+    def back(carry, inp):
+        tag, t = carry
+        bp_t, step = inp  # bp for transition into position step+1
+        # active if step+1 <= len-1  i.e. step < len-1
+        active = (step < lens - 1)
+        prev = bp_t[jnp.arange(B), tag]
+        tag_new = jnp.where(active, prev, tag)
+        return (tag_new, t - 1), tag_new
+
+    steps = jnp.arange(T - 2, -1, -1)
+    (_, _), tags_rev = lax.scan(
+        back, (last_tag, T - 2), (backptr[::-1], steps))
+    # tags_rev[i] is the tag at position steps[i]; build full path
+    path = jnp.concatenate([tags_rev[::-1].T, last_tag[:, None]], axis=1)
+    # positions beyond len-1 hold garbage; mask to 0
+    path = jnp.where(m.astype(bool), path, 0)
+    # reference writes the tag at position len-1 = last_tag:
+    path = jnp.where(
+        (jnp.arange(T)[None] == (lens - 1)[:, None]), last_tag[:, None], path)
+    out_name = ctx.op.outputs["ViterbiPath"][0]
+    ctx.set_len(out_name, lens)
+    out = {"ViterbiPath": path.astype(jnp.int64)}
+    if "Label" in ctx.op.inputs and ctx.op.inputs["Label"]:
+        label = ins["Label"][0].astype(jnp.int64)
+        if label.ndim == 3:
+            label = label.squeeze(-1)
+        out["ViterbiPath"] = (path == label).astype(jnp.int64) * \
+            m.astype(jnp.int64)
+    return out
+
+
+@register_op("warpctc")
+def _warpctc(ctx, ins, attrs):
+    """CTC loss via the standard alpha recursion in log space.
+
+    Logits [B,T,C] (pre-softmax); Label [B,L] padded with lens companion (or
+    -1 padding).  Returns Loss [B,1].  Replaces the external warp-ctc CUDA
+    library with a scan the XLA scheduler pipelines.
+    """
+    logits = ins["Logits"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    if label.ndim == 3:
+        label = label.squeeze(-1)
+    blank = attrs.get("blank", 0)
+    B, T, C = logits.shape
+    L = label.shape[1]
+    in_lens = _seq_lens_or_full(ctx, logits, slot="Logits")
+    lab_lens = ctx.get_len(ctx.op.inputs["Label"][0])
+    if lab_lens is None:
+        lab_lens = jnp.sum((label >= 0).astype(jnp.int32), axis=1)
+    label = jnp.where(label < 0, 0, label)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    S = 2 * L + 1
+    # extended label sequence: blank l1 blank l2 ... blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    s_pos = jnp.arange(S)
+    valid_s = s_pos[None, :] < (2 * lab_lens + 1)[:, None]
+    # can skip from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], 1)
+    can_skip = (s_pos[None, :] % 2 == 1) & (ext != ext_m2)
+
+    def step(alpha, inp):
+        lp_t, t = inp                                  # [B,C], scalar
+        e = jnp.take_along_axis(lp_t, ext, axis=1)     # [B,S]
+        a_m1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], 1)
+        a_m2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], 1)
+        a_m2 = jnp.where(can_skip, a_m2, NEG)
+        new = jnp.logaddexp(jnp.logaddexp(alpha, a_m1), a_m2) + e
+        new = jnp.where(valid_s, new, NEG)
+        active = (t < in_lens)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha0 = jnp.full((B, S), NEG)
+    e0 = jnp.take_along_axis(logp[:, 0], ext, axis=1)
+    alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(lab_lens > 0, e0[:, 1], NEG))
+    lp_t = jnp.swapaxes(logp, 0, 1)
+    alpha, _ = lax.scan(step, alpha0, (lp_t[1:], jnp.arange(1, T)))
+    end1 = jnp.take_along_axis(alpha, (2 * lab_lens)[:, None], axis=1)
+    end2 = jnp.take_along_axis(alpha, jnp.maximum(2 * lab_lens - 1, 0)[:, None],
+                               axis=1)
+    ll = jnp.logaddexp(end1, end2).squeeze(1)
+    loss = -ll[:, None]
+    if attrs.get("norm_by_times", False):
+        loss = loss / jnp.maximum(in_lens, 1)[:, None].astype(loss.dtype)
+    return {"Loss": loss}
+
+
+@register_op("edit_distance")
+def _edit_distance(ctx, ins, attrs):
+    """edit_distance_op: Levenshtein distance between hyp and ref id rows."""
+    hyp = ins["Hyps"][0].astype(jnp.int32)
+    ref = ins["Refs"][0].astype(jnp.int32)
+    if hyp.ndim == 3:
+        hyp = hyp.squeeze(-1)
+    if ref.ndim == 3:
+        ref = ref.squeeze(-1)
+    h_lens = ctx.get_len(ctx.op.inputs["Hyps"][0])
+    r_lens = ctx.get_len(ctx.op.inputs["Refs"][0])
+    B, H = hyp.shape
+    R = ref.shape[1]
+    if h_lens is None:
+        h_lens = jnp.full((B,), H, jnp.int32)
+    if r_lens is None:
+        r_lens = jnp.full((B,), R, jnp.int32)
+
+    def row(carry, inp):
+        prev = carry                                   # [B, R+1]
+        h_tok, i = inp
+        first = jnp.full((B, 1), 0, jnp.int32) + i + 1
+        sub = prev[:, :-1] + (ref != h_tok[:, None]).astype(jnp.int32)
+        # dp scan across the row (sequential in R): use associative min trick
+        # simple loop over R (static, small label lengths)
+        def col(c, j):
+            dele = prev[:, j + 1] + 1
+            ins_ = c + 1
+            best = jnp.minimum(jnp.minimum(dele, ins_), sub[:, j])
+            return best, best
+        _, cols = lax.scan(col, first[:, 0], jnp.arange(R))
+        new = jnp.concatenate([first, cols.T], axis=1)
+        active = (i < h_lens)[:, None]
+        return jnp.where(active, new, prev), None
+
+    init = jnp.broadcast_to(jnp.arange(R + 1, dtype=jnp.int32), (B, R + 1))
+    final, _ = lax.scan(row, init, (hyp.T, jnp.arange(H)))
+    d = jnp.take_along_axis(final, r_lens[:, None], axis=1).astype(jnp.float32)
+    if attrs.get("normalized", True):
+        d = d / jnp.maximum(r_lens, 1)[:, None].astype(jnp.float32)
+    return {"Out": d, "SequenceNum": jnp.asarray([B], jnp.int64)}
+
+
+@register_op("chunk_eval")
+def _chunk_eval(ctx, ins, attrs):
+    """chunk_eval_op: chunk-level precision/recall/F1 for IOB-style tagging.
+    Simplified to the common IOB scheme with chunk start at tag%2==0."""
+    inf = ins["Inference"][0].astype(jnp.int32)
+    label = ins["Label"][0].astype(jnp.int32)
+    if inf.ndim == 3:
+        inf = inf.squeeze(-1)
+    if label.ndim == 3:
+        label = label.squeeze(-1)
+    lens = ctx.get_len(ctx.op.inputs["Label"][0])
+    B, T = label.shape
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    m = _mask(lens, T, jnp.float32)
+    num_chunk_types = attrs.get("num_chunk_types", 1)
+
+    def starts(tags):
+        # IOB2: B-tag = even ids start chunks (scheme-dependent; IOB plain)
+        prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32),
+                                tags[:, :-1]], 1)
+        is_b = (tags % 2 == 0) & (tags < 2 * num_chunk_types)
+        return is_b
+
+    # exact-match chunks: a position contributes a correct chunk when the
+    # full chunk span matches.  Approximate with per-position segment ids.
+    same = (inf == label).astype(jnp.float32) * m
+    lab_chunks = jnp.sum(starts(label).astype(jnp.float32) * m, axis=None)
+    inf_chunks = jnp.sum(starts(inf).astype(jnp.float32) * m, axis=None)
+    correct = jnp.sum(starts(label).astype(jnp.float32) * same, axis=None)
+    prec = correct / jnp.maximum(inf_chunks, 1.0)
+    rec = correct / jnp.maximum(lab_chunks, 1.0)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+    return {"Precision": prec.reshape(1), "Recall": rec.reshape(1),
+            "F1-Score": f1.reshape(1),
+            "NumInferChunks": inf_chunks.astype(jnp.int64).reshape(1),
+            "NumLabelChunks": lab_chunks.astype(jnp.int64).reshape(1),
+            "NumCorrectChunks": correct.astype(jnp.int64).reshape(1)}
+
+
+@register_op("copy_len")
+def _copy_len(ctx, ins, attrs):
+    """Forward the @LEN companion from input to output (framework helper)."""
+    name_in = ctx.op.inputs["X"][0]
+    lens = ctx.get_len(name_in)
+    if lens is not None:
+        ctx.set_len(ctx.op.outputs["Out"][0], lens)
+    return {}

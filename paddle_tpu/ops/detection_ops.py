@@ -1,0 +1,129 @@
+"""Detection op lowerings (reference: roi_pool_op, detection_output_op +
+operators/math/detection_util.h; v1 layers MultiBoxLoss, DetectionOutput,
+PriorBox, ROIPool)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("roi_pool")
+def _roi_pool(ctx, ins, attrs):
+    """roi_pool_op: max-pool each ROI to a fixed [ph, pw] grid.
+
+    X [N,C,H,W]; ROIs [R,5] = (batch_idx, x1, y1, x2, y2) in input scale.
+    Vectorized with vmap over ROIs — one fused gather/reduce program.
+    """
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    ph = attrs["pooled_height"]
+    pw = attrs["pooled_width"]
+    scale = attrs.get("spatial_scale", 1.0)
+    N, C, H, W = x.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale)
+        y1 = jnp.round(roi[2] * scale)
+        x2 = jnp.round(roi[3] * scale)
+        y2 = jnp.round(roi[4] * scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        img = x[b]                                     # [C,H,W]
+        hs = jnp.arange(H, dtype=jnp.float32)
+        ws = jnp.arange(W, dtype=jnp.float32)
+        # bin index of each pixel, -1 if outside roi
+        bin_h = jnp.floor((hs - y1) / (rh / ph))
+        bin_w = jnp.floor((ws - x1) / (rw / pw))
+        valid_h = (hs >= y1) & (hs <= y2)
+        valid_w = (ws >= x1) & (ws <= x2)
+        oh = jnp.clip(bin_h, 0, ph - 1).astype(jnp.int32)
+        ow = jnp.clip(bin_w, 0, pw - 1).astype(jnp.int32)
+        neg = jnp.asarray(-3.4e38, x.dtype)
+        masked = jnp.where(valid_h[None, :, None] & valid_w[None, None, :],
+                           img, neg)
+        out = jnp.full((C, ph, pw), neg, x.dtype)
+        out = out.at[:, oh[:, None], ow[None, :]].max(masked)
+        return jnp.where(out <= neg / 2, 0.0, out)
+
+    out = jax.vmap(one_roi)(rois.astype(jnp.float32))
+    return {"Out": out, "Argmax": jnp.zeros_like(out, dtype=jnp.int64)}
+
+
+@register_op("prior_box")
+def _prior_box(ctx, ins, attrs):
+    """SSD prior boxes for a feature map (v1 PriorBox layer)."""
+    feat, img = ins["Input"][0], ins["Image"][0]
+    min_sizes = attrs["min_sizes"]
+    max_sizes = attrs.get("max_sizes", [])
+    ars = attrs.get("aspect_ratios", [1.0])
+    flip = attrs.get("flip", True)
+    clip = attrs.get("clip", True)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_h = ih / fh
+    step_w = iw / fw
+    full_ars = []
+    for ar in ars:
+        full_ars.append(ar)
+        if flip and ar != 1.0:
+            full_ars.append(1.0 / ar)
+    boxes = []
+    for ms in min_sizes:
+        boxes.append((ms, ms))
+        for mx in max_sizes:
+            s = (ms * mx) ** 0.5
+            boxes.append((s, s))
+        for ar in full_ars:
+            if ar == 1.0:
+                continue
+            boxes.append((ms * ar ** 0.5, ms / ar ** 0.5))
+    cy = (jnp.arange(fh) + 0.5) * step_h
+    cx = (jnp.arange(fw) + 0.5) * step_w
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+    out = []
+    for bw, bh in boxes:
+        out.append(jnp.stack([
+            (cxg - bw / 2) / iw, (cyg - bh / 2) / ih,
+            (cxg + bw / 2) / iw, (cyg + bh / 2) / ih], axis=-1))
+    prior = jnp.stack(out, axis=2).reshape(fh, fw, len(boxes), 4)
+    if clip:
+        prior = jnp.clip(prior, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), prior.shape)
+    return {"Boxes": prior, "Variances": var}
+
+
+@register_op("box_coder")
+def _box_coder(ctx, ins, attrs):
+    """decode_center_size box regression (detection_util.h)."""
+    prior = ins["PriorBox"][0].reshape(-1, 4)
+    pvar = ins["PriorBoxVar"][0].reshape(-1, 4) if "PriorBoxVar" in ins and \
+        ins["PriorBoxVar"] else jnp.ones_like(prior)
+    target = ins["TargetBox"][0]
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = (prior[:, 0] + prior[:, 2]) / 2
+    pcy = (prior[:, 1] + prior[:, 3]) / 2
+    t = target.reshape(-1, 4)
+    cx = pvar[:, 0] * t[:, 0] * pw + pcx
+    cy = pvar[:, 1] * t[:, 1] * ph + pcy
+    w = jnp.exp(pvar[:, 2] * t[:, 2]) * pw
+    h = jnp.exp(pvar[:, 3] * t[:, 3]) * ph
+    out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
+    return {"OutputBox": out.reshape(target.shape)}
+
+
+@register_op("iou_similarity")
+def _iou_similarity(ctx, ins, attrs):
+    a = ins["X"][0].reshape(-1, 4)
+    b = ins["Y"][0].reshape(-1, 4)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return {"Out": inter / jnp.maximum(area_a[:, None] + area_b[None, :]
+                                       - inter, 1e-10)}

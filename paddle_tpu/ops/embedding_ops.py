@@ -1,0 +1,103 @@
+"""Embedding / sparse op lowerings.
+
+Reference: lookup_table_op.cc (dense or SelectedRows gradient), nce_op,
+HierarchicalSigmoidLayer (v1).  On TPU the SelectedRows sparse-gradient
+machinery (selected_rows.h:19) is subsumed by XLA scatter-add gradients of
+gather — and sharded tables ride the mesh via paddle_tpu.parallel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("lookup_table")
+def _lookup_table(ctx, ins, attrs):
+    """W [V, D]; Ids [...,1] or [...] int -> Out [..., D].
+
+    padding_idx rows return zeros (lookup_table_op.cc padding_idx attr).
+    The gather's vjp is a scatter-add — exactly the SelectedRows grad path,
+    derived automatically.
+    """
+    w, ids = ins["W"][0], ins["Ids"][0]
+    ids = ids.astype(jnp.int32)
+    squeeze = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze:
+        ids = ids.squeeze(-1)
+    pad = attrs.get("padding_idx", None)
+    safe = ids
+    if pad is not None and pad >= 0:
+        safe = jnp.where(ids == pad, 0, ids)
+    out = jnp.take(w, safe, axis=0)
+    if pad is not None and pad >= 0:
+        out = jnp.where((ids == pad)[..., None], 0.0, out)
+    return {"Out": out}
+
+
+@register_op("nce")
+def _nce(ctx, ins, attrs):
+    """nce_op: noise-contrastive estimation with uniform negative sampling.
+
+    Inputs: Input [B, D], Label [B, 1] (single true class), Weight [V, D],
+    optional Bias [V].  attrs: num_neg_samples, num_total_classes.
+    Output Cost [B, 1]; SampleLogits/SampleLabels exposed like the reference.
+    """
+    x = ins["Input"][0]
+    label = ins["Label"][0].astype(jnp.int32).reshape(-1)
+    w = ins["Weight"][0]
+    bias = ins["Bias"][0] if "Bias" in ins and ins["Bias"] else None
+    k = attrs.get("num_neg_samples", 10)
+    V = attrs.get("num_total_classes", w.shape[0])
+    B = x.shape[0]
+    neg = jax.random.randint(ctx.rng(), (B, k), 0, V)
+    samples = jnp.concatenate([label[:, None], neg], axis=1)   # [B, 1+k]
+    sw = jnp.take(w, samples, axis=0)                          # [B, 1+k, D]
+    logits = jnp.einsum("bd,bkd->bk", x, sw)
+    if bias is not None:
+        logits = logits + jnp.take(bias.reshape(-1), samples)
+    # P(noise) uniform = 1/V; logit correction log(k * pn)
+    logits = logits - jnp.log(k / V)
+    labels = jnp.concatenate(
+        [jnp.ones((B, 1)), jnp.zeros((B, k))], axis=1)
+    ce = jnp.maximum(logits, 0) - logits * labels + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    cost = jnp.sum(ce, axis=1, keepdims=True)
+    return {"Cost": cost, "SampleLogits": logits,
+            "SampleLabels": samples.astype(jnp.int64)}
+
+
+@register_op("hierarchical_sigmoid", "hsigmoid")
+def _hsigmoid(ctx, ins, attrs):
+    """HierarchicalSigmoidLayer (v1): complete-binary-tree hierarchical
+    softmax over num_classes leaves."""
+    x = ins["X"][0]                       # [B, D]
+    label = ins["Label"][0].astype(jnp.int32).reshape(-1)
+    w = ins["W"][0]                       # [num_classes-1, D] internal nodes
+    bias = ins["Bias"][0] if "Bias" in ins and ins["Bias"] else None
+    num_classes = attrs["num_classes"]
+    depth = max(1, int(jnp.ceil(jnp.log2(num_classes)).item()) if not
+                isinstance(num_classes, int) else (num_classes - 1).bit_length())
+    # path through a complete binary tree: node ids from root, code bits
+    codes = label + num_classes - 1       # leaf index in heap layout... walk up
+    path_nodes = []
+    path_bits = []
+    node = codes
+    for _ in range(depth):
+        bit = node % 2                    # left/right
+        node = (node - 1) // 2
+        path_nodes.append(node)
+        path_bits.append(bit)
+    nodes = jnp.stack(path_nodes, axis=1)      # [B, depth]
+    bits = jnp.stack(path_bits, axis=1).astype(x.dtype)
+    valid = (nodes >= 0) & (nodes < num_classes - 1)
+    nsafe = jnp.clip(nodes, 0, num_classes - 2)
+    wn = jnp.take(w, nsafe, axis=0)            # [B, depth, D]
+    logits = jnp.einsum("bd,bkd->bk", x, wn)
+    if bias is not None:
+        logits = logits + jnp.take(bias.reshape(-1), nsafe)
+    ce = jnp.maximum(logits, 0) - logits * bits + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    cost = jnp.sum(ce * valid.astype(x.dtype), axis=1, keepdims=True)
+    return {"Out": cost, "PreOut": logits}

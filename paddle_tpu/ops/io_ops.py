@@ -1,0 +1,20 @@
+"""I/O op lowerings (save_op.cc:37-80, load_op.cc).
+
+Persistence itself is host-side (paddle_tpu.io reads/writes the Scope with
+numpy), because device->host transfer cannot live inside a jitted program.
+The ops are registered so programs containing them remain loadable; when
+executed they are no-ops and paddle_tpu.io performs the actual serialization.
+"""
+from __future__ import annotations
+
+from ..core.registry import register_op
+
+
+@register_op("save")
+def _save(ctx, ins, attrs):
+    return {}
+
+
+@register_op("load")
+def _load(ctx, ins, attrs):
+    return {}

@@ -1,0 +1,132 @@
+"""Loss op lowerings (SURVEY §2.2 Losses; reference files hinge_loss_op.cc,
+huber_loss_op.cc, log_loss_op.cc, margin_rank_loss_op.cc, rank_loss_op.cc,
+smooth_l1_loss_op.cc, squared_l2_distance_op.cc, squared_l2_norm_op.cc,
+sigmoid_cross_entropy_with_logits_op.cc, modified_huber_loss_op.cc,
+cos_sim_op.cc, bilinear_tensor_product_op.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    logits, labels = ins["Logits"][0], ins["Labels"][0]
+    y = 2.0 * labels.astype(logits.dtype) - 1.0
+    return {"Loss": jnp.maximum(0.0, 1.0 - y * logits)}
+
+
+@register_op("huber_loss")
+def _huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("log_loss")
+def _log_loss(ctx, ins, attrs):
+    p, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1.0 - label) * jnp.log(1.0 - p + eps)
+    return {"Loss": loss}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs):
+    """loss = max(0, -label*(x1-x2) + margin)"""
+    x1, x2, label = ins["X1"][0], ins["X2"][0], ins["Label"][0]
+    m = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + m)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register_op("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    """RankNet pairwise loss (rank_loss_op.cc)."""
+    label, left, right = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": jnp.logaddexp(0.0, d) - label * d}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    if "InsideWeight" in ins and ins["InsideWeight"]:
+        d = d * ins["InsideWeight"][0]
+    a = jnp.abs(d)
+    l = jnp.where(a < 1.0 / s2, 0.5 * s2 * d * d, a - 0.5 / s2)
+    if "OutsideWeight" in ins and ins["OutsideWeight"]:
+        l = l * ins["OutsideWeight"][0]
+    out = jnp.sum(l.reshape(l.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": out, "Diff": d}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    d = x - y
+    out = jnp.sum(jnp.square(d).reshape(d.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": out, "sub_result": d}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    return {"Out": jnp.sum(jnp.square(ins["X"][0])).reshape(1)}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    # max(x,0) - x*z + log(1+exp(-|x|)) — stable form
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": loss}
+
+
+@register_op("modified_huber_loss")
+def _modified_huber(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    z = (2.0 * y - 1.0) * x
+    loss = jnp.where(z >= 1.0, jnp.zeros_like(z),
+                     jnp.where(z >= -1.0, jnp.square(1.0 - z), -4.0 * z))
+    return {"Out": loss, "IntermediateVal": z}
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """out[:, k] = x @ W[k] @ y^T diag  (+ bias) — attention scoring block."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    # w: [K, dx, dy]; x: [N, dx]; y: [N, dy]
+    out = jnp.einsum("nd,kde,ne->nk", x, w, y)
+    if "Bias" in ins and ins["Bias"]:
+        out = out + ins["Bias"][0]
+    return {"Out": out}
+
+
+@register_op("mse_loss")
+def _mse_loss(ctx, ins, attrs):
+    d = ins["X"][0] - ins["Y"][0]
+    return {"Out": jnp.square(d)}
+
+
+@register_op("kldiv_loss")
+def _kldiv_loss(ctx, ins, attrs):
+    x, target = ins["X"][0], ins["Target"][0]
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    return {"Loss": loss}

@@ -1,0 +1,232 @@
+"""Math / elementwise / reduction / comparison op lowerings.
+
+Covers the reference's math category (SURVEY §2.2: elementwise_op.h, mul_op,
+matmul_op.cc, sum_op, scale_op, cast_op, clip_op, clip_by_norm_op, sign_op,
+logical_op, compare_op, reduce_op.cc) as jnp/lax lowerings.  Gradients come
+from jax.vjp — no *_grad ops exist.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from ..core.types import convert_dtype
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary with fluid broadcast semantics
+# (reference: elementwise_op.h trailing-axis broadcast: Y's shape must match a
+# contiguous run of X's dims starting at `axis`)
+# ---------------------------------------------------------------------------
+def _bcast(x, y, axis: int):
+    if x.shape == y.shape or axis in (-1, None):
+        return x, y
+    if y.ndim > x.ndim:
+        raise ValueError(f"elementwise: y rank {y.ndim} > x rank {x.ndim}")
+    trailing = x.ndim - axis - y.ndim
+    if trailing < 0:
+        raise ValueError(f"elementwise: bad axis {axis} for shapes "
+                         f"{x.shape} {y.shape}")
+    y = y.reshape(y.shape + (1,) * trailing)
+    return x, y
+
+
+def _elementwise(fn):
+    def impl(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        x, y = _bcast(x, y, attrs.get("axis", -1))
+        return {"Out": fn(x, y)}
+    return impl
+
+
+register_op("elementwise_add")(_elementwise(jnp.add))
+register_op("elementwise_sub")(_elementwise(jnp.subtract))
+register_op("elementwise_mul")(_elementwise(jnp.multiply))
+register_op("elementwise_div")(_elementwise(jnp.divide))
+register_op("elementwise_pow")(_elementwise(jnp.power))
+register_op("elementwise_max")(_elementwise(jnp.maximum))
+register_op("elementwise_min")(_elementwise(jnp.minimum))
+register_op("elementwise_mod")(_elementwise(jnp.mod))
+
+
+@register_op("mul")
+def _mul(ctx, ins, attrs):
+    """fluid mul_op (mul_op.cc): flatten x/y to 2-D then matmul — the FC
+    primitive.  Kept batched + bf16-friendly so it lands on the MXU."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((_prod(xs[:xn]), _prod(xs[xn:])))
+    y2 = y.reshape((_prod(ys[:yn]), _prod(ys[yn:])))
+    out = jnp.matmul(x2, y2)
+    return {"Out": out.reshape(xs[:xn] + ys[yn:])}
+
+
+def _prod(t):
+    p = 1
+    for v in t:
+        p *= int(v)
+    return p
+
+
+@register_op("matmul")
+def _matmul(ctx, ins, attrs):
+    """matmul_op.cc semantics: optional transposes, batched stacks."""
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs):
+    """sum_op: add N tensors (used to merge multi-consumer grads)."""
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("scale")
+def _scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": x * s + b}
+    return {"Out": (x + b) * s}
+
+
+@register_op("minus")
+def _minus(ctx, ins, attrs):
+    return {"Out": ins["X"][0] - ins["Y"][0]}
+
+
+@register_op("cast")
+def _cast(ctx, ins, attrs):
+    dt = convert_dtype(attrs.get("out_dtype", attrs.get("dtype", "float32")))
+    return {"Out": ins["X"][0].astype(dt)}
+
+
+@register_op("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": jnp.clip(ins["X"][0], attrs["min"], attrs["max"])}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": x * scale.astype(x.dtype)}
+
+
+@register_op("sign")
+def _sign(ctx, ins, attrs):
+    return {"Out": jnp.sign(ins["X"][0])}
+
+
+@register_op("pow")
+def _pow(ctx, ins, attrs):
+    return {"Out": jnp.power(ins["X"][0], attrs.get("factor", 1.0))}
+
+
+# -- logical / comparison ----------------------------------------------------
+def _logical(fn, unary=False):
+    def impl(ctx, ins, attrs):
+        if unary:
+            return {"Out": fn(ins["X"][0].astype(bool))}
+        return {"Out": fn(ins["X"][0].astype(bool), ins["Y"][0].astype(bool))}
+    return impl
+
+
+register_op("logical_and")(_logical(jnp.logical_and))
+register_op("logical_or")(_logical(jnp.logical_or))
+register_op("logical_xor")(_logical(jnp.logical_xor))
+register_op("logical_not")(_logical(jnp.logical_not, unary=True))
+
+
+def _compare(fn):
+    def impl(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        x, y = _bcast(x, y, attrs.get("axis", -1))
+        return {"Out": fn(x, y)}
+    return impl
+
+
+register_op("equal")(_compare(jnp.equal))
+register_op("not_equal")(_compare(jnp.not_equal))
+register_op("less_than")(_compare(jnp.less))
+register_op("less_equal")(_compare(jnp.less_equal))
+register_op("greater_than")(_compare(jnp.greater))
+register_op("greater_equal")(_compare(jnp.greater_equal))
+
+
+# -- reductions (reduce_op.cc: dim/keep_dim/reduce_all attrs) ---------------
+def _reduce(fn):
+    def impl(ctx, ins, attrs):
+        x = ins["X"][0]
+        if attrs.get("reduce_all", False):
+            axis = None
+        else:
+            dim = attrs.get("dim", [0])
+            axis = tuple(dim) if isinstance(dim, (list, tuple)) else (int(dim),)
+            axis = tuple(d % x.ndim for d in axis)
+        keep = attrs.get("keep_dim", False)
+        return {"Out": fn(x, axis=axis, keepdims=keep)}
+    return impl
+
+
+register_op("reduce_sum")(_reduce(jnp.sum))
+register_op("reduce_mean")(_reduce(jnp.mean))
+register_op("reduce_max")(_reduce(jnp.max))
+register_op("reduce_min")(_reduce(jnp.min))
+register_op("reduce_prod")(_reduce(jnp.prod))
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs):
+    """mean_op: full reduction to scalar (loss averaging)."""
+    return {"Out": jnp.mean(ins["X"][0])}
+
+
+@register_op("increment")
+def _increment(ctx, ins, attrs):
+    return {"Out": ins["X"][0] + jnp.asarray(attrs.get("step", 1.0),
+                                             ins["X"][0].dtype)}
+
+
+@register_op("abs_diff", "squared_difference")
+def _sq_diff(ctx, ins, attrs):
+    d = ins["X"][0] - ins["Y"][0]
+    return {"Out": d * d}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, ins, attrs):
+    return {"Out": jnp.cumsum(ins["X"][0], axis=attrs.get("axis", -1))}
+
+
+@register_op("isfinite")
+def _isfinite(ctx, ins, attrs):
+    return {"Out": jnp.all(jnp.isfinite(ins["X"][0]))}
+
+
+@register_op("l2_normalize", "norm")
+def _l2_normalize(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return {"Out": x / jnp.maximum(norm, eps)}

@@ -1,0 +1,99 @@
+"""Metric op lowerings (reference: accuracy_op.cc, auc_op.cc,
+precision_recall_op.cc, positive_negative_pair_op.cc; v1 evaluators in
+gserver/evaluators/).  Stateful accumulation lives in persistable vars
+managed by paddle_tpu.evaluator, mirroring fluid evaluator.py:21-90."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("accuracy")
+def _accuracy(ctx, ins, attrs):
+    """accuracy_op: Indices are top-k predicted ids [N,k], Label [N,1]."""
+    idx, label = ins["Indices"][0], ins["Label"][0]
+    label = label.astype(idx.dtype).reshape(-1, 1)
+    correct = jnp.any(idx == label, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = jnp.asarray(idx.shape[0], jnp.float32)
+    return {"Accuracy": (num_correct / total).reshape(1),
+            "Correct": num_correct.astype(jnp.int32).reshape(1),
+            "Total": jnp.asarray([idx.shape[0]], jnp.int32)}
+
+
+@register_op("auc")
+def _auc(ctx, ins, attrs):
+    """auc_op: streaming AUC over threshold buckets.  Inputs Predict [N,2]
+    (binary probs) or [N,1], Label [N,1]; optional stat inputs accumulate."""
+    pred = ins["Predict"][0]
+    label = ins["Label"][0].reshape(-1)
+    num_thresh = attrs.get("num_thresholds", 200)
+    if pred.ndim == 2 and pred.shape[1] == 2:
+        pos_prob = pred[:, 1]
+    else:
+        pos_prob = pred.reshape(-1)
+    bucket = jnp.clip((pos_prob * num_thresh).astype(jnp.int32), 0, num_thresh)
+    pos = (label > 0).astype(jnp.float32)
+    neg = 1.0 - pos
+    tp_hist = jnp.zeros(num_thresh + 1).at[bucket].add(pos)
+    fp_hist = jnp.zeros(num_thresh + 1).at[bucket].add(neg)
+    if "StatPos" in ins and ins["StatPos"]:
+        tp_hist = tp_hist + ins["StatPos"][0]
+        fp_hist = fp_hist + ins["StatNeg"][0]
+    # TP/FP above each threshold = suffix sums
+    tp = jnp.cumsum(tp_hist[::-1])[::-1]
+    fp = jnp.cumsum(fp_hist[::-1])[::-1]
+    tot_pos, tot_neg = tp[0], fp[0]
+    tpr = tp / jnp.maximum(tot_pos, 1.0)
+    fpr = fp / jnp.maximum(tot_neg, 1.0)
+    auc = -jnp.trapezoid(tpr, fpr)
+    return {"AUC": auc.reshape(1), "StatPosOut": tp_hist, "StatNegOut": fp_hist}
+
+
+@register_op("precision_recall")
+def _precision_recall(ctx, ins, attrs):
+    """precision_recall_op: per-class macro/micro P/R/F1 from MaxProbs idx."""
+    idx = ins["Indices"][0].reshape(-1)
+    label = ins["Labels"][0].reshape(-1).astype(idx.dtype)
+    ncls = attrs["class_number"]
+    onehot_pred = jnp.zeros(ncls).at[idx].add(1.0)
+    onehot_lab = jnp.zeros(ncls).at[label].add(1.0)
+    tp = jnp.zeros(ncls).at[idx].add((idx == label).astype(jnp.float32))
+    states = jnp.stack([tp, onehot_pred - tp, onehot_lab - tp], axis=1)
+    if "StatesInfo" in ins and ins["StatesInfo"]:
+        states = states + ins["StatesInfo"][0]
+    tp_, fp_, fn_ = states[:, 0], states[:, 1], states[:, 2]
+    prec = tp_ / jnp.maximum(tp_ + fp_, 1.0)
+    rec = tp_ / jnp.maximum(tp_ + fn_, 1.0)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+    macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+    tps, fps, fns = tp_.sum(), fp_.sum(), fn_.sum()
+    mp = tps / jnp.maximum(tps + fps, 1.0)
+    mr = tps / jnp.maximum(tps + fns, 1.0)
+    mf = 2 * mp * mr / jnp.maximum(mp + mr, 1e-6)
+    metrics = jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+    return {"BatchMetrics": metrics, "AccumMetrics": metrics,
+            "AccumStatesInfo": states}
+
+
+@register_op("positive_negative_pair")
+def _pnpair(ctx, ins, attrs):
+    """positive_negative_pair_op: rank-order statistics within query groups."""
+    score = ins["Score"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    qid = ins["QueryID"][0].reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    lab_gt = label[:, None] > label[None, :]
+    score_gt = score[:, None] > score[None, :]
+    score_eq = score[:, None] == score[None, :]
+    valid = same_q & lab_gt
+    pos = jnp.sum((valid & score_gt).astype(jnp.float32))
+    neu = jnp.sum((valid & score_eq).astype(jnp.float32))
+    neg = jnp.sum(valid.astype(jnp.float32)) - pos - neu
+    if "AccumulatePositivePair" in ins and ins["AccumulatePositivePair"]:
+        pos = pos + ins["AccumulatePositivePair"][0].reshape(())
+        neg = neg + ins["AccumulateNegativePair"][0].reshape(())
+        neu = neu + ins["AccumulateNeutralPair"][0].reshape(())
+    return {"PositivePair": pos.reshape(1), "NegativePair": neg.reshape(1),
+            "NeutralPair": neu.reshape(1)}

@@ -1,0 +1,344 @@
+"""Neural-network op lowerings: conv, pool, normalization, softmax, dropout.
+
+Reference category (SURVEY §2.2 NN): conv_op/conv_cudnn_op, conv_transpose,
+pool_op/pool_cudnn, pool_with_index, batch_norm_op, softmax,
+softmax_with_cross_entropy, cross_entropy, dropout, lrn, maxout, prelu (in
+activation_ops).  cuDNN paths collapse into XLA convolutions, which tile onto
+the MXU; data layout is NCHW for API parity (XLA's layout assignment
+re-tiles internally, so no NHWC rewrite is forced on users).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+@register_op("conv2d", "depthwise_conv2d")
+def _conv2d(ctx, ins, attrs):
+    """conv_op.cc / conv_cudnn_op: Input [N,C,H,W], Filter [M,C/g,kh,kw]."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1) or 1)
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": out}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    """conv_transpose_op: Filter layout [C_in, C_out/g, kh, kw] ('IOHW')."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    kh, kw = w.shape[2], w.shape[3]
+    # transposed conv == lhs-dilated conv with flipped, transposed kernel
+    out = lax.conv_general_dilated(
+        x, jnp.flip(w, (2, 3)).swapaxes(0, 1),
+        window_strides=(1, 1),
+        padding=[(dil[0] * (kh - 1) - pads[0], dil[0] * (kh - 1) - pads[0]),
+                 (dil[1] * (kw - 1) - pads[1], dil[1] * (kw - 1) - pads[1])],
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return {"Output": out}
+
+
+@register_op("conv3d")
+def _conv3d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    pads = tuple(attrs.get("paddings", [0, 0, 0]))
+    dil = tuple(attrs.get("dilations", [1, 1, 1]))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads], rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=int(attrs.get("groups", 1) or 1),
+    )
+    return {"Output": out}
+
+
+def _pool2d_core(x, ptype, ksize, strides, pads, global_pooling, exclusive,
+                 adaptive=False):
+    if global_pooling or adaptive and tuple(ksize) == (1, 1):
+        axis = (2, 3)
+        if ptype == "max":
+            return jnp.max(x, axis=axis, keepdims=True)
+        return jnp.mean(x, axis=axis, keepdims=True)
+    ksize = _pair(ksize)
+    strides = _pair(strides)
+    pads = _pair(pads)
+    window = (1, 1) + ksize
+    ws = (1, 1) + strides
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+                                 window, ws, padding)
+    s = lax.reduce_window(x, jnp.asarray(0.0, x.dtype), lax.add,
+                          window, ws, padding)
+    if exclusive and (pads[0] or pads[1]):
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, jnp.asarray(0.0, x.dtype), lax.add,
+                                window, ws, padding)
+        return s / cnt
+    return s / (ksize[0] * ksize[1])
+
+
+@register_op("pool2d")
+def _pool2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = _pool2d_core(
+        x, attrs.get("pooling_type", "max"), attrs.get("ksize", [2, 2]),
+        attrs.get("strides", [1, 1]), attrs.get("paddings", [0, 0]),
+        attrs.get("global_pooling", False), attrs.get("exclusive", True))
+    return {"Out": out}
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """pool_with_index_op: returns flat H*W indices of maxima (for unpool).
+    Patch extraction keeps this one fused XLA computation."""
+    x = ins["X"][0]
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", ksize))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, ksize, strides,
+        [(pads[0], pads[0]), (pads[1], pads[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c, ksize[0] * ksize[1], oh, ow)
+    arg = jnp.argmax(patches, axis=2)
+    out = jnp.max(patches, axis=2)
+    # convert patch-local index to flat input H*W index
+    ph, pw = arg // ksize[1], arg % ksize[1]
+    base_h = (jnp.arange(oh) * strides[0] - pads[0])[None, None, :, None]
+    base_w = (jnp.arange(ow) * strides[1] - pads[1])[None, None, None, :]
+    idx = (base_h + ph) * w + (base_w + pw)
+    return {"Out": out, "Mask": idx.astype(jnp.int64)}
+
+
+@register_op("unpool")
+def _unpool(ctx, ins, attrs):
+    """unpool_op: scatter values back to positions given by Indices."""
+    x, idx = ins["X"][0], ins["Indices"][0]
+    n, c, oh, ow = x.shape
+    uh, uw = attrs["unpool_size"] if "unpool_size" in attrs else (
+        attrs["ksize"][0] * oh, attrs["ksize"][1] * ow)
+    flat = jnp.zeros((n, c, uh * uw), x.dtype)
+    out = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1).astype(jnp.int32),
+    ].set(x.reshape(n, c, -1))
+    return {"Out": out.reshape(n, c, uh, uw)}
+
+
+@register_op("batch_norm")
+def _batch_norm(ctx, ins, attrs):
+    """batch_norm_op.cc: NCHW (or NC) input; train updates running stats.
+
+    Outputs mirror the reference (Y, MeanOut, VarianceOut, SavedMean,
+    SavedVariance) so optimizer/IO code can treat stats as persistables.
+    """
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        use_mean_sg = lax.stop_gradient(use_mean)
+        use_var_sg = lax.stop_gradient(use_var)
+        mean_out = momentum * mean + (1.0 - momentum) * use_mean_sg
+        var_out = momentum * var + (1.0 - momentum) * use_var_sg
+    inv = lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) \
+        * scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": use_mean, "SavedVariance": inv}
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    if "Scale" in ins and ins["Scale"]:
+        shape = (1,) * begin + x.shape[begin:]
+        y = y * ins["Scale"][0].reshape(shape)
+    if "Bias" in ins and ins["Bias"]:
+        shape = (1,) * begin + x.shape[begin:]
+        y = y + ins["Bias"][0].reshape(shape)
+    return {"Y": y, "Mean": mean.reshape(x.shape[:begin]),
+            "Variance": var.reshape(x.shape[:begin])}
+
+
+@register_op("softmax")
+def _softmax(ctx, ins, attrs):
+    return {"Out": jax.nn.softmax(ins["X"][0], axis=attrs.get("axis", -1))}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": jax.nn.log_softmax(ins["X"][0], axis=attrs.get("axis", -1))}
+
+
+@register_op("cross_entropy")
+def _cross_entropy(ctx, ins, attrs):
+    """cross_entropy_op: X is probabilities [N, D]; hard or soft labels.
+    Out is [N, 1] like the reference."""
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-8
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, eps)), axis=-1,
+                        keepdims=True)
+    else:
+        lab = label.astype(jnp.int32)
+        if lab.ndim == x.ndim:
+            lab = lab.squeeze(-1)
+        picked = jnp.take_along_axis(x, lab[..., None], axis=-1)
+        loss = -jnp.log(jnp.maximum(picked, eps))
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_ce(ctx, ins, attrs):
+    """Fused, numerically-stable logits->loss (softmax_with_cross_entropy_op)."""
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lab = label.astype(jnp.int32)
+        if lab.ndim == logits.ndim:
+            lab = lab.squeeze(-1)
+        loss = -jnp.take_along_axis(logp, lab[..., None], axis=-1)
+    return {"Softmax": jnp.exp(logp), "Loss": loss}
+
+
+@register_op("dropout")
+def _dropout(ctx, ins, attrs):
+    """dropout_op: reference semantics — train: x*mask; test: x*(1-p).
+    'upscale_in_train' implementation also supported."""
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    upscale = attrs.get("dropout_implementation", "downgrade_in_infer") \
+        == "upscale_in_train"
+    if attrs.get("is_test", False) or ctx.is_test:
+        out = x if upscale else x * (1.0 - p)
+        return {"Out": out, "Mask": jnp.ones_like(x)}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    out = x * mask
+    if upscale:
+        out = out / (1.0 - p)
+    return {"Out": out, "Mask": mask}
+
+
+@register_op("lrn")
+def _lrn(ctx, ins, attrs):
+    """lrn_op: cross-channel local response normalization (AlexNet)."""
+    x = ins["X"][0]
+    n = attrs.get("n", 5)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    k = attrs.get("k", 2.0)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+
+
+@register_op("maxout")
+def _maxout(ctx, ins, attrs):
+    """maxout_op: max over groups of channels."""
+    x = ins["X"][0]
+    g = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": jnp.max(x.reshape(n, c // g, g, h, w), axis=2)}
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    """v1 BilinearInterpLayer / interpolate: resize H,W bilinearly."""
+    x = ins["X"][0]
+    oh = attrs["out_h"]
+    ow = attrs["out_w"]
+    n, c = x.shape[0], x.shape[1]
+    out = jax.image.resize(x, (n, c, oh, ow), method="bilinear")
+    return {"Out": out}
+
+
+@register_op("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, pads, constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op("spp")
+def _spp(ctx, ins, attrs):
+    """spp_op: spatial pyramid pooling — concat of pyramid_height levels."""
+    x = ins["X"][0]
+    levels = attrs.get("pyramid_height", 3)
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        kh, kw = -(-h // bins), -(-w // bins)
+        sh, sw = kh, kw
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        o = _pool2d_core(x, ptype, (kh, kw), (sh, sw), (ph, pw), False, False)
+        outs.append(o.reshape(n, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+@register_op("im2sequence", "block_expand")
+def _im2sequence(ctx, ins, attrs):
+    """block_expand (v1 BlockExpandLayer): image patches -> sequence."""
+    x = ins["X"][0]
+    kh, kw = _pair(attrs.get("kernels", attrs.get("block", [1, 1])))
+    sh, sw = _pair(attrs.get("strides", [1, 1]))
+    ph, pw = _pair(attrs.get("paddings", [0, 0]))
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ckk, oh, ow = patches.shape
+    out = patches.reshape(n, ckk, oh * ow).transpose(0, 2, 1)
+    return {"Out": out}

@@ -1,0 +1,430 @@
+"""Sequence op lowerings over the padded+lengths representation.
+
+The reference stores variable-length sequences padding-free via LoD offsets
+(lod_tensor.h:34-83; v1 Argument.sequenceStartPositions, Argument.h:84-90) and
+reorders into time-major shrinking batches (SequenceToBatch.cpp,
+lod_rank_table_op.cc).  XLA needs static shapes, so the TPU-native design is:
+
+    value:  [B, T_max, ...] padded dense tensor
+    length: [B] int32 companion (var ``name@LEN`` threaded by the executor)
+
+Every sequence op masks by length.  This trades padding FLOPs for MXU-sized
+static matmuls — the standard TPU bargain — and buckets in the data feeder
+keep T_max tight (see paddle_tpu.reader).
+
+Fused RNNs (``lstm``/``gru``, reference lstm_op.cc + math/lstm_compute,
+gru_op) are lax.scan loops whose per-step math is batched matmul — XLA fuses
+the gate nonlinearities; the recurrent matmul rides the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _mask(lens, T, dtype=jnp.float32):
+    """[B,T] validity mask from lengths."""
+    return (jnp.arange(T)[None, :] < lens[:, None]).astype(dtype)
+
+
+def _in_lens(ctx, slot="X", idx=0):
+    name = ctx.op.inputs[slot][idx]
+    lens = ctx.get_len(name)
+    return lens
+
+
+def _seq_lens_or_full(ctx, x, slot="X"):
+    lens = _in_lens(ctx, slot)
+    if lens is None:
+        lens = jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32)
+    return lens
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ctx, ins, attrs):
+    """sequence_pool_op: AVERAGE/SUM/SQRT/MAX/LAST/FIRST over time."""
+    x = ins["X"][0]                      # [B, T, ...]
+    lens = _seq_lens_or_full(ctx, x)
+    ptype = attrs.get("pooltype", attrs.get("pool_type", "AVERAGE")).upper()
+    T = x.shape[1]
+    m = _mask(lens, T, x.dtype).reshape((x.shape[0], T) + (1,) * (x.ndim - 2))
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(
+            lens.astype(x.dtype), 1).reshape((-1,) + (1,) * (x.ndim - 2))
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(jnp.maximum(
+            lens.astype(x.dtype), 1)).reshape((-1,) + (1,) * (x.ndim - 2))
+    elif ptype == "MAX":
+        neg = jnp.asarray(-3.4e38, x.dtype)
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lens - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)).astype(jnp.int32)
+            .repeat(1, axis=1), axis=1).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    return {"Out": out}
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ctx, ins, attrs):
+    """softmax over the time dim, masked to each sequence's length."""
+    x = ins["X"][0]                      # [B, T] or [B, T, 1]
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    v = x.squeeze(-1) if squeeze else x
+    lens = _seq_lens_or_full(ctx, v)
+    m = _mask(lens, v.shape[1], jnp.bool_)
+    z = jnp.where(m, v, -3.4e38)
+    out = jax.nn.softmax(z, axis=1)
+    out = out * m.astype(out.dtype)
+    if squeeze:
+        out = out[..., None]
+    ctx.set_len(ctx.op.outputs["Out"][0], lens)
+    return {"Out": out}
+
+
+@register_op("sequence_expand", "sequence_expand_as")
+def _sequence_expand(ctx, ins, attrs):
+    """sequence_expand_op: broadcast one row per sequence along Y's time."""
+    x, y = ins["X"][0], ins["Y"][0]
+    lens = _seq_lens_or_full(ctx, y, slot="Y")
+    T = y.shape[1]
+    if x.ndim == y.ndim:                  # already time-major: tile-nothing
+        out = x
+    else:                                 # [B, D] -> [B, T, D]
+        out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+    m = _mask(lens, T, out.dtype).reshape(
+        (out.shape[0], T) + (1,) * (out.ndim - 2))
+    out = out * m
+    ctx.set_len(ctx.op.outputs["Out"][0], lens)
+    return {"Out": out}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    """sequence_concat_op axis=0: concatenate per-sequence along time.
+
+    out[i] = concat(x0[i, :l0_i], x1[i, :l1_i], ...) then re-padded.
+    Built with a gather from the stacked inputs — one fused XLA gather.
+    """
+    xs = ins["X"]
+    B = xs[0].shape[0]
+    lens_list = []
+    for i, nm in enumerate(ctx.op.inputs["X"]):
+        l = ctx.get_len(nm)
+        if l is None:
+            l = jnp.full((B,), xs[i].shape[1], jnp.int32)
+        lens_list.append(l)
+    total = sum(lens_list)
+    T_out = sum(x.shape[1] for x in xs)
+    # For output position t of row b, find which source and source offset.
+    starts = jnp.cumsum(jnp.stack([jnp.zeros_like(lens_list[0])] +
+                                  lens_list[:-1]), axis=0)  # [K, B]
+    tpos = jnp.arange(T_out)[None, :]                        # [1, T_out]
+    src = jnp.zeros((B, T_out), jnp.int32)
+    off = tpos.repeat(B, 0)
+    for k in range(len(xs)):
+        sel = tpos >= starts[k][:, None]
+        src = jnp.where(sel, k, src)
+        off = jnp.where(sel, tpos - starts[k][:, None], off)
+    padded = jnp.stack([jnp.pad(x, [(0, 0), (0, T_out - x.shape[1])] +
+                                [(0, 0)] * (x.ndim - 2)) for x in xs])  # [K,B,T_out,...]
+    b_idx = jnp.arange(B)[:, None]
+    out = padded[src, b_idx, jnp.clip(off, 0, T_out - 1)]
+    m = _mask(total, T_out, out.dtype).reshape(
+        (B, T_out) + (1,) * (out.ndim - 2))
+    out = out * m
+    ctx.set_len(ctx.op.outputs["Out"][0], total)
+    return {"Out": out}
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    """sequence_conv_op: context-window projection along time
+    (v1 ContextProjection, function/ContextProjection*).  Filter shape
+    [ctx_len * D, M]."""
+    x, w = ins["X"][0], ins["Filter"][0]
+    lens = _seq_lens_or_full(ctx, x)
+    stride = attrs.get("contextStride", 1)
+    assert stride == 1, "sequence_conv supports stride 1 (as the reference)"
+    ctx_len = attrs.get("contextLength", 3)
+    start = attrs.get("contextStart", -(ctx_len // 2))
+    B, T, D = x.shape
+    m = _mask(lens, T, x.dtype)[..., None]
+    xm = x * m
+    cols = []
+    for j in range(ctx_len):
+        shift = start + j
+        rolled = jnp.roll(xm, -shift, axis=1)
+        # zero positions that rolled around
+        t = jnp.arange(T)
+        valid = (t + shift >= 0) & (t + shift < T)
+        cols.append(rolled * valid[None, :, None].astype(x.dtype))
+    ctxmat = jnp.concatenate(cols, axis=-1)          # [B, T, ctx_len*D]
+    out = jnp.einsum("btd,dm->btm", ctxmat, w)
+    out = out * m
+    ctx.set_len(ctx.op.outputs["Out"][0], lens)
+    return {"Out": out}
+
+
+@register_op("sequence_slice")
+def _sequence_slice(ctx, ins, attrs):
+    """sequence_slice_op: per-sequence [offset, offset+length) gather."""
+    x = ins["X"][0]
+    offset = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    B, T = x.shape[0], x.shape[1]
+    tpos = jnp.arange(T)[None, :]
+    idx = jnp.clip(offset[:, None] + tpos, 0, T - 1)
+    out = jnp.take_along_axis(
+        x, idx.reshape((B, T) + (1,) * (x.ndim - 2)), axis=1)
+    m = _mask(length, T, x.dtype).reshape((B, T) + (1,) * (x.ndim - 2))
+    out = out * m
+    ctx.set_len(ctx.op.outputs["Out"][0], length)
+    return {"Out": out}
+
+
+@register_op("sequence_reverse")
+def _sequence_reverse(ctx, ins, attrs):
+    x = ins["X"][0]
+    lens = _seq_lens_or_full(ctx, x)
+    B, T = x.shape[0], x.shape[1]
+    tpos = jnp.arange(T)[None, :]
+    idx = jnp.where(tpos < lens[:, None], lens[:, None] - 1 - tpos, tpos)
+    out = jnp.take_along_axis(
+        x, idx.reshape((B, T) + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1)
+    ctx.set_len(ctx.op.outputs["Y" if "Y" in ctx.op.outputs else "Out"][0], lens)
+    return {("Y" if "Y" in ctx.op.outputs else "Out"): out}
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    """sequence_reshape_op: change feature dim, scaling lengths."""
+    x = ins["X"][0]
+    new_dim = attrs["new_dim"]
+    B, T, D = x.shape
+    factor = D // new_dim if D >= new_dim else 1
+    lens = _seq_lens_or_full(ctx, x)
+    if D >= new_dim:
+        out = x.reshape(B, T * factor, new_dim)
+        new_lens = lens * factor
+    else:
+        factor = new_dim // D
+        out = x.reshape(B, T // factor, new_dim)
+        new_lens = lens // factor
+    ctx.set_len(ctx.op.outputs["Out"][0], new_lens)
+    return {"Out": out}
+
+
+@register_op("sequence_pad")
+def _sequence_pad(ctx, ins, attrs):
+    """Identity in the padded representation (kept for API parity)."""
+    x = ins["X"][0]
+    lens = _seq_lens_or_full(ctx, x)
+    return {"Out": x, "Length": lens}
+
+
+@register_op("sequence_unpad")
+def _sequence_unpad(ctx, ins, attrs):
+    x = ins["X"][0]
+    lens = ins["Length"][0] if "Length" in ins and ins["Length"] else \
+        _seq_lens_or_full(ctx, x)
+    ctx.set_len(ctx.op.outputs["Out"][0], lens.reshape(-1))
+    return {"Out": x}
+
+
+@register_op("lod_reset")
+def _lod_reset(ctx, ins, attrs):
+    x = ins["X"][0]
+    if "Y" in ins and ins["Y"]:
+        lens = ins["Y"][0].reshape(-1).astype(jnp.int32)
+    else:
+        target = attrs.get("target_lod", [])
+        offs = jnp.asarray(target, jnp.int32)
+        lens = offs[1:] - offs[:-1]
+    ctx.set_len(ctx.op.outputs["Out"][0], lens)
+    return {"Out": x}
+
+
+@register_op("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """row_conv_op: lookahead convolution (DeepSpeech2-style)."""
+    x, w = ins["X"][0], ins["Filter"][0]   # x [B,T,D], w [future_ctx, D]
+    lens = _seq_lens_or_full(ctx, x)
+    T = x.shape[1]
+    m = _mask(lens, T, x.dtype)[..., None]
+    xm = x * m
+    ctx_len = w.shape[0]
+    out = jnp.zeros_like(x)
+    for j in range(ctx_len):
+        rolled = jnp.roll(xm, -j, axis=1)
+        t = jnp.arange(T)
+        valid = (t + j < T)[None, :, None].astype(x.dtype)
+        out = out + rolled * valid * w[j][None, None, :]
+    out = out * m
+    ctx.set_len(ctx.op.outputs["Out"][0], lens)
+    return {"Out": out}
+
+
+@register_op("max_sequence_len")
+def _max_sequence_len(ctx, ins, attrs):
+    lens = _in_lens(ctx, "RankTable") if "RankTable" in ctx.op.inputs else \
+        _in_lens(ctx, "X")
+    if lens is None:
+        x = next(iter(ins.values()))[0]
+        return {"Out": jnp.asarray(x.shape[1], jnp.int64)}
+    return {"Out": jnp.max(lens).astype(jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# Fused recurrent ops (reference lstm_op.cc + math/lstm_compute;
+# gru_op.cc + math/gru_compute; *_unit ops)
+# Gate order: i, f, c(candidate), o for LSTM; u(update), r(reset), c for GRU.
+# ---------------------------------------------------------------------------
+_ACT = {
+    "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+@register_op("lstm")
+def _lstm(ctx, ins, attrs):
+    """dynamic LSTM over [B,T,4H] pre-projected input; recurrent Weight
+    [H,4H]; Bias [1,4H] (+[1,3H] peephole tail when use_peepholes)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins["Bias"][0].reshape(-1) if "Bias" in ins and ins["Bias"] else None
+    lens = _seq_lens_or_full(ctx, x, slot="Input")
+    B, T, H4 = x.shape
+    H = H4 // 4
+    use_peep = attrs.get("use_peepholes", False)
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+    b_gate = None
+    wi = wf = wo = None
+    if bias is not None:
+        b_gate = bias[:4 * H]
+        if use_peep:
+            peep = bias[4 * H:7 * H]
+            wi, wf, wo = peep[:H], peep[H:2 * H], peep[2 * H:]
+    h0 = ins["H0"][0] if "H0" in ins and ins["H0"] else jnp.zeros((B, H), x.dtype)
+    c0 = ins["C0"][0] if "C0" in ins and ins["C0"] else jnp.zeros((B, H), x.dtype)
+    xt_seq = jnp.swapaxes(x, 0, 1)              # [T, B, 4H]
+    step_mask = _mask(lens, T, x.dtype).T       # [T, B]
+
+    def step(carry, inp):
+        h, c = carry
+        xt, mt = inp
+        gates = xt + h @ w
+        if b_gate is not None:
+            gates = gates + b_gate
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if use_peep:
+            gi = gi + c * wi
+            gf = gf + c * wf
+        i = gate_act(gi)
+        f = gate_act(gf)
+        cand = cand_act(gc)
+        c_new = f * c + i * cand
+        if use_peep:
+            go = go + c_new * wo
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        mt = mt[:, None]
+        h_new = mt * h_new + (1 - mt) * h
+        c_new = mt * c_new + (1 - mt) * c
+        return (h_new, c_new), (h_new * mt, c_new * mt)
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), (xt_seq, step_mask))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    for slot, val in (("Hidden", hidden), ("Cell", cell)):
+        if slot in ctx.op.outputs and ctx.op.outputs[slot]:
+            ctx.set_len(ctx.op.outputs[slot][0], lens)
+    return {"Hidden": hidden, "Cell": cell}
+
+
+@register_op("gru")
+def _gru(ctx, ins, attrs):
+    """dynamic GRU over [B,T,3H]; Weight [H,3H] laid out [u|r|c]."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins["Bias"][0].reshape(-1) if "Bias" in ins and ins["Bias"] else None
+    lens = _seq_lens_or_full(ctx, x, slot="Input")
+    B, T, H3 = x.shape
+    H = H3 // 3
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[attrs.get("activation", "tanh")]
+    w_ur = w[:, :2 * H]
+    w_c = w[:, 2 * H:]
+    h0 = ins["H0"][0] if "H0" in ins and ins["H0"] else jnp.zeros((B, H), x.dtype)
+    xt_seq = jnp.swapaxes(x, 0, 1)
+    step_mask = _mask(lens, T, x.dtype).T
+
+    def step(h, inp):
+        xt, mt = inp
+        x_ur = xt[:, :2 * H]
+        x_c = xt[:, 2 * H:]
+        ur = x_ur + h @ w_ur
+        if bias is not None:
+            ur = ur + bias[:2 * H]
+        u, r = jnp.split(gate_act(ur), 2, axis=-1)
+        c = x_c + (r * h) @ w_c
+        if bias is not None:
+            c = c + bias[2 * H:]
+        c = cand_act(c)
+        h_new = u * h + (1.0 - u) * c
+        mt = mt[:, None]
+        h_new = mt * h_new + (1 - mt) * h
+        return h_new, h_new * mt
+
+    _, hs = lax.scan(step, h0, (xt_seq, step_mask))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    if "Hidden" in ctx.op.outputs and ctx.op.outputs["Hidden"]:
+        ctx.set_len(ctx.op.outputs["Hidden"][0], lens)
+    return {"Hidden": hidden}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    """single LSTM step from pre-computed gates [B,4H] (lstm_unit_op)."""
+    gates, c_prev = ins["X"][0], ins["C_prev"][0]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    o = jax.nn.sigmoid(go)
+    c = f * c_prev + i * jnp.tanh(gc)
+    h = o * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """single GRU step (gru_unit_op): Input [B,3H], HiddenPrev [B,H],
+    Weight [H,3H]."""
+    x, h, w = ins["Input"][0], ins["HiddenPrev"][0], ins["Weight"][0]
+    H = h.shape[-1]
+    bias = ins["Bias"][0].reshape(-1) if "Bias" in ins and ins["Bias"] else None
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[attrs.get("activation", "tanh")]
+    ur = x[:, :2 * H] + h @ w[:, :2 * H]
+    if bias is not None:
+        ur = ur + bias[:2 * H]
+    g = gate_act(ur)
+    u, r = g[:, :H], g[:, H:]
+    c = x[:, 2 * H:] + (r * h) @ w[:, 2 * H:]
+    if bias is not None:
+        c = c + bias[2 * H:]
+    c = cand_act(c)
+    h_new = u * h + (1.0 - u) * c
+    return {"Hidden": h_new, "Gate": g, "ResetHiddenPrev": r * h}

@@ -1,0 +1,304 @@
+"""Tensor creation / data-movement op lowerings.
+
+Reference category (SURVEY §2.2 Data/layout + I/O): reshape, transpose,
+concat, split, pad, crop, expand, gather/scatter, multiplex, top_k,
+fill_constant(_batch_size_like), fill_zeros_like, gaussian_random,
+uniform_random, assign, one_hot, shape.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.types import convert_dtype
+
+
+@register_op("feed", "fetch")
+def _feed_fetch(ctx, ins, attrs):
+    """Kept for program parity (feed_op.cc/fetch_op.cc); the executor feeds
+    and fetches by name directly, so these are identity/no-ops."""
+    if "X" in ins and ins["X"]:
+        return {"Out": ins["X"][0]}
+    return {}
+
+
+@register_op("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": ins["X"][0]}
+
+
+@register_op("shape")
+def _shape(ctx, ins, attrs):
+    return {"Out": jnp.asarray(ins["X"][0].shape, dtype=jnp.int64)}
+
+
+@register_op("fill_constant")
+def _fill_constant(ctx, ins, attrs):
+    dt = convert_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(attrs.get("shape", []))
+    return {"Out": jnp.full(shape, attrs.get("value", 0.0), dtype=dt)}
+
+
+@register_op("fill_constant_batch_size_like")
+def _fill_cbsl(ctx, ins, attrs):
+    """Shape copied from Input except the batch dim (fill_constant_batch_
+    size_like_op.cc) — used to seed decoder states."""
+    ref = ins["Input"][0]
+    dt = convert_dtype(attrs.get("dtype", "float32"))
+    shape = list(attrs.get("shape", []))
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0), dtype=dt)}
+
+
+@register_op("fill_zeros_like")
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": jnp.zeros_like(ins["X"][0])}
+
+
+@register_op("fill_any_like")
+def _fill_any_like(ctx, ins, attrs):
+    return {"Out": jnp.full_like(ins["X"][0], attrs.get("value", 0.0))}
+
+
+@register_op("gaussian_random")
+def _gaussian_random(ctx, ins, attrs):
+    dt = convert_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(attrs.get("shape", []))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    return {"Out": mean + std * jax.random.normal(ctx.rng(), shape, dtype=dt)}
+
+
+@register_op("uniform_random")
+def _uniform_random(ctx, ins, attrs):
+    dt = convert_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(attrs.get("shape", []))
+    return {"Out": jax.random.uniform(ctx.rng(), shape, dtype=dt,
+                                      minval=attrs.get("min", -1.0),
+                                      maxval=attrs.get("max", 1.0))}
+
+
+@register_op("truncated_gaussian_random")
+def _truncated_gaussian_random(ctx, ins, attrs):
+    dt = convert_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(attrs.get("shape", []))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    return {"Out": mean + std * jax.random.truncated_normal(
+        ctx.rng(), -2.0, 2.0, shape, dtype=dt)}
+
+
+@register_op("assign_value")
+def _assign_value(ctx, ins, attrs):
+    vals = attrs["values"]
+    dt = convert_dtype(attrs.get("dtype", "float32"))
+    arr = jnp.asarray(vals, dtype=dt)
+    if "shape" in attrs and attrs["shape"]:
+        arr = arr.reshape(tuple(attrs["shape"]))
+    return {"Out": arr}
+
+
+@register_op("reshape")
+def _reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    # fluid: 0 means copy input dim, -1 infers
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return {"Out": x.reshape(tuple(shape))}
+
+
+@register_op("squeeze")
+def _squeeze(ctx, ins, attrs):
+    axes = attrs.get("axes", None)
+    return {"Out": jnp.squeeze(ins["X"][0],
+                               axis=tuple(axes) if axes else None)}
+
+
+@register_op("unsqueeze")
+def _unsqueeze(ctx, ins, attrs):
+    return {"Out": jnp.expand_dims(ins["X"][0], tuple(attrs["axes"]))}
+
+
+@register_op("transpose")
+def _transpose(ctx, ins, attrs):
+    return {"Out": jnp.transpose(ins["X"][0], tuple(attrs["axis"]))}
+
+
+@register_op("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("split")
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    sections = attrs.get("sections")
+    if sections:
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, attrs["num"], axis=axis)
+    return {"Out": list(parts)}
+
+
+@register_op("pad")
+def _pad(ctx, ins, attrs):
+    """pad_op: paddings = [before0, after0, before1, after1, ...]"""
+    x = ins["X"][0]
+    p = attrs["paddings"]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op("crop")
+def _crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    offsets = attrs["offsets"]
+    shape = attrs["shape"]
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": x[slices]}
+
+
+@register_op("expand")
+def _expand(ctx, ins, attrs):
+    """expand_op: tile each dim by expand_times."""
+    return {"Out": jnp.tile(ins["X"][0], tuple(attrs["expand_times"]))}
+
+
+@register_op("tile")
+def _tile(ctx, ins, attrs):
+    return {"Out": jnp.tile(ins["X"][0], tuple(attrs["repeat_times"]))}
+
+
+@register_op("slice")
+def _slice(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    sl = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[ax] = slice(st, en)
+    return {"Out": x[tuple(sl)]}
+
+
+@register_op("gather")
+def _gather(ctx, ins, attrs):
+    """gather_op: rows of X by Index (gather.h)."""
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": jnp.take(x, idx.astype(jnp.int32),
+                            axis=attrs.get("axis", 0))}
+
+
+@register_op("scatter")
+def _scatter(ctx, ins, attrs):
+    """scatter_op: write Updates rows into X at Ids (scatter.h).
+    overwrite=False accumulates (the SelectedRows-merge behavior)."""
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    ids = ids.astype(jnp.int32).reshape(-1)
+    if attrs.get("overwrite", True):
+        return {"Out": x.at[ids].set(upd)}
+    return {"Out": x.at[ids].add(upd)}
+
+
+@register_op("multiplex")
+def _multiplex(ctx, ins, attrs):
+    """multiplex_op: per-row select among candidate tensors by Ids."""
+    ids = ins["Ids"][0].astype(jnp.int32).reshape(-1)
+    stack = jnp.stack(ins["X"], axis=0)  # [K, N, ...]
+    return {"Out": stack[ids, jnp.arange(stack.shape[1])]}
+
+
+@register_op("top_k")
+def _top_k(ctx, ins, attrs):
+    vals, idx = jax.lax.top_k(ins["X"][0], attrs["k"])
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("argmax", "arg_max", "max_ids")
+def _argmax(ctx, ins, attrs):
+    return {"Out": jnp.argmax(ins["X"][0], axis=attrs.get("axis", -1))
+            .astype(jnp.int64)}
+
+
+@register_op("argsort")
+def _argsort(ctx, ins, attrs):
+    axis = attrs.get("axis", -1)
+    x = ins["X"][0]
+    idx = jnp.argsort(x, axis=axis, descending=attrs.get("descending", False))
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("one_hot")
+def _one_hot(ctx, ins, attrs):
+    x = ins["X"][0].astype(jnp.int32)
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x.squeeze(-1)
+    return {"Out": jax.nn.one_hot(x, attrs["depth"], dtype=jnp.float32)}
+
+
+@register_op("range")
+def _range(ctx, ins, attrs):
+    return {"Out": jnp.arange(attrs["start"], attrs["end"],
+                              attrs.get("step", 1),
+                              dtype=convert_dtype(attrs.get("dtype", "int64")))}
+
+
+@register_op("flatten")
+def _flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = attrs.get("axis", 1)
+    lead = 1
+    for s in x.shape[:ax]:
+        lead *= s
+    return {"Out": x.reshape((lead, -1))}
+
+
+@register_op("stack")
+def _stack(ctx, ins, attrs):
+    return {"Out": jnp.stack(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("unstack")
+def _unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    return {"Y": [jnp.squeeze(p, axis)
+                  for p in jnp.split(x, x.shape[axis], axis=axis)]}
+
+
+@register_op("where", "select")
+def _where(ctx, ins, attrs):
+    return {"Out": jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])}
+
+
+@register_op("is_empty")
+def _is_empty(ctx, ins, attrs):
+    """is_empty_op.cc — static under XLA (shapes are compile-time)."""
+    return {"Out": jnp.asarray(ins["X"][0].size == 0)}
+
+
+@register_op("shuffle")
+def _shuffle(ctx, ins, attrs):
+    x = ins["X"][0]
+    perm = jax.random.permutation(ctx.rng(), x.shape[0])
+    return {"Out": x[perm]}
+
+
+@register_op("reverse")
+def _reverse(ctx, ins, attrs):
+    axes = attrs.get("axis", [0])
+    if not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    return {"Out": jnp.flip(ins["X"][0], axis=tuple(axes))}

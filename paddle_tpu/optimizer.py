@@ -1,0 +1,312 @@
+"""Optimizer builders: append backward + optimizer ops to the program
+(reference: fluid/optimizer.py:190 minimize, :213-513 SGD/Momentum/Adagrad/
+Adam/Adamax/DecayedAdagrad; plus Adadelta/RMSProp/Ftrl from the op library
+and v1 FirstOrderOptimizer.h hierarchy).
+
+The produced program's optimizer section is pure ops, so one Executor.run
+compiles forward+backward+update into a single donated-buffer XLA step.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .backward import append_backward
+from .core import unique_name
+from .core.program import (Parameter, Program, Variable,
+                           default_main_program, default_startup_program,
+                           grad_var_name)
+from .initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+from .regularizer import append_regularization_ops
+from .clip import append_gradient_clip_ops
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None,
+                 global_step=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._global_step = global_step
+        self._name = name
+        self._accumulators = {}       # name -> {param_name: var}
+        self._lr_var = None
+
+    # -- learning rate -----------------------------------------------------
+    def _create_lr_var(self, program: Program):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        helper = LayerHelper("learning_rate")
+        name = unique_name.generate("learning_rate")
+        var = helper.create_global_variable([1], "float32", name=name)
+        helper.set_variable_initializer(
+            var, ConstantInitializer(float(self._learning_rate)))
+        self._lr_var = var
+
+    def _lr_for_param(self, param: Parameter):
+        mult = 1.0
+        if getattr(param, "optimize_attr", None):
+            mult = param.optimize_attr.get("learning_rate", 1.0)
+        if mult == 1.0:
+            return self._lr_var
+        from . import layers
+        return layers.scale(self._lr_var, scale=mult)
+
+    # -- accumulators ------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None):
+        helper = LayerHelper(f"{name}_acc")
+        shape = shape if shape is not None else list(param.shape)
+        var = helper.create_global_variable(
+            shape, param.dtype,
+            name=unique_name.generate(f"{param.name}_{name}"))
+        helper.set_variable_initializer(
+            var, ConstantInitializer(float(fill_value)))
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- main entry --------------------------------------------------------
+    def minimize(self, loss: Variable, startup_program=None,
+                 parameter_list=None, no_grad_set=None):
+        from .core.program import program_guard
+        program = loss.block.program
+        # LayerHelper-built pieces (clip graphs, lr vars, accumulators) must
+        # land in the LOSS's program even if a different default is active
+        with program_guard(program, startup_program):
+            params_grads = append_backward(loss, parameter_list, no_grad_set)
+            params_grads = append_gradient_clip_ops(params_grads)
+            params_grads = append_regularization_ops(
+                params_grads, self.regularization)
+            optimize_ops = self.apply_gradients(params_grads, program)
+        return optimize_ops, params_grads
+
+    def apply_gradients(self, params_grads, program=None):
+        program = program or default_main_program()
+        self._create_lr_var(program)
+        self._create_accumulators(
+            program, [p for p, _ in params_grads])
+        ops = []
+        for p, g in params_grads:
+            ops.append(self._append_optimize_op(program, p, g))
+        if self._global_step is not None:
+            from . import layers
+            layers.increment(self._global_step, 1.0, in_place=True)
+        return ops
+
+    # -- per-optimizer hooks ----------------------------------------------
+    def _create_accumulators(self, program, params):
+        pass
+
+    def _append_optimize_op(self, program, param, grad):
+        raise NotImplementedError
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, program, param, grad):
+        return program.global_block().append_op(
+            "sgd",
+            inputs={"Param": [param], "Grad": [grad],
+                    "LearningRate": [self._lr_for_param(param)]},
+            outputs={"ParamOut": [param.name]})
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, program, params):
+        for p in params:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, program, param, grad):
+        v = self._get_accumulator("velocity", param)
+        return program.global_block().append_op(
+            "momentum",
+            inputs={"Param": [param], "Grad": [grad], "Velocity": [v],
+                    "LearningRate": [self._lr_for_param(param)]},
+            outputs={"ParamOut": [param.name], "VelocityOut": [v.name]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, program, params):
+        for p in params:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, program, param, grad):
+        m = self._get_accumulator("moment", param)
+        return program.global_block().append_op(
+            "adagrad",
+            inputs={"Param": [param], "Grad": [grad], "Moment": [m],
+                    "LearningRate": [self._lr_for_param(param)]},
+            outputs={"ParamOut": [param.name], "MomentOut": [m.name]},
+            attrs={"epsilon": self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, program, params):
+        for p in params:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow", p, self._beta2, shape=[1])
+
+    def _append_optimize_op(self, program, param, grad):
+        m1 = self._get_accumulator("moment1", param)
+        m2 = self._get_accumulator("moment2", param)
+        b1 = self._get_accumulator("beta1_pow", param)
+        b2 = self._get_accumulator("beta2_pow", param)
+        return program.global_block().append_op(
+            "adam",
+            inputs={"Param": [param], "Grad": [grad], "Moment1": [m1],
+                    "Moment2": [m2], "Beta1Pow": [b1], "Beta2Pow": [b2],
+                    "LearningRate": [self._lr_for_param(param)]},
+            outputs={"ParamOut": [param.name], "Moment1Out": [m1.name],
+                     "Moment2Out": [m2.name], "Beta1PowOut": [b1.name],
+                     "Beta2PowOut": [b2.name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, program, params):
+        for p in params:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow", p, self._beta1, shape=[1])
+
+    def _append_optimize_op(self, program, param, grad):
+        m = self._get_accumulator("moment", param)
+        inf = self._get_accumulator("inf_norm", param)
+        b1 = self._get_accumulator("beta1_pow", param)
+        return program.global_block().append_op(
+            "adamax",
+            inputs={"Param": [param], "Grad": [grad], "Moment": [m],
+                    "InfNorm": [inf], "Beta1Pow": [b1],
+                    "LearningRate": [self._lr_for_param(param)]},
+            outputs={"ParamOut": [param.name], "MomentOut": [m.name],
+                     "InfNormOut": [inf.name], "Beta1PowOut": [b1.name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, program, params):
+        for p in params:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, program, param, grad):
+        m = self._get_accumulator("moment", param)
+        return program.global_block().append_op(
+            "decayed_adagrad",
+            inputs={"Param": [param], "Grad": [grad], "Moment": [m],
+                    "LearningRate": [self._lr_for_param(param)]},
+            outputs={"ParamOut": [param.name], "MomentOut": [m.name]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _create_accumulators(self, program, params):
+        for p in params:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, program, param, grad):
+        g2 = self._get_accumulator("avg_squared_grad", param)
+        u2 = self._get_accumulator("avg_squared_update", param)
+        return program.global_block().append_op(
+            "adadelta",
+            inputs={"Param": [param], "Grad": [grad],
+                    "AvgSquaredGrad": [g2], "AvgSquaredUpdate": [u2],
+                    "LearningRate": [self._lr_for_param(param)]},
+            outputs={"ParamOut": [param.name],
+                     "AvgSquaredGradOut": [g2.name],
+                     "AvgSquaredUpdateOut": [u2.name]},
+            attrs={"rho": self._rho, "epsilon": self._epsilon})
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon, self._momentum = rho, epsilon, momentum
+
+    def _create_accumulators(self, program, params):
+        for p in params:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("momentum", p)
+
+    def _append_optimize_op(self, program, param, grad):
+        ms = self._get_accumulator("mean_square", param)
+        mom = self._get_accumulator("momentum", param)
+        return program.global_block().append_op(
+            "rmsprop",
+            inputs={"Param": [param], "Grad": [grad], "MeanSquare": [ms],
+                    "Moment": [mom],
+                    "LearningRate": [self._lr_for_param(param)]},
+            outputs={"ParamOut": [param.name], "MeanSquareOut": [ms.name],
+                     "MomentOut": [mom.name]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum})
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, program, params):
+        for p in params:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, program, param, grad):
+        sq = self._get_accumulator("squared", param)
+        lin = self._get_accumulator("linear", param)
+        return program.global_block().append_op(
+            "ftrl",
+            inputs={"Param": [param], "Grad": [grad],
+                    "SquaredAccumulator": [sq], "LinearAccumulator": [lin],
+                    "LearningRate": [self._lr_for_param(param)]},
+            outputs={"ParamOut": [param.name], "SquaredAccumOut": [sq.name],
+                     "LinearAccumOut": [lin.name]},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power})
+
+
+# fluid-style aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer

@@ -1,0 +1,30 @@
+"""Parallelism over the device mesh.
+
+Replaces ALL FOUR of the reference's communication backends (SURVEY §2.6:
+v1 pserver epoll RPC, Go pserver/master, fluid gRPC send/recv, NCCL ops) with
+XLA collectives over a ``jax.sharding.Mesh``:
+
+* data parallel  — MultiGradientMachine / pserver / NCCLAllReduce →
+  batch-sharded ``pjit`` with psum'd gradients riding ICI.
+* model parallel — ParallelNeuralNetwork's per-layer device placement →
+  tensor-parallel PartitionSpecs on parameters (Megatron-style for fc).
+* NEW capabilities beyond the reference (required by the rebuild spec):
+  sequence/context parallelism incl. ring attention, pipeline and expert
+  scaffolds.
+"""
+from .mesh import MeshConfig, get_mesh, make_mesh, mesh_guard
+from .collective import (all_gather, all_reduce, broadcast, psum,
+                         reduce_scatter, ppermute, barrier)
+from .data_parallel import DataParallel, shard_batch
+from .tensor_parallel import column_parallel_spec, row_parallel_spec, \
+    shard_params
+from .ring_attention import ring_attention
+from . import pipeline
+
+__all__ = [
+    "MeshConfig", "get_mesh", "make_mesh", "mesh_guard",
+    "all_gather", "all_reduce", "broadcast", "psum", "reduce_scatter",
+    "ppermute", "barrier", "DataParallel", "shard_batch",
+    "column_parallel_spec", "row_parallel_spec", "shard_params",
+    "ring_attention", "pipeline",
+]

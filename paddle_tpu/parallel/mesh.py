@@ -1,0 +1,88 @@
+"""Device-mesh management.
+
+The reference selects devices with gflags (``--trainer_count``, ``--use_gpu``,
+per-layer ``deviceId_``); TPU-native placement is a named
+``jax.sharding.Mesh`` whose axes express the parallelism taxonomy:
+
+    dp — data parallel (batch)          tp — tensor parallel (hidden)
+    pp — pipeline stages                sp — sequence/context parallel
+    ep — expert parallel
+
+Mesh axis layout determines whether collectives ride ICI or DCN; keep tp/sp
+on the innermost (fastest) axes, dp/pp outermost — the scaling-book recipe.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    @property
+    def size(self):
+        return self.dp * self.tp * self.pp * self.sp * self.ep
+
+    def axis_sizes(self) -> Tuple[Tuple[str, int], ...]:
+        return (("dp", self.dp), ("pp", self.pp), ("sp", self.sp),
+                ("ep", self.ep), ("tp", self.tp))
+
+
+_current_mesh: Optional[Mesh] = None
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence] = None,
+              axis_names: Optional[Sequence[str]] = None,
+              shape: Optional[Sequence[int]] = None) -> Mesh:
+    """Build a Mesh.  Either from a MeshConfig (axes dp/pp/sp/ep/tp — inner
+    axes map to adjacent devices => ICI) or raw (shape, axis_names)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if config is not None:
+        names = [n for n, s in config.axis_sizes()]
+        sizes = [s for n, s in config.axis_sizes()]
+        total = int(np.prod(sizes))
+        if total != len(devices):
+            raise ValueError(f"mesh size {total} != device count "
+                             f"{len(devices)}")
+        arr = np.asarray(devices).reshape(sizes)
+        return Mesh(arr, axis_names=names)
+    arr = np.asarray(devices).reshape(tuple(shape))
+    return Mesh(arr, axis_names=tuple(axis_names))
+
+
+def get_mesh() -> Mesh:
+    """The ambient mesh (set with mesh_guard), defaulting to a 1-D 'dp' mesh
+    over all local devices."""
+    global _current_mesh
+    if _current_mesh is not None:
+        return _current_mesh
+    devs = jax.devices()
+    return Mesh(np.asarray(devs), axis_names=("dp",))
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh: Mesh):
+    global _current_mesh
+    old = _current_mesh
+    _current_mesh = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _current_mesh = old
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,55 @@
+"""Pipeline parallelism scaffold over the 'pp' mesh axis.
+
+The reference's nearest ancestor is ParallelNeuralNetwork: whole layers
+pinned to devices with queue-pipelined activations (SURVEY §2.6 "Model
+parallelism (v1)").  The TPU-native version is GPipe-style microbatching
+inside shard_map: each pp stage applies its layer stack, activations hop to
+the next stage with ppermute, and a scan over (microbatches + stages - 1)
+ticks keeps every stage busy after warmup.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_forward(stage_fn: Callable, params, x_microbatches,
+                     axis_name: str = "pp"):
+    """Run microbatches through a pipeline of stages.
+
+    stage_fn(params, x) -> y is THIS stage's computation (same signature on
+    every member; params differ per stage).  x_microbatches: [M, ...] stacked
+    microbatches (only stage 0's input matters; others ignore it).
+    Returns [M, ...] outputs valid on the LAST stage.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    ticks = M + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        buf, outs = carry
+        # stage 0 injects microbatch t (if in range); others use what arrived
+        inject = jnp.where(t < M, t, M - 1)
+        x0 = x_microbatches[inject]
+        x = jnp.where(idx == 0, x0, buf)
+        y = stage_fn(params, x)
+        # last stage records its result at slot t-(n-1)
+        slot = t - (n - 1)
+        valid = (idx == n - 1) & (slot >= 0)
+        outs = lax.cond(
+            valid,
+            lambda o: o.at[jnp.clip(slot, 0, M - 1)].set(y),
+            lambda o: o,
+            outs)
+        buf_next = lax.ppermute(y, axis_name, perm)
+        return (buf_next, outs), None
+
+    buf0 = jnp.zeros_like(stage_fn(params, x_microbatches[0]))
+    outs0 = jnp.zeros((M,) + buf0.shape, buf0.dtype)
+    (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(ticks))
+    return outs

@@ -1,0 +1,102 @@
+"""Ring attention: sequence/context parallelism over the 'sp' mesh axis.
+
+A NEW capability relative to the 2017 reference (SURVEY §2.6 confirms the
+reference has no sequence parallelism — long sequences were handled by LoD
+packing only).  Required by the rebuild spec for long-context scaling.
+
+Blockwise ring attention (Liu et al.): each sp shard holds a query block and
+circulates key/value blocks around the ring with ppermute, maintaining
+numerically-stable streaming softmax statistics (m, l) so the result is exact
+full attention.  Communication overlaps compute; memory is O(T/sp).
+Use inside shard_map with sequences sharded on 'sp'.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attn(q, k, v, bias=None):
+    """Stable block attention returning (out_unnorm, m, l)."""
+    s = jnp.einsum("...qd,...kd->...qk", q, k)
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("...qk,...kd->...qd", p, v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                   scale: float = None):
+    """Exact attention with K/V circulated around the sp ring.
+
+    q,k,v: [B, T_local, H, D] (local sequence shard).  Returns [B,T_local,H,D].
+    With ``causal``, blocks wholly in the future are skipped via masking
+    (shapes stay static; the mask zeroes their contribution).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    q = q * scale
+    # work in [B, H, T, D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    T = qh.shape[2]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def bias_for(src_idx):
+        if not causal:
+            return None
+        # global positions: my block rows, src block cols
+        qpos = my * T + jnp.arange(T)[:, None]
+        kpos = src_idx * T + jnp.arange(T)[None, :]
+        return jnp.where(kpos <= qpos, 0.0, -1e30)
+
+    def step(carry, i):
+        kh_c, vh_c, o, m, l = carry
+        src = (my - i) % n            # whose kv block we currently hold
+        bias = bias_for(src)
+        o_b, m_b, l_b = _block_attn(qh, kh_c, vh_c, bias)
+        m_new = jnp.maximum(m, m_b)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_b - m_new)
+        o = o * alpha + o_b * beta
+        l = l * alpha + l_b * beta
+        kh_n = lax.ppermute(kh_c, axis_name, perm)
+        vh_n = lax.ppermute(vh_c, axis_name, perm)
+        return (kh_n, vh_n, o, m_new, l), None
+
+    o0 = jnp.zeros_like(qh)
+    m0 = jnp.full(qh.shape[:-1] + (1,), -1e30, qh.dtype)
+    l0 = jnp.zeros(qh.shape[:-1] + (1,), qh.dtype)
+    (_, _, o, m, l), _ = lax.scan(
+        step, (kh, vh, o0, m0, l0), jnp.arange(n))
+    out = o / jnp.maximum(l, 1e-20)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def sequence_parallel_attention(q, k, v, axis_name="sp", causal=False):
+    """Ulysses-style all-to-all alternative: swap sequence sharding for head
+    sharding, run full attention locally, swap back.  Prefer when head count
+    is divisible by sp and sequence length is moderate."""
+    # [B, T/s, H, D] -> all_to_all -> [B, T, H/s, D]
+    qt = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kt = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vt = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    d = qt.shape[-1]
+    s = jnp.einsum("bthd,bshd->bhts", qt * (d ** -0.5), kt)
+    if causal:
+        T = s.shape[-1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhts,bshd->bthd", p, vt)
+    return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)

@@ -1,0 +1,52 @@
+"""Tensor (model) parallelism: parameter PartitionSpecs.
+
+The reference's model parallelism is per-layer device placement
+(ParallelNeuralNetwork.cpp, `--parallel_nn` Flags.cpp:30) — whole layers on
+different GPUs with activations shipped between them.  The TPU-native version
+shards *within* layers: fc/embedding weights get Megatron-style column/row
+specs on the 'tp' mesh axis and XLA inserts the all-gather/reduce-scatter
+pairs on ICI.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.program import Program
+from ..core.scope import Scope
+
+
+def column_parallel_spec():
+    """fc weight [in, out] sharded on out — activations gather on 'tp'."""
+    return P(None, "tp")
+
+
+def row_parallel_spec():
+    """fc weight [in, out] sharded on in — outputs psum on 'tp'."""
+    return P("tp", None)
+
+
+def embedding_parallel_spec():
+    """vocab-sharded embedding [V, D] (the SelectedRows/CTR table analog —
+    SparseRowMatrix.h:31 machinery becomes a sharded gather)."""
+    return P("tp", None)
+
+
+def shard_params(program: Program, scope: Scope, mesh: Mesh,
+                 overrides: Optional[Dict[str, P]] = None):
+    """Apply Parameter.sharding annotations (set via ParamAttr(sharding=...))
+    or explicit overrides, placing scope arrays accordingly.  Un-annotated
+    params replicate."""
+    overrides = overrides or {}
+    for p in program.all_parameters():
+        if not scope.has(p.name):
+            continue
+        spec = overrides.get(p.name)
+        if spec is None and p.sharding is not None:
+            spec = P(*p.sharding)
+        if spec is None:
+            spec = P()
+        scope.set(p.name, jax.device_put(
+            scope.get(p.name), NamedSharding(mesh, spec)))

@@ -1,0 +1,58 @@
+"""ParamAttr: per-parameter configuration (reference: fluid/param_attr.py).
+
+Adds one TPU-native field the reference lacks: ``sharding`` — a
+PartitionSpec-like tuple naming mesh axes per dim, consumed by
+paddle_tpu.parallel for tensor-parallel layouts.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .initializer import Initializer
+
+
+class ParamAttr:
+    def __init__(self, name: Optional[str] = None,
+                 initializer: Optional[Initializer] = None,
+                 learning_rate: float = 1.0,
+                 regularizer=None,
+                 trainable: bool = True,
+                 gradient_clip=None,
+                 sharding=None):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.gradient_clip = gradient_clip
+        self.sharding = sharding
+
+    @staticmethod
+    def _to_attr(arg) -> "ParamAttr":
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, Initializer):
+            return ParamAttr(initializer=arg)
+        if isinstance(arg, bool):
+            return ParamAttr() if arg else None
+        raise TypeError(f"cannot convert {arg!r} to ParamAttr")
+
+    def to_kwargs(self, with_initializer=False):
+        kw = {
+            "name": self.name,
+            "optimize_attr": {"learning_rate": self.learning_rate},
+            "regularizer": self.regularizer,
+            "trainable": self.trainable,
+            "gradient_clip_attr": self.gradient_clip,
+            "sharding": self.sharding,
+        }
+        if with_initializer:
+            kw["initializer"] = self.initializer
+        return kw
+
+
+WeightNormParamAttr = ParamAttr  # parity alias (weight-norm TODO)

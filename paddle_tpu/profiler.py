@@ -1,0 +1,98 @@
+"""Profiling & timing utilities.
+
+Reference analogs: v1 `Stat`/`REGISTER_TIMER` per-layer timers
+(utils/Stat.h:63,114,230 printed per log period) and fluid's `cuda_profiler`
+nvprof context manager (fluid/profiler.py:19-52).  TPU-native: jax.profiler
+traces (viewable in TensorBoard/XProf) + host-side step timers.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+from typing import Dict
+
+import jax
+
+
+@contextlib.contextmanager
+def profiler(output_dir: str = "/tmp/paddle_tpu_trace", state=None,
+             sorted_key=None):
+    """Trace the enclosed steps with jax.profiler (cuda_profiler analog)."""
+    jax.profiler.start_trace(output_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+cuda_profiler = profiler  # reference-name alias
+
+
+class Stat:
+    """Accumulating named timer (utils/Stat.h StatSet analog)."""
+
+    def __init__(self):
+        self._totals: Dict[str, float] = collections.defaultdict(float)
+        self._counts: Dict[str, int] = collections.defaultdict(int)
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._totals[name] += dt
+            self._counts[name] += 1
+
+    def report(self) -> str:
+        lines = ["======= StatSet ======="]
+        for name in sorted(self._totals, key=lambda n: -self._totals[n]):
+            tot = self._totals[name]
+            cnt = self._counts[name]
+            lines.append(f"  {name}: total={tot*1e3:.2f}ms count={cnt} "
+                         f"avg={tot/cnt*1e3:.3f}ms")
+        return "\n".join(lines)
+
+    def reset(self):
+        self._totals.clear()
+        self._counts.clear()
+
+
+_global_stat = Stat()
+
+
+def global_stat() -> Stat:
+    return _global_stat
+
+
+@contextlib.contextmanager
+def timer(name: str):
+    """REGISTER_TIMER analog on the global StatSet."""
+    with _global_stat.timer(name):
+        yield
+
+
+class StepTimer:
+    """Per-step wall-clock with warmup discard, for benchmarks."""
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self.times = []
+        self._t = None
+        self._step = 0
+
+    def start(self):
+        self._t = time.perf_counter()
+
+    def stop(self):
+        dt = time.perf_counter() - self._t
+        self._step += 1
+        if self._step > self.warmup:
+            self.times.append(dt)
+        return dt
+
+    @property
+    def mean(self):
+        return sum(self.times) / max(len(self.times), 1)

@@ -1,0 +1,16 @@
+"""Reader composition toolkit (reference: python/paddle/v2/reader/ —
+decorator.py: batch, shuffle, buffered, cache, chain, compose, firstn,
+map_readers, xmap_readers with a thread pool; creator.py).
+
+A *reader* is a zero-arg callable returning an iterable of samples — the
+reference's protocol, kept verbatim.  ``xmap_readers``'s thread-pool
+double-buffering (the PyDataProvider2 async pool role,
+PyDataProvider2.cpp:195) is provided by ``buffered`` / ``xmap_readers`` over
+``paddle_tpu.distributed.queue`` (native-backed when available).
+"""
+from . import decorator
+from .decorator import (batch, buffered, cache, chain, compose, firstn,
+                        map_readers, shuffle, xmap_readers)
+
+__all__ = ["batch", "buffered", "cache", "chain", "compose", "firstn",
+           "map_readers", "shuffle", "xmap_readers", "decorator"]

@@ -1,0 +1,32 @@
+"""Test environment: force an 8-virtual-device CPU platform BEFORE jax
+imports, so mesh/sharding tests run without TPU hardware (the driver's
+dryrun uses the same trick)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    """Fresh default programs/scope/name-counters per test."""
+    import paddle_tpu as pt
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    pt.unique_name.reset()
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

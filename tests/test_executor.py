@@ -1,0 +1,143 @@
+"""Core Program/Executor tests (analog of framework/executor_test,
+operator_test.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_simple_program_runs():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.scale(x, scale=2.0, bias=1.0)
+    exe = pt.Executor()
+    xin = np.arange(8, dtype=np.float32).reshape(2, 4)
+    (out,) = exe.run(feed={"x": xin}, fetch_list=[y])
+    np.testing.assert_allclose(out, xin * 2 + 1, rtol=1e-6)
+
+
+def test_fc_forward_matches_numpy(rng):
+    x = layers.data("x", shape=[8], dtype="float32")
+    out = layers.fc(x, size=3, bias_attr=True)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xin = rng.randn(5, 8).astype(np.float32)
+    (o,) = exe.run(feed={"x": xin}, fetch_list=[out])
+    scope = pt.global_scope()
+    w_name = [k for k in scope.keys() if k.endswith(".w_0")][0]
+    b_name = [k for k in scope.keys() if k.endswith(".b_0")][0]
+    w = scope.numpy(w_name)
+    b = scope.numpy(b_name)
+    np.testing.assert_allclose(o, xin @ w + b, rtol=1e-5, atol=1e-5)
+
+
+def test_persistable_state_updates():
+    c = layers.create_global_var([1], 0.0, "float32", persistable=True,
+                                 name="counter")
+    layers.increment(c, 1.0)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    for i in range(3):
+        exe.run(pt.default_main_program(), fetch_list=[])
+    assert float(pt.global_scope().numpy("counter")[0]) == 3.0
+
+
+def test_backward_computes_gradient(rng):
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.fc(x, size=1, bias_attr=False,
+                  param_attr=pt.ParamAttr(name="w_lin"))
+    loss = layers.mean(y)
+    pt.append_backward(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xin = rng.randn(6, 4).astype(np.float32)
+    (g,) = exe.run(feed={"x": xin}, fetch_list=["w_lin@GRAD"])
+    # d mean(x@w) / dw = mean over batch of x
+    np.testing.assert_allclose(g.reshape(-1), xin.mean(0), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_sgd_training_reduces_loss(rng):
+    x = layers.data("x", shape=[4], dtype="float32")
+    yt = layers.data("yt", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    diff = layers.elementwise_sub(pred, yt)
+    loss = layers.mean(layers.square(diff))
+    opt = pt.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    w_true = rng.randn(4, 1).astype(np.float32)
+    losses = []
+    for i in range(30):
+        xin = rng.randn(16, 4).astype(np.float32)
+        yin = xin @ w_true
+        (l,) = exe.run(feed={"x": xin, "yt": yin}, fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.1, losses[:3] + losses[-3:]
+
+
+def test_program_clone_and_prune():
+    x = layers.data("x", shape=[4], dtype="float32")
+    h = layers.fc(x, size=3, act="relu")
+    out = layers.fc(h, size=2)
+    loss = layers.mean(out)
+    pt.append_backward(loss)
+    pt.optimizer.SGD(0.1).apply_gradients(
+        [(p, pt.default_main_program().global_block().var(p.name + "@GRAD"))
+         for p in pt.default_main_program().all_parameters()])
+    inf = pt.default_main_program().prune([out])
+    types = [op.type for op in inf.global_block().ops]
+    assert "backward" not in types
+    assert "sgd" not in types
+    assert "mul" in types
+
+
+def test_executor_nan_check():
+    x = layers.data("x", shape=[2], dtype="float32")
+    y = layers.log(x)
+    exe = pt.Executor(check_nan_inf=True)
+    with pytest.raises(FloatingPointError):
+        exe.run(feed={"x": np.array([[-1.0, 1.0]], np.float32)},
+                fetch_list=[y])
+
+
+def test_program_serialization_roundtrip():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.fc(x, size=2, bias_attr=True)
+    prog = pt.default_main_program()
+    restored = pt.Program.from_json(prog.to_json())
+    assert [op.type for op in restored.global_block().ops] == \
+        [op.type for op in prog.global_block().ops]
+
+
+def test_while_loop_runs_and_terminates():
+    """Regression: body writes must update the lax.while_loop carry."""
+    i = layers.fill_constant([1], "int64", 0)
+    limit = layers.fill_constant([1], "int64", 5)
+    total = layers.fill_constant([1], "float32", 0.0)
+    cond = layers.less_than(i, limit)
+    w = layers.While(cond)
+    with w.block():
+        layers.sums([total, layers.ones([1], "float32")], out=total)
+        layers.increment(i, 1.0)
+        layers.less_than(i, limit, cond=cond)
+    exe = pt.Executor()
+    out, iv = exe.run(fetch_list=[total, i])
+    assert float(out[0]) == 5.0
+    assert int(iv[0]) == 5
+
+
+def test_fc_has_bias_by_default():
+    x = layers.data("x", shape=[4], dtype="float32")
+    layers.fc(x, size=3)
+    names = [p.name for p in pt.default_main_program().all_parameters()]
+    assert any(".b_" in n for n in names), names
+
+
+def test_program_roundtrip_keeps_parameters():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.fc(x, size=2)
+    prog = pt.default_main_program()
+    restored = pt.Program.from_json(prog.to_json())
+    assert len(restored.all_parameters()) == len(prog.all_parameters()) > 0
