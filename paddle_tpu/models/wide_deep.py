@@ -1,0 +1,31 @@
+"""Wide & Deep CTR model (reference capability: sparse/CTR machinery —
+SparseRowCpuMatrix embeddings SparseRowMatrix.h:31-260, SelectedRows grads,
+lookup_table_op; BASELINE.json config "DeepFM / wide-deep CTR").
+
+TPU design: every sparse feature is an embedding lookup (gather) whose
+gradient XLA turns into a scatter-add — the SelectedRows path without a
+parameter server.  For multi-chip, shard the embedding tables over the 'mp'
+axis via Parameter.sharding (paddle_tpu.parallel.embedding).
+"""
+from __future__ import annotations
+
+from .. import layers
+
+
+def wide_deep(sparse_ids, dense_feat, vocab_sizes, emb_dim=16,
+              deep_hidden=(64, 32)):
+    """``sparse_ids``: list of int id tensors [B, 1]; ``dense_feat``:
+    [B, D] float tensor; returns sigmoid CTR prediction [B, 1]."""
+    # deep: concat embeddings + dense -> MLP
+    embs = [layers.embedding(ids, size=[vs, emb_dim], is_sparse=True)
+            for ids, vs in zip(sparse_ids, vocab_sizes)]
+    deep = layers.concat(embs + [dense_feat], axis=1)
+    for h in deep_hidden:
+        deep = layers.fc(deep, size=h, act="relu")
+    # wide: one scalar weight per sparse id (linear part) + dense linear
+    wides = [layers.embedding(ids, size=[vs, 1], is_sparse=True)
+             for ids, vs in zip(sparse_ids, vocab_sizes)]
+    wide = layers.concat(wides + [dense_feat], axis=1)
+    wide = layers.fc(wide, size=1)
+    logit = layers.elementwise_add(layers.fc(deep, size=1), wide)
+    return layers.sigmoid(logit)

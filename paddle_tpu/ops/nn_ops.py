@@ -92,13 +92,13 @@ def _pool2d_core(x, ptype, ksize, strides, pads, global_pooling, exclusive,
     padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+        return lax.reduce_window(x, init, lax.max,
                                  window, ws, padding)
-    s = lax.reduce_window(x, jnp.asarray(0.0, x.dtype), lax.add,
+    s = lax.reduce_window(x, 0.0, lax.add,
                           window, ws, padding)
     if exclusive and (pads[0] or pads[1]):
         ones = jnp.ones_like(x)
-        cnt = lax.reduce_window(ones, jnp.asarray(0.0, x.dtype), lax.add,
+        cnt = lax.reduce_window(ones, 0.0, lax.add,
                                 window, ws, padding)
         return s / cnt
     return s / (ksize[0] * ksize[1])
