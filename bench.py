@@ -1,0 +1,72 @@
+"""Headline benchmark: ResNet-50 training throughput, images/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's best published ResNet-50 *training* number is
+82.35 img/s (batch 128) on a 2x20-core Skylake with MKL-DNN
+(benchmark/IntelOptimizedPaddle.md:39-45 — no GPU ResNet-50 number exists
+in-repo; BASELINE.md "Gaps").  vs_baseline = ours / 82.35.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 82.35
+BATCH = 64
+WARMUP = 3
+ITERS = 10
+
+
+def main():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    img = layers.data("img", shape=[3, 224, 224], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = models.resnet50(img, num_classes=1000)
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    opt = pt.optimizer.Momentum(learning_rate=0.01 / BATCH, momentum=0.9)
+    opt.minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+
+    rng = np.random.RandomState(0)
+    feeds = {"img": rng.rand(BATCH, 3, 224, 224).astype("float32"),
+             "label": rng.randint(0, 1000, (BATCH, 1))}
+
+    prog = pt.default_main_program()
+    for _ in range(WARMUP):
+        exe.run(prog, feed=feeds, fetch_list=[loss])
+    jax.block_until_ready(pt.global_scope().get(
+        prog.all_parameters()[0].name))
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss])
+    elapsed = time.perf_counter() - t0
+
+    img_s = BATCH * ITERS / elapsed
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # the driver records whatever line we print
+        print(json.dumps({
+            "metric": "resnet50_train_images_per_sec_per_chip",
+            "value": 0.0, "unit": "img/s", "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:300]}))
+        sys.exit(1)

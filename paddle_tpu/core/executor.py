@@ -360,9 +360,18 @@ class Executor:
 
     def _build(self, program: Program, feed_names: List[str],
                fetch_names: List[str], state_keys: List[str], is_test: bool):
+        fn = self._make_fn(program, fetch_names, is_test)
+        if not self.use_jit:
+            return fn
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def _make_fn(self, program: Program, fetch_names: List[str],
+                 is_test: bool):
+        """The pure (feeds, state, step) -> (fetches, state') function the
+        jit wrappers compile (ShardedExecutor adds mesh shardings)."""
         persistable_names = sorted(
             {v.name for b in program.blocks for v in b.vars.values()
-             if v.persistable} | set(state_keys))
+             if v.persistable})
 
         def fn(feed_arrays, state, step):
             base_key = jax.random.fold_in(
@@ -377,10 +386,7 @@ class Executor:
                          if env.has(k)}
             return fetches, new_state
 
-        if not self.use_jit:
-            return fn
-        jfn = jax.jit(fn, donate_argnums=(1,))
-        return jfn
+        return fn
 
     def _nan_check(self, names, fetches):
         for n, f in zip(names, fetches):

@@ -19,6 +19,7 @@ from .data_parallel import DataParallel, shard_batch
 from .tensor_parallel import column_parallel_spec, row_parallel_spec, \
     shard_params
 from .ring_attention import ring_attention
+from .sharded import ShardedExecutor
 from . import pipeline
 
 __all__ = [
@@ -26,5 +27,5 @@ __all__ = [
     "all_gather", "all_reduce", "broadcast", "psum", "reduce_scatter",
     "ppermute", "barrier", "DataParallel", "shard_batch",
     "column_parallel_spec", "row_parallel_spec", "shard_params",
-    "ring_attention", "pipeline",
+    "ring_attention", "ShardedExecutor", "pipeline",
 ]

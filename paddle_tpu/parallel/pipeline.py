@@ -41,15 +41,20 @@ def pipeline_forward(stage_fn: Callable, params, x_microbatches,
         # last stage records its result at slot t-(n-1)
         slot = t - (n - 1)
         valid = (idx == n - 1) & (slot >= 0)
-        outs = lax.cond(
-            valid,
-            lambda o: o.at[jnp.clip(slot, 0, M - 1)].set(y),
-            lambda o: o,
-            outs)
+        slot_c = jnp.clip(slot, 0, M - 1)
+        cur = lax.dynamic_index_in_dim(outs, slot_c, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(valid, y, cur), slot_c, 0)
         buf_next = lax.ppermute(y, axis_name, perm)
         return (buf_next, outs), None
 
     buf0 = jnp.zeros_like(stage_fn(params, x_microbatches[0]))
     outs0 = jnp.zeros((M,) + buf0.shape, buf0.dtype)
+    # carries become device-varying (ppermute / axis_index); mark the inits
+    buf0 = lax.pvary(buf0, (axis_name,))
+    outs0 = lax.pvary(outs0, (axis_name,))
     (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(ticks))
-    return outs
+    # only the last stage holds real results; psum broadcasts them so the
+    # output is replicated over pp (callers can use out_specs=P())
+    return lax.psum(jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)),
+                    axis_name)

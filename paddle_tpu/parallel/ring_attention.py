@@ -74,8 +74,10 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         return (kh_n, vh_n, o, m_new, l), None
 
     o0 = jnp.zeros_like(qh)
-    m0 = jnp.full(qh.shape[:-1] + (1,), -1e30, qh.dtype)
-    l0 = jnp.zeros(qh.shape[:-1] + (1,), qh.dtype)
+    # derive from qh so the carries inherit its varying-manual-axes type
+    # under shard_map (a constant init would fail lax.scan's carry check)
+    m0 = jnp.full_like(qh[..., :1], -1e30)
+    l0 = jnp.zeros_like(qh[..., :1])
     (_, _, o, m, l), _ = lax.scan(
         step, (kh, vh, o0, m0, l0), jnp.arange(n))
     out = o / jnp.maximum(l, 1e-20)

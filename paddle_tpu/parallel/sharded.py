@@ -1,0 +1,141 @@
+"""ShardedExecutor: the multi-chip training path.
+
+One jit per (program, feed-signature) with explicit ``in_shardings`` /
+``out_shardings`` over a named Mesh — GSPMD propagates the annotations and
+inserts ICI collectives.  This single mechanism replaces the reference's
+MultiGradientMachine ring reduce (MultiGradientMachine.h:60-110), both
+parameter servers (paddle/pserver, go/pserver), and the NCCL op family
+(operators/nccl/nccl_op.cu.cc) — there is no gradient-exchange code to write
+because sharded-batch + replicated-params makes XLA emit the all-reduce.
+
+Parallelism taxonomy (mesh axes, see parallel.mesh):
+  dp — feeds sharded on batch dim 0 (data parallel)
+  tp — Parameter.sharding PartitionSpecs (Megatron column/row, vocab-sharded
+       embeddings — the SelectedRows/CTR analog)
+  sp — sequence dim sharding on feeds declared lod_level>0 (NEW vs reference)
+  pp/ep — via parallel.pipeline / expert specs on parameters.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.executor import Executor
+from ..core.program import Program
+from .mesh import get_mesh
+
+
+class ShardedExecutor(Executor):
+    """Executor whose compiled step carries mesh shardings.
+
+    feed_specs: optional {feed_name: PartitionSpec} overrides.  Default:
+    batch dim sharded on ``batch_axis`` (and, when the program var has
+    lod_level>0 and the mesh has an 'sp' axis of size>1, time dim on 'sp').
+    Parameters use ``Parameter.sharding`` annotations; unannotated state
+    replicates.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, batch_axis: str = "dp",
+                 feed_specs: Optional[Dict[str, P]] = None,
+                 param_specs: Optional[Dict[str, P]] = None, **kw):
+        super().__init__(**kw)
+        self.mesh = mesh or get_mesh()
+        self.batch_axis = batch_axis
+        self.feed_specs = dict(feed_specs or {})
+        self.param_specs = dict(param_specs or {})
+
+    # -- sharding selection -------------------------------------------------
+    def _find_var(self, program: Program, name: str):
+        for b in program.blocks:
+            if name in b.vars:
+                return b.vars[name]
+        return None
+
+    def _feed_spec(self, program: Program, name: str, ndim: int) -> P:
+        if name in self.feed_specs:
+            return self.feed_specs[name]
+        if ndim == 0:
+            return P()
+        base = name[:-4] if name.endswith("@LEN") else name
+        v = self._find_var(program, base)
+        axes = [self.batch_axis if self.batch_axis in self.mesh.axis_names
+                else None]
+        if (not name.endswith("@LEN") and v is not None and v.lod_level
+                and "sp" in self.mesh.axis_names
+                and self.mesh.shape["sp"] > 1 and ndim >= 2):
+            axes.append("sp")
+        axes = axes[:ndim]
+        return P(*axes)
+
+    def _state_spec(self, program: Program, name: str) -> P:
+        if name in self.param_specs:
+            return self.param_specs[name]
+        v = self._find_var(program, name)
+        if v is not None and getattr(v, "sharding", None):
+            return P(*v.sharding)
+        return P()
+
+    # -- overrides ----------------------------------------------------------
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, **kw):
+        with self.mesh:
+            return super().run(program, feed=feed, fetch_list=fetch_list,
+                               **kw)
+
+    def _build(self, program: Program, feed_names, fetch_names,
+               state_keys, is_test):
+        fn = self._make_fn(program, fetch_names, is_test)
+        if not self.use_jit:
+            return fn
+        mesh = self.mesh
+
+        def shardings_for_call(feed_arrays, state):
+            feed_sh = {n: NamedSharding(mesh, self._feed_spec(
+                program, n, np.ndim(a))) for n, a in feed_arrays.items()}
+            # Pin only explicitly-annotated params; None leaves let jit keep
+            # whatever sharding GSPMD propagated onto the arrays (replicated
+            # params stay replicated, derived accumulators keep their layout).
+            state_sh = {}
+            for k in state:
+                spec = self.param_specs.get(k)
+                if spec is None:
+                    v = self._find_var(program, k)
+                    if v is not None and getattr(v, "sharding", None):
+                        spec = P(*v.sharding)
+                state_sh[k] = NamedSharding(mesh, spec) if spec is not None \
+                    else None
+            return feed_sh, state_sh
+
+        jitted = {}
+
+        def wrapper(feed_arrays, state, step):
+            key = (tuple(sorted(feed_arrays)), tuple(sorted(state)))
+            if key not in jitted:
+                feed_sh, state_sh = shardings_for_call(feed_arrays, state)
+                # out_shardings stay unspecified: the produced state set can
+                # exceed the fed state (first step materializes accumulators)
+                # and GSPMD propagation keeps params on their input shardings.
+                jitted[key] = jax.jit(
+                    fn,
+                    in_shardings=(feed_sh, state_sh, None),
+                    donate_argnums=(1,))
+            return jitted[key](feed_arrays, state, step)
+
+        return wrapper
+
+    def place_state(self, program: Program, scope=None):
+        """Pre-place persistable scope entries with their specs (params get
+        Parameter.sharding; others replicate).  Call once after the startup
+        program ran — the analog of MultiGradientMachine's value dispatch."""
+        from ..core.scope import global_scope
+        scope = scope or global_scope()
+        for name in list(scope.keys()):
+            v = self._find_var(program, name)
+            if v is None or not v.persistable:
+                continue
+            spec = self._state_spec(program, name)
+            scope.set(name, jax.device_put(
+                scope.get(name), NamedSharding(self.mesh, spec)))

@@ -3,13 +3,18 @@ imports, so mesh/sharding tests run without TPU hardware (the driver's
 dryrun uses the same trick)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = \
         (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+# The image's sitecustomize imports jax at interpreter startup with the TPU
+# platform pinned, so the env vars above can come too late; force the
+# platform through the live config (backends are not initialized yet).
+jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_default_matmul_precision", "highest")
 
