@@ -304,9 +304,10 @@ class Executor:
         gb = program.global_block()
         feed_arrays: Dict[str, jnp.ndarray] = {}
         for name, val in feed.items():
-            arr = np.asarray(val)
+            # keep device-resident arrays on device (no host round-trip)
+            arr = val if isinstance(val, jax.Array) else np.asarray(val)
             if gb.has_var(name):
-                want = gb.var(name).dtype
+                want = jax.dtypes.canonicalize_dtype(gb.var(name).dtype)
                 if arr.dtype != want:
                     arr = arr.astype(want)
             feed_arrays[name] = arr
