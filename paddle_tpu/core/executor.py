@@ -311,6 +311,9 @@ class Executor:
                 if arr.dtype != want:
                     arr = arr.astype(want)
             feed_arrays[name] = arr
+        if not self.use_jit:
+            # eager interpreting: op lowerings expect jax arrays (.at etc.)
+            feed_arrays = {k: jnp.asarray(v) for k, v in feed_arrays.items()}
 
         state_keys = self._state_keys(program, scope)
         state = {k: scope.get(k) for k in state_keys}

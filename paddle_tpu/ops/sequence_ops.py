@@ -211,15 +211,13 @@ def _sequence_reshape(ctx, ins, attrs):
     x = ins["X"][0]
     new_dim = attrs["new_dim"]
     B, T, D = x.shape
-    factor = D // new_dim if D >= new_dim else 1
+    if (T * D) % new_dim:
+        raise ValueError(
+            f"sequence_reshape: T*D={T * D} not divisible by new_dim "
+            f"{new_dim}")
     lens = _seq_lens_or_full(ctx, x)
-    if D >= new_dim:
-        out = x.reshape(B, T * factor, new_dim)
-        new_lens = lens * factor
-    else:
-        factor = new_dim // D
-        out = x.reshape(B, T // factor, new_dim)
-        new_lens = lens // factor
+    out = x.reshape(B, T * D // new_dim, new_dim)
+    new_lens = (lens * D) // new_dim
     ctx.set_len(ctx.op.outputs["Out"][0], new_lens)
     return {"Out": out}
 

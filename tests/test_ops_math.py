@@ -1,0 +1,286 @@
+"""Per-op forward + numeric-gradient tests for math/elementwise/reduction/
+transform ops (reference: fluid/tests/test_elementwise_*_op.py,
+test_activation_op.py, test_reduce_op.py, test_matmul_op.py, ...)."""
+import numpy as np
+import pytest
+
+from op_test import check_grad, check_output
+
+R = np.random.RandomState(11)
+
+
+def _away_from_kinks(a, kinks=(0.0,), margin=0.05):
+    for k in kinks:
+        a = np.where(np.abs(a - k) < margin, a + 2 * margin, a)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary
+# ---------------------------------------------------------------------------
+ELTWISE = {
+    "elementwise_add": np.add,
+    "elementwise_sub": np.subtract,
+    "elementwise_mul": np.multiply,
+    "elementwise_div": np.divide,
+    "elementwise_max": np.maximum,
+    "elementwise_min": np.minimum,
+    "elementwise_pow": np.power,
+}
+
+
+@pytest.mark.parametrize("op", sorted(ELTWISE))
+def test_elementwise_forward(op):
+    x = R.uniform(0.5, 2.0, (3, 4)).astype("float32")
+    y = R.uniform(0.5, 2.0, (3, 4)).astype("float32")
+    check_output(op, {"X": ("x", x), "Y": ("y", y)}, {},
+                 {"Out": ELTWISE[op](x, y)})
+
+
+@pytest.mark.parametrize("op", ["elementwise_add", "elementwise_sub",
+                                "elementwise_mul", "elementwise_div"])
+def test_elementwise_grad(op):
+    x = R.uniform(0.5, 2.0, (3, 4)).astype("float32")
+    y = R.uniform(0.5, 2.0, (3, 4)).astype("float32")
+    check_grad(op, {"X": ("x", x), "Y": ("y", y)}, {}, wrt=["x", "y"])
+
+
+def test_elementwise_add_broadcast_axis():
+    """fluid broadcast: Y [C] added over axis=1 of X [N,C,H,W]."""
+    x = R.rand(2, 3, 4, 5).astype("float32")
+    y = R.rand(3).astype("float32")
+    check_output("elementwise_add", {"X": ("x", x), "Y": ("y", y)},
+                 {"axis": 1}, {"Out": x + y.reshape(1, 3, 1, 1)})
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+ACT = {
+    "sigmoid": lambda x: 1 / (1 + np.exp(-x)),
+    "tanh": np.tanh,
+    "relu": lambda x: np.maximum(x, 0),
+    "exp": np.exp,
+    "abs": np.abs,
+    "square": np.square,
+    "sqrt": np.sqrt,
+    "reciprocal": lambda x: 1 / x,
+    "log": np.log,
+    "softplus": lambda x: np.log1p(np.exp(x)),
+    "softsign": lambda x: x / (1 + np.abs(x)),
+    "ceil": np.ceil,
+    "floor": np.floor,
+    "round": np.round,
+}
+
+
+@pytest.mark.parametrize("op", sorted(ACT))
+def test_activation_forward(op):
+    x = R.uniform(0.2, 2.0, (3, 5)).astype("float32")
+    check_output(op, {"X": ("x", x)}, {}, {"Out": ACT[op](x)}, atol=1e-4)
+
+
+@pytest.mark.parametrize("op", ["sigmoid", "tanh", "relu", "exp", "square",
+                                "sqrt", "log", "softplus", "softsign"])
+def test_activation_grad(op):
+    x = _away_from_kinks(
+        R.uniform(0.3, 1.5, (3, 4)).astype("float32"))
+    check_grad(op, {"X": ("x", x)}, {}, wrt=["x"], max_relative_error=1e-2)
+
+
+def test_leaky_relu_and_elu():
+    x = _away_from_kinks(R.uniform(-2, 2, (3, 4)).astype("float32"))
+    check_output("leaky_relu", {"X": ("x", x)}, {"alpha": 0.1},
+                 {"Out": np.where(x > 0, x, 0.1 * x)})
+    check_output("elu", {"X": ("x", x)}, {"alpha": 1.0},
+                 {"Out": np.where(x > 0, x, np.expm1(x))})
+
+
+def test_pow_scale_clip():
+    x = R.uniform(0.5, 2.0, (3, 4)).astype("float32")
+    check_output("pow", {"X": ("x", x)}, {"factor": 3.0}, {"Out": x ** 3})
+    check_output("scale", {"X": ("x", x)}, {"scale": 2.5, "bias": 0.5},
+                 {"Out": 2.5 * x + 0.5})
+    check_output("clip", {"X": ("x", x)}, {"min": 0.8, "max": 1.5},
+                 {"Out": np.clip(x, 0.8, 1.5)})
+    check_grad("scale", {"X": ("x", x)}, {"scale": 2.5}, wrt=["x"])
+
+
+def test_clip_by_norm():
+    x = R.uniform(-1, 1, (4, 4)).astype("float32") * 3
+    norm = np.sqrt((x ** 2).sum())
+    expected = x * (1.0 / max(norm, 1.0)) if norm > 1.0 else x
+    check_output("clip_by_norm", {"X": ("x", x)}, {"max_norm": 1.0},
+                 {"Out": expected}, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# matmul family
+# ---------------------------------------------------------------------------
+def test_mul_op_2d():
+    x = R.rand(4, 6).astype("float32")
+    y = R.rand(6, 3).astype("float32")
+    check_output("mul", {"X": ("x", x), "Y": ("y", y)},
+                 {"x_num_col_dims": 1, "y_num_col_dims": 1}, {"Out": x @ y})
+    check_grad("mul", {"X": ("x", x), "Y": ("y", y)},
+               {"x_num_col_dims": 1, "y_num_col_dims": 1}, wrt=["x", "y"])
+
+
+def test_mul_op_flatten():
+    x = R.rand(2, 3, 4).astype("float32")
+    y = R.rand(12, 5).astype("float32")
+    check_output("mul", {"X": ("x", x), "Y": ("y", y)},
+                 {"x_num_col_dims": 1, "y_num_col_dims": 1},
+                 {"Out": x.reshape(2, 12) @ y})
+
+
+@pytest.mark.parametrize("tx,ty", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_matmul_transposes(tx, ty):
+    a = R.rand(4, 5).astype("float32")
+    b = R.rand(5, 3).astype("float32")
+    x = a.T.copy() if tx else a
+    y = b.T.copy() if ty else b
+    check_output("matmul", {"X": ("x", x), "Y": ("y", y)},
+                 {"transpose_X": tx, "transpose_Y": ty}, {"Out": a @ b})
+
+
+def test_matmul_batched():
+    x = R.rand(2, 4, 5).astype("float32")
+    y = R.rand(2, 5, 3).astype("float32")
+    check_output("matmul", {"X": ("x", x), "Y": ("y", y)}, {},
+                 {"Out": np.matmul(x, y)})
+    check_grad("matmul", {"X": ("x", x), "Y": ("y", y)}, {}, wrt=["x", "y"])
+
+
+def test_sum_op():
+    xs = [R.rand(3, 4).astype("float32") for _ in range(3)]
+    check_output("sum", {"X": [("a", xs[0]), ("b", xs[1]), ("c", xs[2])]},
+                 {}, {"Out": xs[0] + xs[1] + xs[2]})
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+RED = {"reduce_sum": np.sum, "reduce_mean": np.mean,
+       "reduce_max": np.max, "reduce_min": np.min, "reduce_prod": np.prod}
+
+
+@pytest.mark.parametrize("op", sorted(RED))
+@pytest.mark.parametrize("dim,keep", [([0], False), ([1], True),
+                                      (None, False)])
+def test_reduce_forward(op, dim, keep):
+    x = R.uniform(0.5, 1.5, (3, 4)).astype("float32")
+    attrs = {"keep_dim": keep}
+    if dim is None:
+        attrs["reduce_all"] = True
+        exp = RED[op](x, keepdims=keep)
+    else:
+        attrs["dim"] = dim
+        exp = RED[op](x, axis=tuple(dim), keepdims=keep)
+    check_output(op, {"X": ("x", x)}, attrs, {"Out": np.asarray(exp)})
+
+
+def test_reduce_sum_grad():
+    x = R.rand(3, 4).astype("float32")
+    check_grad("reduce_sum", {"X": ("x", x)}, {"dim": [1]}, wrt=["x"])
+    check_grad("reduce_mean", {"X": ("x", x)}, {"reduce_all": True},
+               wrt=["x"])
+
+
+# ---------------------------------------------------------------------------
+# shape transforms
+# ---------------------------------------------------------------------------
+def test_reshape_transpose_concat_split():
+    x = R.rand(2, 6).astype("float32")
+    check_output("reshape", {"X": ("x", x)}, {"shape": [3, 4]},
+                 {"Out": x.reshape(3, 4)})
+    check_output("transpose", {"X": ("x", x)}, {"axis": [1, 0]},
+                 {"Out": x.T})
+    y = R.rand(2, 6).astype("float32")
+    check_output("concat", {"X": [("x", x), ("y", y)]}, {"axis": 0},
+                 {"Out": np.concatenate([x, y], 0)})
+    check_output("split", {"X": ("x", x)}, {"num": 2, "axis": 1},
+                 {"Out~0": x[:, :3], "Out~1": x[:, 3:]})
+    check_grad("transpose", {"X": ("x", x)}, {"axis": [1, 0]}, wrt=["x"])
+
+
+def test_pad_and_crop():
+    x = R.rand(2, 3).astype("float32")
+    check_output("pad", {"X": ("x", x)},
+                 {"paddings": [1, 0, 0, 2], "pad_value": 0.5},
+                 {"Out": np.pad(x, ((1, 0), (0, 2)), constant_values=0.5)})
+    big = R.rand(4, 5).astype("float32")
+    check_output("crop", {"X": ("x", big)},
+                 {"offsets": [1, 2], "shape": [2, 3]},
+                 {"Out": big[1:3, 2:5]})
+
+
+def test_gather_scatter():
+    x = R.rand(5, 3).astype("float32")
+    idx = np.array([0, 2, 4])
+    check_output("gather", {"X": ("x", x), "Index": ("i", idx)}, {},
+                 {"Out": x[idx]})
+    upd = R.rand(3, 3).astype("float32")
+    exp = x.copy()
+    exp[idx] = upd
+    check_output("scatter",
+                 {"X": ("x", x), "Ids": ("i", idx), "Updates": ("u", upd)},
+                 {"overwrite": True}, {"Out": exp})
+
+
+def test_cast_sign_logical():
+    x = R.uniform(-2, 2, (3, 4)).astype("float32")
+    check_output("sign", {"X": ("x", x)}, {}, {"Out": np.sign(x)})
+    a = (R.rand(3, 4) > 0.5)
+    b = (R.rand(3, 4) > 0.5)
+    check_output("logical_and", {"X": ("x", a), "Y": ("y", b)}, {},
+                 {"Out": a & b})
+    check_output("logical_not", {"X": ("x", a)}, {}, {"Out": ~a})
+
+
+def test_compare_ops():
+    x = R.rand(3, 4).astype("float32")
+    y = R.rand(3, 4).astype("float32")
+    check_output("less_than", {"X": ("x", x), "Y": ("y", y)}, {},
+                 {"Out": x < y})
+    check_output("equal", {"X": ("x", x), "Y": ("x2", x.copy())}, {},
+                 {"Out": np.ones_like(x, bool)})
+
+
+def test_top_k():
+    x = R.rand(3, 6).astype("float32")
+    k = 2
+    idx = np.argsort(-x, axis=1)[:, :k]
+    val = np.take_along_axis(x, idx, 1)
+    got = check_output("top_k", {"X": ("x", x)}, {"k": k}, {"Out": val})
+
+
+def test_one_hot_and_multiplex():
+    ids = np.array([[1], [0], [3]])
+    exp = np.zeros((3, 4), "float32")
+    exp[np.arange(3), ids[:, 0]] = 1
+    check_output("one_hot", {"X": ("x", ids)}, {"depth": 4}, {"Out": exp})
+
+
+def test_cumsum_and_norm():
+    x = R.rand(3, 4).astype("float32")
+    check_output("cumsum", {"X": ("x", x)}, {"axis": 1},
+                 {"Out": np.cumsum(x, 1)})
+    check_output("norm", {"X": ("x", x)}, {"axis": 1, "epsilon": 1e-10},
+                 {"Out": x / np.sqrt((x**2).sum(1, keepdims=True) + 1e-10)},
+                 atol=1e-4)
+
+
+def test_fill_and_random_shapes():
+    from op_test import run_op
+    got = run_op("fill_constant", {}, {"shape": [2, 3], "value": 7.0,
+                                       "dtype": "float32"}, ["Out"])
+    np.testing.assert_allclose(got["out__out0"], np.full((2, 3), 7.0))
+    got = run_op("gaussian_random", {}, {"shape": [64, 64], "mean": 0.0,
+                                         "std": 1.0}, ["Out"])
+    assert abs(float(np.mean(got["out__out0"]))) < 0.1
+    got = run_op("uniform_random", {}, {"shape": [64, 64], "min": -1.0,
+                                        "max": 1.0}, ["Out"])
+    a = got["out__out0"]
+    assert a.min() >= -1 and a.max() <= 1 and abs(a.mean()) < 0.1

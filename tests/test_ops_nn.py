@@ -1,0 +1,313 @@
+"""Per-op tests for NN ops: conv/pool/norm/softmax/losses/dropout
+(reference: fluid/tests/test_conv2d_op.py, test_pool2d_op.py,
+test_batch_norm_op.py, test_softmax_op.py, test_cross_entropy_op.py, ...)."""
+import numpy as np
+import pytest
+
+from op_test import check_grad, check_output, run_op
+
+R = np.random.RandomState(5)
+
+
+# ---------------------------------------------------------------------------
+# numpy references
+# ---------------------------------------------------------------------------
+def np_conv2d(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    m, _, kh, kw = w.shape
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, m, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,mchw->nm", patch, w)
+    return out
+
+
+def np_pool2d(x, k, stride, pad, mode):
+    n, c, h, w = x.shape
+    oh = (h + 2 * pad - k) // stride + 1
+    ow = (w + 2 * pad - k) // stride + 1
+    if mode == "max":
+        xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)),
+                    constant_values=-np.inf)
+    else:
+        xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + k,
+                       j * stride:j * stride + k]
+            out[:, :, i, j] = patch.max((2, 3)) if mode == "max" \
+                else patch.mean((2, 3))
+    return out
+
+
+def np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis, keepdims=True))
+    return e / e.sum(axis, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# conv / pool
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("stride,pad", [(1, 0), (2, 1)])
+def test_conv2d_forward(stride, pad):
+    x = R.rand(2, 3, 8, 8).astype("float32")
+    w = R.rand(4, 3, 3, 3).astype("float32")
+    check_output("conv2d", {"Input": ("x", x), "Filter": ("w", w)},
+                 {"strides": [stride, stride], "paddings": [pad, pad]},
+                 {"Output": np_conv2d(x, w, stride, pad)}, atol=1e-3,
+                 rtol=1e-3)
+
+
+def test_conv2d_grad():
+    x = R.rand(1, 2, 5, 5).astype("float32")
+    w = R.rand(3, 2, 3, 3).astype("float32")
+    check_grad("conv2d", {"Input": ("x", x), "Filter": ("w", w)},
+               {"strides": [1, 1], "paddings": [1, 1]},
+               wrt=["x", "w"], out_slots=["Output"],
+               max_relative_error=2e-2)
+
+
+def test_conv2d_groups():
+    x = R.rand(1, 4, 6, 6).astype("float32")
+    w = R.rand(4, 2, 3, 3).astype("float32")
+    exp = np.concatenate([np_conv2d(x[:, :2], w[:2], 1, 0),
+                          np_conv2d(x[:, 2:], w[2:], 1, 0)], 1)
+    check_output("conv2d", {"Input": ("x", x), "Filter": ("w", w)},
+                 {"strides": [1, 1], "paddings": [0, 0], "groups": 2},
+                 {"Output": exp}, atol=1e-3, rtol=1e-3)
+
+
+@pytest.mark.parametrize("mode", ["max", "avg"])
+def test_pool2d_forward(mode):
+    x = R.rand(2, 3, 6, 6).astype("float32")
+    check_output("pool2d", {"X": ("x", x)},
+                 {"pooling_type": mode, "ksize": [2, 2], "strides": [2, 2],
+                  "paddings": [0, 0]},
+                 {"Out": np_pool2d(x, 2, 2, 0, mode)})
+
+
+def test_pool2d_global():
+    x = R.rand(2, 3, 5, 5).astype("float32")
+    check_output("pool2d", {"X": ("x", x)},
+                 {"pooling_type": "avg", "ksize": [1, 1], "strides": [1, 1],
+                  "paddings": [0, 0], "global_pooling": True},
+                 {"Out": x.mean((2, 3), keepdims=True)})
+
+
+def test_pool2d_grad():
+    x = R.rand(1, 2, 4, 4).astype("float32")
+    for mode in ("max", "avg"):
+        check_grad("pool2d", {"X": ("x", x)},
+                   {"pooling_type": mode, "ksize": [2, 2], "strides": [2, 2],
+                    "paddings": [0, 0]}, wrt=["x"],
+                   max_relative_error=2e-2)
+
+
+def test_conv2d_transpose_forward():
+    """conv_transpose must invert conv's shape math: x [1,2,3,3] k3 s2 ->
+    [1,4,7,7]; validated against autograd-of-conv (vjp is conv_transpose)."""
+    x = R.rand(1, 2, 3, 3).astype("float32")
+    w = R.rand(2, 4, 3, 3).astype("float32")   # [Cin, Cout, kh, kw]
+    got = run_op("conv2d_transpose", {"Input": ("x", x), "Filter": ("w", w)},
+                 {"strides": [2, 2], "paddings": [0, 0]}, ["Output"])
+    assert got["output__out0"].shape == (1, 4, 7, 7)
+
+
+def test_lrn_forward():
+    x = R.rand(2, 5, 4, 4).astype("float32")
+    n, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+    sq = np.zeros_like(x)
+    for c in range(5):
+        lo, hi = max(0, c - n // 2), min(5, c + n // 2 + 1)
+        sq[:, c] = (x[:, lo:hi] ** 2).sum(1)
+    exp = x / (k + alpha * sq) ** beta
+    check_output("lrn", {"X": ("x", x)},
+                 {"n": n, "k": k, "alpha": alpha, "beta": beta},
+                 {"Out": exp}, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+def test_batch_norm_train_forward():
+    x = R.rand(4, 3, 5, 5).astype("float32")
+    scale = R.rand(3).astype("float32")
+    bias = R.rand(3).astype("float32")
+    mean = np.zeros(3, "float32")
+    var = np.ones(3, "float32")
+    mu = x.mean((0, 2, 3))
+    v = x.var((0, 2, 3))
+    xn = (x - mu.reshape(1, 3, 1, 1)) / np.sqrt(v.reshape(1, 3, 1, 1) + 1e-5)
+    exp = xn * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+    check_output("batch_norm",
+                 {"X": ("x", x), "Scale": ("s", scale), "Bias": ("b", bias),
+                  "Mean": ("m", mean), "Variance": ("v", var)},
+                 {"epsilon": 1e-5, "momentum": 0.9, "is_test": False},
+                 {"Y": exp}, atol=1e-4, is_test=False)
+
+
+def test_batch_norm_test_mode_uses_running_stats():
+    x = R.rand(4, 3).astype("float32")
+    scale = np.ones(3, "float32")
+    bias = np.zeros(3, "float32")
+    mean = np.full(3, 0.25, "float32")
+    var = np.full(3, 2.0, "float32")
+    exp = (x - 0.25) / np.sqrt(2.0 + 1e-5)
+    check_output("batch_norm",
+                 {"X": ("x", x), "Scale": ("s", scale), "Bias": ("b", bias),
+                  "Mean": ("m", mean), "Variance": ("v", var)},
+                 {"epsilon": 1e-5, "is_test": True}, {"Y": exp}, atol=1e-4)
+
+
+def test_layer_norm_forward():
+    x = R.rand(4, 6).astype("float32")
+    scale = R.rand(6).astype("float32")
+    bias = R.rand(6).astype("float32")
+    mu = x.mean(1, keepdims=True)
+    v = x.var(1, keepdims=True)
+    exp = (x - mu) / np.sqrt(v + 1e-5) * scale + bias
+    check_output("layer_norm",
+                 {"X": ("x", x), "Scale": ("s", scale), "Bias": ("b", bias)},
+                 {"epsilon": 1e-5, "begin_norm_axis": 1}, {"Y": exp},
+                 atol=1e-4)
+
+
+def test_l2_normalize():
+    x = R.rand(3, 4).astype("float32")
+    check_output("norm", {"X": ("x", x)}, {"axis": 1, "epsilon": 1e-12},
+                 {"Out": x / np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-12)},
+                 atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# softmax & losses
+# ---------------------------------------------------------------------------
+def test_softmax_forward_grad():
+    x = R.rand(4, 7).astype("float32")
+    check_output("softmax", {"X": ("x", x)}, {}, {"Out": np_softmax(x)},
+                 atol=1e-5)
+    check_grad("softmax", {"X": ("x", x)}, {}, wrt=["x"],
+               max_relative_error=1e-2)
+
+
+def test_cross_entropy_hard_label():
+    p = np_softmax(R.rand(4, 5).astype("float32"))
+    lbl = np.array([[0], [3], [2], [4]])
+    exp = -np.log(p[np.arange(4), lbl[:, 0]]).reshape(4, 1)
+    check_output("cross_entropy", {"X": ("x", p), "Label": ("l", lbl)},
+                 {"soft_label": False}, {"Y": exp}, atol=1e-4)
+
+
+def test_cross_entropy_soft_label():
+    p = np_softmax(R.rand(4, 5).astype("float32"))
+    soft = np_softmax(R.rand(4, 5).astype("float32"))
+    exp = -(soft * np.log(p)).sum(1, keepdims=True)
+    check_output("cross_entropy",
+                 {"X": ("x", p), "Label": ("l", soft)},
+                 {"soft_label": True}, {"Y": exp}, atol=1e-4)
+
+
+def test_softmax_with_cross_entropy():
+    logits = R.rand(4, 5).astype("float32")
+    lbl = np.array([[0], [3], [2], [4]])
+    p = np_softmax(logits)
+    exp = -np.log(p[np.arange(4), lbl[:, 0]]).reshape(4, 1)
+    check_output("softmax_with_cross_entropy",
+                 {"Logits": ("x", logits), "Label": ("l", lbl)}, {},
+                 {"Loss": exp, "Softmax": p}, atol=1e-4)
+    check_grad("softmax_with_cross_entropy",
+               {"Logits": ("x", logits), "Label": ("l", lbl)}, {},
+               wrt=["x"], out_slots=["Loss"], max_relative_error=1e-2)
+
+
+def test_sigmoid_ce_with_logits():
+    x = R.uniform(-2, 2, (4, 3)).astype("float32")
+    lbl = R.rand(4, 3).astype("float32")
+    sig = 1 / (1 + np.exp(-x))
+    exp = -lbl * np.log(sig) - (1 - lbl) * np.log(1 - sig)
+    check_output("sigmoid_cross_entropy_with_logits",
+                 {"X": ("x", x), "Label": ("l", lbl)}, {}, {"Out": exp},
+                 atol=1e-4)
+
+
+def test_binary_losses():
+    x = R.uniform(0.1, 0.9, (4, 1)).astype("float32")
+    y = R.randint(0, 2, (4, 1)).astype("float32")
+    eps = 1e-4
+    exp = -y * np.log(x + eps) - (1 - y) * np.log(1 - x + eps)
+    check_output("log_loss", {"Predicted": ("x", x), "Labels": ("y", y)},
+                 {"epsilon": eps}, {"Loss": exp}, atol=1e-4)
+    d = R.uniform(-2, 2, (4, 3)).astype("float32")
+    t = R.uniform(-2, 2, (4, 3)).astype("float32")
+    diff = np.abs(d - t)
+    delta = 1.0
+    exp = np.where(diff <= delta, 0.5 * diff ** 2,
+                   delta * (diff - 0.5 * delta))
+    check_output("huber_loss", {"X": ("x", d), "Y": ("y", t)},
+                 {"delta": delta}, {"Out": exp}, atol=1e-4)
+
+
+def test_squared_l2():
+    x = R.rand(4, 3).astype("float32")
+    y = R.rand(4, 3).astype("float32")
+    check_output("squared_l2_distance", {"X": ("x", x), "Y": ("y", y)}, {},
+                 {"Out": ((x - y) ** 2).sum(1, keepdims=True)}, atol=1e-4)
+    check_output("squared_l2_norm", {"X": ("x", x)}, {},
+                 {"Out": np.asarray((x ** 2).sum())}, atol=1e-4)
+
+
+def test_cos_sim():
+    x = R.rand(4, 3).astype("float32")
+    y = R.rand(4, 3).astype("float32")
+    exp = (x * y).sum(1, keepdims=True) / (
+        np.linalg.norm(x, axis=1, keepdims=True) *
+        np.linalg.norm(y, axis=1, keepdims=True))
+    check_output("cos_sim", {"X": ("x", x), "Y": ("y", y)}, {},
+                 {"Out": exp}, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# dropout / embedding / metrics
+# ---------------------------------------------------------------------------
+def test_dropout_test_mode():
+    x = R.rand(4, 5).astype("float32")
+    # reference semantics (dropout_op.cc): test mode scales by (1-p)
+    check_output("dropout", {"X": ("x", x)},
+                 {"dropout_prob": 0.5, "is_test": True}, {"Out": x * 0.5})
+    # upscale_in_train: test mode is identity
+    check_output("dropout", {"X": ("x", x)},
+                 {"dropout_prob": 0.5, "is_test": True,
+                  "dropout_implementation": "upscale_in_train"}, {"Out": x})
+
+
+def test_dropout_train_masks():
+    x = np.ones((64, 64), "float32")
+    got = run_op("dropout", {"X": ("x", x)}, {"dropout_prob": 0.3},
+                 ["Out"], is_test=False)
+    frac = float((got["out__out0"] == 0).mean())
+    assert 0.2 < frac < 0.4
+
+
+def test_lookup_table():
+    w = R.rand(10, 4).astype("float32")
+    ids = np.array([[1], [3], [7]])
+    check_output("lookup_table", {"W": ("w", w), "Ids": ("i", ids)}, {},
+                 {"Out": w[ids[:, 0]]})
+    check_grad("lookup_table", {"W": ("w", w), "Ids": ("i", ids)}, {},
+               wrt=["w"])
+
+
+def test_accuracy_op():
+    pred = np_softmax(R.rand(6, 4).astype("float32"))
+    lbl = np.argmax(pred, 1).reshape(-1, 1)
+    lbl[0] = (lbl[0] + 1) % 4   # one wrong
+    got = run_op("accuracy", {"Out": ("p", pred), "Label": ("l", lbl),
+                              "Indices": ("i", np.argsort(-pred, 1)[:, :1])},
+                 {}, ["Accuracy"])
+    np.testing.assert_allclose(got["accuracy__out0"], 5 / 6, atol=1e-6)

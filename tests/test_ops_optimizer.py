@@ -1,0 +1,144 @@
+"""Per-op tests for optimizer ops vs numpy references (reference:
+fluid/tests/test_sgd_op.py, test_momentum_op.py, test_adam_op.py, ...)."""
+import numpy as np
+
+from op_test import run_op
+
+R = np.random.RandomState(9)
+N = (4, 3)
+LR = np.array([0.1], "float32")
+
+
+def _pg():
+    return (R.uniform(-1, 1, N).astype("float32"),
+            R.uniform(-1, 1, N).astype("float32"))
+
+
+def test_sgd_op():
+    p, g = _pg()
+    got = run_op("sgd", {"Param": ("p", p), "Grad": ("g", g),
+                         "LearningRate": ("lr", LR)}, {}, ["ParamOut"])
+    np.testing.assert_allclose(got["paramout__out0"], p - 0.1 * g,
+                               rtol=1e-6)
+
+
+def test_momentum_op():
+    p, g = _pg()
+    v = R.uniform(-1, 1, N).astype("float32")
+    got = run_op("momentum",
+                 {"Param": ("p", p), "Grad": ("g", g), "Velocity": ("v", v),
+                  "LearningRate": ("lr", LR)},
+                 {"mu": 0.9}, ["ParamOut", "VelocityOut"])
+    v_out = 0.9 * v + g
+    np.testing.assert_allclose(got["velocityout__out0"], v_out, rtol=1e-6)
+    np.testing.assert_allclose(got["paramout__out0"], p - 0.1 * v_out,
+                               rtol=1e-5)
+    # nesterov variant
+    got = run_op("momentum",
+                 {"Param": ("p", p), "Grad": ("g", g), "Velocity": ("v", v),
+                  "LearningRate": ("lr", LR)},
+                 {"mu": 0.9, "use_nesterov": True}, ["ParamOut"])
+    np.testing.assert_allclose(got["paramout__out0"],
+                               p - (g + 0.9 * v_out) * 0.1, rtol=1e-5)
+
+
+def test_adam_op():
+    p, g = _pg()
+    m = R.uniform(-1, 1, N).astype("float32")
+    v = R.uniform(0, 1, N).astype("float32")
+    b1p = np.array([0.9 ** 3], "float32")
+    b2p = np.array([0.999 ** 3], "float32")
+    got = run_op("adam",
+                 {"Param": ("p", p), "Grad": ("g", g), "Moment1": ("m", m),
+                  "Moment2": ("v", v), "Beta1Pow": ("b1", b1p),
+                  "Beta2Pow": ("b2", b2p), "LearningRate": ("lr", LR)},
+                 {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+                 ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+                  "Beta2PowOut"])
+    m_out = 0.9 * m + 0.1 * g
+    v_out = 0.999 * v + 0.001 * g * g
+    lr_t = 0.1 * np.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - lr_t * m_out / (np.sqrt(v_out) + 1e-8)
+    np.testing.assert_allclose(got["paramout__out0"], p_out, rtol=1e-5)
+    np.testing.assert_allclose(got["beta1powout__out0"], b1p * 0.9,
+                               rtol=1e-6)
+
+
+def test_adagrad_op():
+    p, g = _pg()
+    mom = R.uniform(0, 1, N).astype("float32")
+    got = run_op("adagrad",
+                 {"Param": ("p", p), "Grad": ("g", g), "Moment": ("m", mom),
+                  "LearningRate": ("lr", LR)},
+                 {"epsilon": 1e-6}, ["ParamOut", "MomentOut"])
+    m_out = mom + g * g
+    np.testing.assert_allclose(got["momentout__out0"], m_out, rtol=1e-6)
+    np.testing.assert_allclose(
+        got["paramout__out0"], p - 0.1 * g / (np.sqrt(m_out) + 1e-6),
+        rtol=1e-5)
+
+
+def test_rmsprop_op():
+    p, g = _pg()
+    ms = R.uniform(0, 1, N).astype("float32")
+    mom = R.uniform(-1, 1, N).astype("float32")
+    got = run_op("rmsprop",
+                 {"Param": ("p", p), "Grad": ("g", g),
+                  "MeanSquare": ("ms", ms), "Moment": ("m", mom),
+                  "LearningRate": ("lr", LR)},
+                 {"decay": 0.95, "momentum": 0.8, "epsilon": 1e-6},
+                 ["ParamOut", "MomentOut", "MeanSquareOut"])
+    ms_out = 0.95 * ms + 0.05 * g * g
+    mom_out = 0.8 * mom + 0.1 * g / np.sqrt(ms_out + 1e-6)
+    np.testing.assert_allclose(got["meansquareout__out0"], ms_out, rtol=1e-5)
+    np.testing.assert_allclose(got["paramout__out0"], p - mom_out, rtol=1e-4)
+
+
+def test_adadelta_op():
+    p, g = _pg()
+    asg = R.uniform(0, 1, N).astype("float32")
+    asu = R.uniform(0, 1, N).astype("float32")
+    got = run_op("adadelta",
+                 {"Param": ("p", p), "Grad": ("g", g),
+                  "AvgSquaredGrad": ("a", asg),
+                  "AvgSquaredUpdate": ("u", asu)},
+                 {"rho": 0.95, "epsilon": 1e-6},
+                 ["ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"])
+    g2 = 0.95 * asg + 0.05 * g * g
+    upd = -np.sqrt((asu + 1e-6) / (g2 + 1e-6)) * g
+    np.testing.assert_allclose(got["avgsquaredgradout__out0"], g2, rtol=1e-5)
+    np.testing.assert_allclose(got["paramout__out0"], p + upd, rtol=1e-4)
+
+
+def test_full_optimizer_builders_train():
+    """Every host-side optimizer builder must assemble a runnable program
+    (fluid/optimizer.py:213-513 parity)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    for name, ctor in [
+            ("sgd", lambda: pt.optimizer.SGD(0.1)),
+            ("momentum", lambda: pt.optimizer.Momentum(0.1, momentum=0.9)),
+            ("adam", lambda: pt.optimizer.Adam(0.01)),
+            ("adamax", lambda: pt.optimizer.Adamax(0.01)),
+            ("adagrad", lambda: pt.optimizer.Adagrad(0.1)),
+            ("adadelta", lambda: pt.optimizer.Adadelta(0.1)),
+            ("decayed_adagrad", lambda: pt.optimizer.DecayedAdagrad(0.1)),
+            ("rmsprop", lambda: pt.optimizer.RMSProp(0.1)),
+            ("ftrl", lambda: pt.optimizer.Ftrl(0.1))]:
+        pt.core.reset_default_programs()
+        pt.core.reset_global_scope()
+        pt.unique_name.reset()
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        ctor().minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+        feeds = {"x": R.rand(8, 4).astype("float32"),
+                 "y": R.rand(8, 1).astype("float32")}
+        vals = [float(exe.run(feed=feeds, fetch_list=[loss])[0])
+                for _ in range(4)]
+        assert np.isfinite(vals).all(), name
+        assert vals[-1] < vals[0], f"{name} did not reduce loss: {vals}"

@@ -1,0 +1,229 @@
+"""Per-op tests for sequence/RNN ops over the padded+lengths representation
+(the LoD analog — reference: fluid/tests/test_seq_pool.py, test_lstm_op.py,
+test_gru_op.py, test_linear_chain_crf_op.py, ...)."""
+import numpy as np
+import pytest
+
+from op_test import check_grad, check_output, run_op
+
+R = np.random.RandomState(3)
+
+LENS = np.array([4, 2, 3])
+B, T, D = 3, 4, 5
+
+
+def _x():
+    x = R.rand(B, T, D).astype("float32")
+    for b in range(B):
+        x[b, LENS[b]:] = 0.0
+    return x
+
+
+def test_sequence_pool_modes():
+    x = _x()
+    m = (np.arange(T)[None] < LENS[:, None]).astype("float32")[..., None]
+    check_output("sequence_pool", {"X": ("x", x)}, {"pooltype": "SUM"},
+                 {"Out": (x * m).sum(1)}, lens={"x": LENS})
+    check_output("sequence_pool", {"X": ("x", x)}, {"pooltype": "AVERAGE"},
+                 {"Out": (x * m).sum(1) / LENS[:, None]}, lens={"x": LENS})
+    check_output("sequence_pool", {"X": ("x", x)}, {"pooltype": "SQRT"},
+                 {"Out": (x * m).sum(1) / np.sqrt(LENS)[:, None]},
+                 lens={"x": LENS})
+    exp_max = np.stack([x[b, :LENS[b]].max(0) for b in range(B)])
+    check_output("sequence_pool", {"X": ("x", x)}, {"pooltype": "MAX"},
+                 {"Out": exp_max}, lens={"x": LENS})
+    exp_last = np.stack([x[b, LENS[b] - 1] for b in range(B)])
+    check_output("sequence_pool", {"X": ("x", x)}, {"pooltype": "LAST"},
+                 {"Out": exp_last}, lens={"x": LENS})
+    check_output("sequence_pool", {"X": ("x", x)}, {"pooltype": "FIRST"},
+                 {"Out": x[:, 0]}, lens={"x": LENS})
+
+
+def test_sequence_pool_grad():
+    x = _x()
+    check_grad("sequence_pool", {"X": ("x", x)}, {"pooltype": "AVERAGE"},
+               wrt=["x"], lens={"x": LENS})
+
+
+def test_sequence_softmax():
+    x = R.rand(B, T).astype("float32")
+    exp = np.zeros_like(x)
+    for b in range(B):
+        e = np.exp(x[b, :LENS[b]] - x[b, :LENS[b]].max())
+        exp[b, :LENS[b]] = e / e.sum()
+    check_output("sequence_softmax", {"X": ("x", x)}, {}, {"Out": exp},
+                 lens={"x": LENS}, atol=1e-5)
+
+
+def test_sequence_expand():
+    x = R.rand(B, D).astype("float32")
+    y = R.rand(B, T, 2).astype("float32")
+    m = (np.arange(T)[None] < LENS[:, None]).astype("float32")
+    exp = x[:, None, :] * m[..., None]
+    check_output("sequence_expand", {"X": ("x", x), "Y": ("y", y)}, {},
+                 {"Out": exp}, lens={"y": LENS})
+
+
+def test_sequence_reverse():
+    x = _x()
+    exp = np.zeros_like(x)
+    for b in range(B):
+        exp[b, :LENS[b]] = x[b, :LENS[b]][::-1]
+    check_output("sequence_reverse", {"X": ("x", x)}, {}, {"Y": exp},
+                 lens={"x": LENS})
+
+
+def test_sequence_concat():
+    x1 = _x()
+    l2 = np.array([1, 3, 2])
+    x2 = R.rand(B, 3, D).astype("float32")
+    for b in range(B):
+        x2[b, l2[b]:] = 0
+    out_T = 7
+    exp = np.zeros((B, out_T, D), "float32")
+    for b in range(B):
+        seq = np.concatenate([x1[b, :LENS[b]], x2[b, :l2[b]]])
+        exp[b, :len(seq)] = seq
+    got = run_op("sequence_concat", {"X": [("a", x1), ("b", x2)]}, {},
+                 ["Out"], lens={"a": LENS, "b": l2})
+    np.testing.assert_allclose(got["out__out0"][:, :out_T], exp, atol=1e-6)
+
+
+def test_sequence_slice_and_reshape():
+    x = _x()
+    off = np.array([[1], [0], [1]])
+    length = np.array([[2], [1], [2]])
+    got = run_op("sequence_slice",
+                 {"X": ("x", x), "Offset": ("o", off),
+                  "Length": ("l", length)}, {}, ["Out"], lens={"x": LENS})
+    out = got["out__out0"]
+    for b in range(B):
+        np.testing.assert_allclose(
+            out[b, :length[b, 0]], x[b, off[b, 0]:off[b, 0] + length[b, 0]])
+    x2 = R.rand(2, 3, 4).astype("float32")
+    got = run_op("sequence_reshape", {"X": ("x", x2)}, {"new_dim": 6},
+                 ["Out"])
+    assert got["out__out0"].shape == (2, 2, 6)
+
+
+def test_lstm_op_matches_numpy():
+    H = 4
+    x = R.uniform(-0.5, 0.5, (B, T, 4 * H)).astype("float32")
+    w = R.uniform(-0.5, 0.5, (H, 4 * H)).astype("float32")
+    bias = R.uniform(-0.1, 0.1, (1, 4 * H)).astype("float32")
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    hid = np.zeros((B, T, H), "float32")
+    h = np.zeros((B, H), "float32")
+    c = np.zeros((B, H), "float32")
+    for t in range(T):
+        gates = x[:, t] + h @ w + bias
+        gi, gf, gc, go = np.split(gates, 4, 1)
+        i, f, o = sig(gi), sig(gf), sig(go)
+        cand = np.tanh(gc)
+        c_new = f * c + i * cand
+        h_new = o * np.tanh(c_new)
+        alive = (t < LENS)[:, None]
+        h = np.where(alive, h_new, h)
+        c = np.where(alive, c_new, c)
+        hid[:, t] = np.where(alive, h_new, 0)
+    check_output("lstm",
+                 {"Input": ("x", x), "Weight": ("w", w), "Bias": ("b", bias)},
+                 {"use_peepholes": False}, {"Hidden": hid},
+                 lens={"x": LENS}, atol=1e-5)
+
+
+def test_lstm_grad():
+    H = 3
+    x = R.uniform(-0.5, 0.5, (2, 3, 4 * H)).astype("float32")
+    w = R.uniform(-0.5, 0.5, (H, 4 * H)).astype("float32")
+    b = R.uniform(-0.1, 0.1, (1, 4 * H)).astype("float32")
+    check_grad("lstm",
+               {"Input": ("x", x), "Weight": ("w", w), "Bias": ("b", b)},
+               {"use_peepholes": False}, wrt=["x", "w"],
+               out_slots=["Hidden"], lens={"x": np.array([3, 2])},
+               max_relative_error=2e-2)
+
+
+def test_gru_op_shapes_and_mask():
+    H = 4
+    x = R.uniform(-0.5, 0.5, (B, T, 3 * H)).astype("float32")
+    w = R.uniform(-0.5, 0.5, (H, 3 * H)).astype("float32")
+    b = np.zeros((1, 3 * H), "float32")
+    got = run_op("gru", {"Input": ("x", x), "Weight": ("w", w),
+                         "Bias": ("b", b)}, {}, ["Hidden"],
+                 lens={"x": LENS})
+    hid = got["hidden__out0"]
+    assert hid.shape == (B, T, H)
+    for b_ in range(B):
+        if LENS[b_] < T:
+            assert np.abs(hid[b_, LENS[b_]:]).max() == 0.0
+
+
+def test_linear_chain_crf_loglik():
+    """CRF negative log-likelihood vs brute-force enumeration."""
+    ntags = 3
+    lens = np.array([3, 2])
+    emission = R.uniform(-1, 1, (2, 3, ntags)).astype("float32")
+    trans = R.uniform(-0.5, 0.5, (ntags + 2, ntags)).astype("float32")
+    label = np.array([[0, 2, 1], [1, 0, 0]])
+
+    def path_score(e, lab, L):
+        s = trans[0, lab[0]]                      # start
+        for t in range(L):
+            s += e[t, lab[t]]
+            if t > 0:
+                s += trans[lab[t - 1] + 2, lab[t]]
+        s += trans[1, lab[L - 1]]                 # stop
+        return s
+
+    import itertools
+    exp_ll = np.zeros((2, 1), "float32")
+    for b in range(2):
+        L = lens[b]
+        logZ = np.log(sum(
+            np.exp(path_score(emission[b], list(lab), L))
+            for lab in itertools.product(range(ntags), repeat=L)))
+        exp_ll[b, 0] = logZ - path_score(emission[b], label[b], L)
+    got = run_op("linear_chain_crf",
+                 {"Emission": ("e", emission), "Transition": ("t", trans),
+                  "Label": ("l", label)}, {}, ["LogLikelihood"],
+                 lens={"e": lens, "l": lens})
+    np.testing.assert_allclose(got["loglikelihood__out0"], exp_ll,
+                               atol=1e-3, rtol=1e-3)
+
+
+def test_crf_decoding_viterbi():
+    ntags = 3
+    lens = np.array([3])
+    emission = R.uniform(-1, 1, (1, 3, ntags)).astype("float32")
+    trans = R.uniform(-0.5, 0.5, (ntags + 2, ntags)).astype("float32")
+
+    import itertools
+    best, best_s = None, -1e30
+    for lab in itertools.product(range(ntags), repeat=3):
+        s = trans[0, lab[0]] + trans[1, lab[-1]]
+        for t in range(3):
+            s += emission[0, t, lab[t]]
+            if t:
+                s += trans[lab[t - 1] + 2, lab[t]]
+        if s > best_s:
+            best, best_s = lab, s
+    got = run_op("crf_decoding",
+                 {"Emission": ("e", emission), "Transition": ("t", trans)},
+                 {}, ["ViterbiPath"], lens={"e": lens})
+    np.testing.assert_array_equal(
+        got["viterbipath__out0"][0, :3].reshape(-1), np.array(best))
+
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 0]])
+    ref = np.array([[1, 3, 3, 2]])
+    got = run_op("edit_distance",
+                 {"Hyps": ("h", hyp), "Refs": ("r", ref)},
+                 {"normalized": False}, ["Out"],
+                 lens={"h": np.array([3]), "r": np.array([4])})
+    # hyp [1,2,3] vs ref [1,3,3,2]: substitute 2->3, insert 2 => distance 2
+    np.testing.assert_allclose(got["out__out0"].reshape(-1), [2.0])
