@@ -1,0 +1,141 @@
+"""Beam-search generation.
+
+Reference capability: RecurrentGradientMachine generation mode
+(gserver/gradientmachines/RecurrentGradientMachine.h:307-309 generateSequence
+/beamSearch + SWIG SequenceGenerator), and fluid's while_op + beam_search_op
++ beam_search_decode_op pipeline (operators/beam_search_op.h:88,177,
+beam_search_decode_op).
+
+TPU-native redesign: the decode loop is a ``beam_search`` op holding the
+user's per-step sub-block (same machinery as the rnn op).  The lowering runs
+a lax.scan over ``max_len`` steps with STATIC shapes — beams are flattened
+into the batch ([B*K] rows), expansion is one top-k over [B, K*V], and the
+backtrace (the beam_search_decode analog) is a second scan over recorded
+(parent, token) tables.  No dynamic LoD trees: finished beams are frozen by
+masking, which keeps every step identical for XLA.
+
+Usage::
+
+    bs = BeamSearchDecoder(beam_size=4, bos_id=0, eos_id=1, max_len=16,
+                           vocab_size=V)
+    with bs.step():
+        tok = bs.token()                  # [B*K] int32 current tokens
+        state = bs.memory(init=dec_init)  # [B*K, H] (pre-tiled to beams)
+        ... compute probs [B*K, V] from (tok, state) ...
+        bs.update_memory(state, new_state)
+        bs.set_probs(probs)
+    ids, scores = bs()                    # [B, K, max_len], [B, K]
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..core import unique_name
+from ..layer_helper import LayerHelper
+
+__all__ = ["BeamSearchDecoder"]
+
+
+class BeamSearchDecoder:
+    def __init__(self, beam_size, bos_id, eos_id, max_len, vocab_size,
+                 length_penalty=0.0, name=None):
+        self.helper = LayerHelper("beam_search", name=name)
+        self.program = self.helper.main_program
+        self.beam_size = beam_size
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        self.max_len = max_len
+        self.vocab_size = vocab_size
+        self.length_penalty = length_penalty
+        self.memories = {}      # step name -> [init var, update name]
+        self.contexts = {}      # step name -> parent var
+        self.token_var = None
+        self.probs_var = None
+        self.sub_block = None
+        self.parent_block = None
+        self.outputs = None
+
+    @contextlib.contextmanager
+    def step(self):
+        self.parent_block = self.program.current_block()
+        self.sub_block = self.program.create_block()
+        try:
+            yield
+        finally:
+            self.program.rollback()
+            self._complete()
+
+    def token(self):
+        """Current token ids, one per live beam: int32 [B*K]."""
+        assert self.token_var is None, "token() called twice"
+        v = self.sub_block.create_var(
+            name=unique_name.generate("beam_token"), dtype="int32",
+            shape=(-1,))
+        self.token_var = v
+        return v
+
+    def memory(self, init):
+        """Per-beam state from a per-sequence init [B, ...]; the lowering
+        tiles it to [B*K, ...] (batch-flattened beams)."""
+        mem = self.sub_block.create_var(
+            name=unique_name.generate("beam_mem"), dtype=init.dtype,
+            shape=init.shape)
+        self.memories[mem.name] = [init, None]
+        return mem
+
+    def context(self, x):
+        """Register a read-only per-sequence tensor [B, ...] (e.g. encoder
+        outputs); returns the step-block view tiled to [B*K, ...]."""
+        v = self.sub_block.create_var(
+            name=unique_name.generate("beam_ctx"), dtype=x.dtype,
+            shape=x.shape, lod_level=x.lod_level)
+        self.contexts[v.name] = x
+        return v
+
+    def update_memory(self, mem, new):
+        self.memories[mem.name][1] = new.name
+
+    def set_probs(self, probs):
+        """Next-token probabilities [B*K, V] (post-softmax)."""
+        self.probs_var = probs
+
+    def _complete(self):
+        assert self.token_var is not None, "step block must call token()"
+        assert self.probs_var is not None, "step block must set_probs()"
+        ids = self.parent_block.create_var(
+            name=unique_name.generate("beam_ids"), dtype="int32",
+            shape=(-1, self.beam_size, self.max_len))
+        scores = self.parent_block.create_var(
+            name=unique_name.generate("beam_scores"), dtype="float32",
+            shape=(-1, self.beam_size))
+        lens = self.parent_block.create_var(
+            name=unique_name.generate("beam_lens"), dtype="int32",
+            shape=(-1, self.beam_size))
+        mem_names = list(self.memories)
+        ctx_names = list(self.contexts)
+        self.parent_block.append_op(
+            "beam_search",
+            inputs={"InitStates": [self.memories[m][0].name
+                                   for m in mem_names],
+                    "Contexts": [self.contexts[c].name for c in ctx_names]},
+            outputs={"Ids": [ids.name], "Scores": [scores.name],
+                     "Lens": [lens.name]},
+            attrs={
+                "sub_block": self.sub_block.idx,
+                "token_name": self.token_var.name,
+                "probs_name": self.probs_var.name,
+                "mem_step_names": mem_names,
+                "mem_update_names": [self.memories[m][1]
+                                     for m in mem_names],
+                "ctx_step_names": ctx_names,
+                "beam_size": self.beam_size,
+                "bos_id": self.bos_id,
+                "eos_id": self.eos_id,
+                "max_len": self.max_len,
+                "vocab_size": self.vocab_size,
+                "length_penalty": self.length_penalty,
+            })
+        self.outputs = (ids, scores, lens)
+
+    def __call__(self):
+        return self.outputs

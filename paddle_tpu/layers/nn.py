@@ -19,6 +19,7 @@ __all__ = [
     "sequence_first_step", "sequence_last_step", "sequence_concat",
     "sequence_reshape", "sequence_slice", "sequence_reverse", "lod_reset",
     "topk", "lrn", "maxout", "row_conv", "im2sequence", "one_hot", "reshape",
+    "expand",
     "squeeze", "unsqueeze", "reduce_sum", "reduce_mean", "reduce_max",
     "reduce_min", "reduce_prod", "split", "l2_normalize", "matmul", "mul",
     "cos_sim", "scale", "clip", "clip_by_norm", "mean", "accuracy", "auc",
@@ -508,6 +509,20 @@ def unsqueeze(input, axes, name=None):
     out = helper.create_variable_for_type_inference(input.dtype, shape)
     helper.append_op(type="unsqueeze", inputs={"X": [input]},
                      outputs={"Out": [out]}, attrs={"axes": list(axes)})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    """expand_op: tile each dim by expand_times (fluid layers.expand)."""
+    helper = LayerHelper("expand", name=name)
+    shape = None
+    if x.shape is not None:
+        shape = tuple(s * t if s is not None and s >= 0 else s
+                      for s, t in zip(x.shape, expand_times))
+    out = helper.create_variable_for_type_inference(x.dtype, shape)
+    helper.append_op(type="expand", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"expand_times": list(expand_times)})
     return out
 
 

@@ -13,11 +13,11 @@ from .vgg import vgg16, vgg19, vgg_cifar
 from .resnet import resnet_imagenet, resnet50, resnet_cifar
 from .googlenet import googlenet
 from .lstm_textcls import lstm_text_classification
-from .seq2seq import seq2seq_attention
+from .seq2seq import seq2seq_attention, seq2seq_infer
 from .wide_deep import wide_deep
 
 __all__ = [
     "mnist_mlp", "mnist_lenet", "alexnet", "vgg16", "vgg19", "vgg_cifar",
     "resnet_imagenet", "resnet50", "resnet_cifar", "googlenet",
-    "lstm_text_classification", "seq2seq_attention", "wide_deep",
+    "lstm_text_classification", "seq2seq_attention", "seq2seq_infer", "wide_deep",
 ]

@@ -20,6 +20,7 @@ from . import embedding_ops   # noqa: F401
 from . import io_ops          # noqa: F401
 from . import detection_ops   # noqa: F401
 from . import crf_ops         # noqa: F401
+from . import generation_ops  # noqa: F401
 
 
 @register_op("backward")

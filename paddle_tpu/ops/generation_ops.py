@@ -1,0 +1,106 @@
+"""beam_search op lowering (see layers/generation.py for the design notes;
+reference: beam_search_op.h:88 BeamSearch::operator(), RecurrentGradientMachine
+beamSearch, beam_search_decode_op trace-back)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+NEG_INF = -1e30
+
+
+@register_op("beam_search")
+def _beam_search(ctx, ins, attrs):
+    sub_idx = attrs["sub_block"]
+    token_name = attrs["token_name"]
+    probs_name = attrs["probs_name"]
+    mem_names = attrs["mem_step_names"]
+    mem_update_names = attrs["mem_update_names"]
+    K = int(attrs["beam_size"])
+    bos = int(attrs["bos_id"])
+    eos = int(attrs["eos_id"])
+    T = int(attrs["max_len"])
+    V = int(attrs["vocab_size"])
+    lp = float(attrs.get("length_penalty", 0.0))
+
+    ctx_names = attrs.get("ctx_step_names", [])
+    inits = [jnp.repeat(v, K, axis=0) for v in ins.get("InitStates", [])]
+    ctxs = [jnp.repeat(v, K, axis=0) for v in ins.get("Contexts", [])]
+    B = ins["InitStates"][0].shape[0]
+    BK = B * K
+    env = ctx.env
+
+    def run_step(tokens_flat, mems):
+        benv = ctx.child_env(sub_idx, env)
+        benv.local[token_name] = tokens_flat
+        for nm, v in zip(mem_names, mems):
+            benv.local[nm] = v
+        for nm, v in zip(ctx_names, ctxs):
+            benv.local[nm] = v
+        ctx.interpret_block(sub_idx, benv)
+        probs = benv.get(probs_name)
+        new_mems = tuple(benv.get(un) if un else old
+                         for un, old in zip(mem_update_names, mems))
+        return probs, new_mems
+
+    def step(carry, t):
+        tokens, cum, finished, mems, flens = carry
+        # tokens [B,K] int32; cum [B,K] log-prob; finished [B,K] bool;
+        # flens [B,K] generated length
+        probs, new_mems = run_step(tokens.reshape(BK), mems)
+        logp = jnp.log(jnp.maximum(probs, 1e-20)).reshape(B, K, V)
+        # finished beams: freeze — only a virtual <pad>=eos continuation
+        # with prob 1 so their score is carried unchanged
+        frozen = jnp.full((B, K, V), NEG_INF).at[:, :, eos].set(0.0)
+        logp = jnp.where(finished[..., None], frozen, logp)
+        total = cum[..., None] + logp                      # [B,K,V]
+        # first step: all K beams are identical copies of bos — keep only
+        # beam 0's candidates so the frontier isn't K duplicates
+        first = (t == 0)
+        dup_mask = jnp.where(
+            first & (jnp.arange(K)[None, :, None] > 0), NEG_INF, 0.0)
+        flat = (total + dup_mask).reshape(B, K * V)
+        top_val, top_idx = lax.top_k(flat, K)              # [B,K]
+        parent = (top_idx // V).astype(jnp.int32)
+        token = (top_idx % V).astype(jnp.int32)
+        b_idx = jnp.arange(B)[:, None]
+        was_finished = finished[b_idx, parent]
+        now_finished = was_finished | (token == eos)
+        new_flens = jnp.where(was_finished, flens[b_idx, parent],
+                              flens[b_idx, parent] + 1)
+        # reindex memories to selected parents (flattened gather)
+        flat_parent = (b_idx * K + parent).reshape(BK)
+        mems_sel = tuple(m.reshape((B * K,) + m.shape[1:])[flat_parent]
+                         for m in new_mems)
+        return ((token, top_val, now_finished, mems_sel, new_flens),
+                (token, parent))
+
+    tokens0 = jnp.full((B, K), bos, jnp.int32)
+    cum0 = jnp.zeros((B, K), jnp.float32)
+    fin0 = jnp.zeros((B, K), bool)
+    flens0 = jnp.zeros((B, K), jnp.int32)
+    mems0 = tuple(inits)
+    (tokens_f, cum_f, fin_f, _, flens_f), (tok_tab, par_tab) = lax.scan(
+        step, (tokens0, cum0, fin0, mems0, flens0), jnp.arange(T))
+    # tok_tab/par_tab: [T, B, K] — backtrace from final beams
+    b_idx = jnp.arange(B)[:, None]
+
+    def back(carry, t_rev):
+        beam = carry                                       # [B,K] beam index
+        tok = tok_tab[t_rev][b_idx, beam]
+        par = par_tab[t_rev][b_idx, beam]
+        return par, tok
+
+    _, rev_ids = lax.scan(back, jnp.tile(jnp.arange(K)[None], (B, 1)),
+                          jnp.arange(T - 1, -1, -1))
+    ids = jnp.flip(jnp.transpose(rev_ids, (1, 2, 0)), axis=-1)  # [B,K,T]
+    # mask everything after (and including) the first eos to eos
+    hit = jnp.cumsum((ids == eos).astype(jnp.int32), axis=-1)
+    ids = jnp.where(hit > 0, eos, ids)
+    scores = cum_f
+    if lp > 0:
+        scores = scores / jnp.power(flens_f.astype(jnp.float32) + 1e-6, lp)
+    return {"Ids": ids, "Scores": scores, "Lens": flens_f}

@@ -1,0 +1,127 @@
+"""Beam-search generation tests (reference: fluid test_beam_search_op.py,
+test_beam_search_decode_op.py; RecurrentGradientMachine generation golden
+tests trainer/tests/test_recurrent_machine_generation.cpp)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+
+def _markov_program(P, beam_size, max_len, bos, eos):
+    """Decoder whose next-token distribution depends only on the current
+    token: probs = P[token] — exactly computable in numpy."""
+    V = P.shape[0]
+    Pvar = layers.data("P", shape=[V, V], dtype="float32",
+                       append_batch_size=False)
+    init = layers.data("init", shape=[1], dtype="float32")
+    bs = layers.BeamSearchDecoder(beam_size=beam_size, bos_id=bos,
+                                  eos_id=eos, max_len=max_len, vocab_size=V)
+    with bs.step():
+        tok = bs.token()
+        mem = bs.memory(init=init)
+        probs = layers.gather(Pvar, tok)
+        bs.update_memory(mem, mem)
+        bs.set_probs(probs)
+    return bs()
+
+
+def test_beam_k1_matches_greedy_chain():
+    rng = np.random.RandomState(0)
+    V, T, bos, eos = 5, 4, 0, 4
+    P = rng.dirichlet(np.ones(V), size=V).astype("float32")
+    P[:, eos] = 1e-6           # never stop
+    P /= P.sum(1, keepdims=True)
+    ids_v, scores_v, lens_v = _markov_program(P, 1, T, bos, eos)
+    exe = pt.Executor()
+    ids, scores = exe.run(feed={"P": P, "init": np.zeros((2, 1), "float32")},
+                          fetch_list=[ids_v, scores_v])
+    tok, exp_ids, exp_score = bos, [], 0.0
+    for _ in range(T):
+        nxt = int(np.argmax(P[tok]))
+        exp_score += np.log(P[tok, nxt])
+        exp_ids.append(nxt)
+        tok = nxt
+    for b in range(2):
+        np.testing.assert_array_equal(ids[b, 0], exp_ids)
+        np.testing.assert_allclose(scores[b, 0], exp_score, rtol=1e-4)
+
+
+def test_beam_finds_better_than_greedy():
+    """Classic beam > greedy setup: a low-prob first step leads to a
+    near-deterministic tail."""
+    V, bos, eos = 4, 0, 3
+    P = np.full((V, V), 1e-9, "float32")
+    # from bos: token1 p=0.6, token2 p=0.4
+    P[0, 1], P[0, 2] = 0.6, 0.4
+    # token1 -> uniform-ish continuations (greedy path gets stuck cheap)
+    P[1, 1], P[1, 2] = 0.5, 0.5
+    # token2 -> token2 with p ~1 (the good tail)
+    P[2, 2] = 1.0
+    P /= P.sum(1, keepdims=True)
+    T = 3
+    ids_v, scores_v, _ = _markov_program(P, 2, T, bos, eos)
+    exe = pt.Executor()
+    ids, scores = exe.run(feed={"P": P, "init": np.zeros((1, 1), "float32")},
+                          fetch_list=[ids_v, scores_v])
+    # best: 2,2,2 with logp log(.4)  vs greedy 1,... log(.6)+2*log(.5)
+    np.testing.assert_array_equal(ids[0, 0], [2, 2, 2])
+    assert scores[0, 0] >= scores[0, 1] - 1e-6
+    np.testing.assert_allclose(scores[0, 0], np.log(0.4), rtol=1e-4)
+
+
+def test_beam_eos_freezes_score():
+    V, bos, eos = 3, 0, 2
+    P = np.full((V, V), 1e-9, "float32")
+    P[0, 2] = 0.9            # bos -> eos
+    P[0, 1] = 0.1
+    P[1, 1] = 1.0
+    P /= P.sum(1, keepdims=True)
+    ids_v, scores_v, lens_v = _markov_program(P, 2, 5, bos, eos)
+    exe = pt.Executor()
+    ids, scores, lens = exe.run(
+        feed={"P": P, "init": np.zeros((1, 1), "float32")},
+        fetch_list=[ids_v, scores_v, lens_v])
+    np.testing.assert_array_equal(ids[0, 0], [2] * 5)      # eos then frozen
+    np.testing.assert_allclose(scores[0, 0], np.log(P[0, 2]), rtol=1e-4)
+    assert int(lens[0, 0]) == 1
+
+
+def test_seq2seq_train_then_beam_decode(rng):
+    """Micro machine-translation book test: learn 'always emit token 3'
+    then check the decoder's top beam starts with it."""
+    V, H = 8, 16
+    src = layers.data("src", shape=[], dtype="int64", lod_level=1)
+    tgt = layers.data("tgt", shape=[], dtype="int64", lod_level=1)
+    lbl = layers.data("lbl", shape=[], dtype="int64", lod_level=1)
+    probs = models.seq2seq_attention(src, tgt, V, V, emb_dim=8, hidden_dim=H)
+    flat = layers.reshape(probs, [-1, V])
+    loss = layers.mean(layers.cross_entropy(
+        flat, layers.reshape(lbl, [-1, 1])))
+    opt = pt.optimizer.Adam(0.05)
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    B, Ts, Tt = 8, 5, 4
+    feeds = {"src": rng.randint(2, V, (B, Ts)),
+             "src@LEN": np.full(B, Ts),
+             "tgt": np.full((B, Tt), 3),
+             "tgt@LEN": np.full(B, Tt),
+             "lbl": np.full((B, Tt), 3),
+             "lbl@LEN": np.full(B, Tt)}
+    losses = [float(exe.run(feed=feeds, fetch_list=[loss])[0])
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5
+
+    infer_prog = pt.Program()
+    with pt.program_guard(infer_prog, pt.Program()):
+        src_i = layers.data("src", shape=[], dtype="int64", lod_level=1)
+        ids_v, scores_v, lens_v = models.seq2seq_infer(
+            src_i, V, V, emb_dim=8, hidden_dim=H, beam_size=3, bos_id=0,
+            eos_id=1, max_len=4)
+    ids, scores = exe.run(infer_prog,
+                          feed={"src": rng.randint(2, V, (2, Ts)),
+                                "src@LEN": np.full(2, Ts)},
+                          fetch_list=[ids_v, scores_v], is_test=True)
+    assert ids.shape == (2, 3, 4)
+    assert (scores[:, 0] + 1e-6 >= scores[:, 1]).all()
+    assert (ids[:, 0, 0] == 3).all()
