@@ -360,13 +360,21 @@ class Program:
         for op in reversed(gb.ops):
             if op.type in ("fetch", "feed"):
                 continue
-            produces = set(op.output_names)
-            if produces & needed:
-                kept.append(op)
-                needed |= set(op.input_names)
-                for sub_idx in _sub_block_indices(op):
-                    for sop in p.blocks[sub_idx].ops:
-                        needed |= set(sop.input_names)
+            produces = set(op.output_names) & needed
+            if not produces:
+                continue
+            # in-place updates (optimizer ops: ParamOut aliases Param) only
+            # *rewrite* existing vars — keeping them would drag the whole
+            # training section into an inference slice.  Ops with sub-blocks
+            # (while/rnn) legitimately alias their carries and are kept.
+            if not _sub_block_indices(op) and \
+                    produces <= set(op.input_names):
+                continue
+            kept.append(op)
+            needed |= set(op.input_names)
+            for sub_idx in _sub_block_indices(op):
+                for sop in p.blocks[sub_idx].ops:
+                    needed |= set(sop.input_names)
         gb.ops = list(reversed(kept))
         return p
 

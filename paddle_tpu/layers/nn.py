@@ -1096,9 +1096,16 @@ def linear_chain_crf(input, label, param_attr=None, name=None):
 
 
 def crf_decoding(input, param_attr, label=None, name=None):
-    helper = LayerHelper("crf_decoding", name=name)
-    transition = helper.main_program.global_block().var(
-        ParamAttr._to_attr(param_attr).name)
+    helper = LayerHelper("crf_decoding", param_attr=param_attr, name=name)
+    attr = ParamAttr._to_attr(param_attr)
+    gb = helper.main_program.global_block()
+    if attr.name and gb.has_var(attr.name):
+        transition = gb.var(attr.name)
+    else:
+        # standalone decode program: declare the (loaded) transition param
+        ntags = input.shape[-1]
+        transition = helper.create_parameter(
+            attr, shape=[ntags + 2, ntags], dtype=input.dtype)
     out = helper.create_variable_for_type_inference("int64", lod_level=1)
     ins = {"Emission": [input], "Transition": [transition]}
     if label is not None:

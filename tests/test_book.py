@@ -1,0 +1,240 @@
+"""End-to-end 'book' training tests (reference: fluid/tests/book/ — 11 full
+training scripts doubling as reference models; shrunk to synthetic data +
+loss-decrease assertions for CI, same as the reference runs them to a target
+cost)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models, nets
+
+
+def _fit(loss, feeds_fn, steps, opt=None, fetch=()):
+    opt = opt or pt.optimizer.SGD(learning_rate=0.01)
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    vals = []
+    for i in range(steps):
+        out = exe.run(feed=feeds_fn(i), fetch_list=[loss, *fetch])
+        vals.append(float(out[0]))
+    return vals, exe
+
+
+def test_fit_a_line(rng):
+    """book/test_fit_a_line.py: linear regression learns planted weights."""
+    true_w = np.array([[2.0], [-3.4]], "float32")
+    x = layers.data("x", shape=[2], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1, name="fit")
+    loss = layers.mean(layers.square_error_cost(pred, y))
+
+    def feeds(_):
+        xb = (rng.rand(32, 2) - 0.5).astype("float32")
+        return {"x": xb, "y": xb @ true_w + 4.2}
+
+    vals, exe = _fit(loss, feeds, steps=100,
+                     opt=pt.optimizer.SGD(learning_rate=0.5))
+    assert vals[-1] < 1e-2
+    w = np.asarray(pt.global_scope().get("fit.w_0"))
+    np.testing.assert_allclose(w, true_w, atol=0.2)
+
+
+def test_word2vec(rng):
+    """book/test_word2vec.py: N-gram LM — 4 context words -> next word."""
+    V, E = 30, 16
+    words = [layers.data(f"w{i}", shape=[1], dtype="int64")
+             for i in range(4)]
+    nxt = layers.data("next", shape=[1], dtype="int64")
+    embs = [layers.embedding(w, size=[V, E], param_attr=pt.ParamAttr(
+        name="shared_emb")) for w in words]
+    concat = layers.concat(embs, axis=1)
+    hidden = layers.fc(concat, size=64, act="sigmoid")
+    pred = layers.fc(hidden, size=V, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, nxt))
+
+    data = rng.randint(0, V, (16, 5))
+    data[:, 4] = (data[:, 0] + 1) % V     # learnable rule
+
+    def feeds(_):
+        return {**{f"w{i}": data[:, i:i + 1] for i in range(4)},
+                "next": data[:, 4:5]}
+
+    vals, _ = _fit(loss, feeds, steps=40,
+                   opt=pt.optimizer.Adam(learning_rate=0.05))
+    assert vals[-1] < vals[0] * 0.3
+
+
+def test_understand_sentiment_stacked_lstm(rng):
+    """book/test_understand_sentiment_lstm.py via stacked_lstm_net."""
+    V = 40
+    data = layers.data("words", shape=[], dtype="int64", lod_level=1)
+    label = layers.data("label", shape=[1], dtype="int64")
+    from paddle_tpu.models.lstm_textcls import stacked_lstm_net
+    pred = stacked_lstm_net(data, V, num_classes=2, emb_dim=8, hidden_dim=8,
+                            stacked_num=3)
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    acc = layers.accuracy(pred, label)
+
+    toks = rng.randint(2, V, (8, 10))
+    lab = (toks[:, 0] > V // 2).astype("int64").reshape(-1, 1)
+
+    def feeds(_):
+        return {"words": toks, "words@LEN": np.full(8, 10), "label": lab}
+
+    vals, exe = _fit(loss, feeds, steps=30,
+                     opt=pt.optimizer.Adam(learning_rate=0.05),
+                     fetch=(acc,))
+    assert vals[-1] < vals[0] * 0.6
+
+
+def test_understand_sentiment_conv(rng):
+    """book/test_understand_sentiment_conv.py: sequence_conv_pool net."""
+    V = 40
+    data = layers.data("words", shape=[], dtype="int64", lod_level=1)
+    label = layers.data("label", shape=[1], dtype="int64")
+    emb = layers.embedding(data, size=[V, 8])
+    conv3 = nets.sequence_conv_pool(emb, num_filters=8, filter_size=3,
+                                    act="tanh", pool_type="max")
+    conv4 = nets.sequence_conv_pool(emb, num_filters=8, filter_size=4,
+                                    act="tanh", pool_type="max")
+    pred = layers.fc([conv3, conv4], size=2, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+
+    toks = rng.randint(2, V, (8, 10))
+    lab = (toks[:, 0] > V // 2).astype("int64").reshape(-1, 1)
+    lens = rng.randint(4, 11, 8)
+
+    def feeds(_):
+        return {"words": toks, "words@LEN": lens, "label": lab}
+
+    vals, _ = _fit(loss, feeds, steps=30,
+                   opt=pt.optimizer.Adam(learning_rate=0.05))
+    assert vals[-1] < vals[0] * 0.6
+
+
+def test_label_semantic_roles_crf(rng):
+    """book/test_label_semantic_roles.py (shrunk): BiGRU + linear-chain CRF
+    trained with the CRF negative log-likelihood, decoded with viterbi."""
+    V, NT, E, H = 30, 4, 8, 8
+    words = layers.data("words", shape=[], dtype="int64", lod_level=1)
+    target = layers.data("target", shape=[], dtype="int64", lod_level=1)
+    emb = layers.embedding(words, size=[V, E])
+    proj = layers.fc(emb, size=H * 3, num_flatten_dims=2)
+    fwd = layers.dynamic_gru(proj, size=H)
+    emission = layers.fc(fwd, size=NT, num_flatten_dims=2)
+    crf_cost = layers.linear_chain_crf(
+        emission, target, param_attr=pt.ParamAttr(name="crfw"))
+    loss = layers.mean(crf_cost)
+
+    toks = rng.randint(0, V, (4, 6))
+    tags = (toks % NT).astype("int64")
+    lens = np.array([6, 5, 6, 4])
+
+    def feeds(_):
+        return {"words": toks, "words@LEN": lens,
+                "target": tags, "target@LEN": lens}
+
+    vals, exe = _fit(loss, feeds, steps=40,
+                     opt=pt.optimizer.Adam(learning_rate=0.1))
+    assert vals[-1] < vals[0] * 0.5
+
+    # decode with the trained transition: should mostly recover tags
+    # (param names match because the build order repeats after a counter
+    # reset — the reference's clone-for-test pattern)
+    pt.unique_name.reset()
+    infer = pt.Program()
+    with pt.program_guard(infer, pt.Program()):
+        w2 = layers.data("words", shape=[], dtype="int64", lod_level=1)
+        emb2 = layers.embedding(w2, size=[V, E])
+        proj2 = layers.fc(emb2, size=H * 3, num_flatten_dims=2)
+        fwd2 = layers.dynamic_gru(proj2, size=H)
+        em2 = layers.fc(fwd2, size=NT, num_flatten_dims=2)
+        path = layers.crf_decoding(em2, param_attr=pt.ParamAttr(name="crfw"))
+    got = exe.run(infer, feed={"words": toks, "words@LEN": lens},
+                  fetch_list=[path], is_test=True)
+    m = (np.arange(6)[None] < lens[:, None])
+    agree = (got[0][m] == tags[m]).mean()
+    assert agree > 0.7, f"viterbi agreement {agree}"
+
+
+def test_recommender_system(rng):
+    """book/test_recommender_system.py (shrunk): user/item towers -> cosine
+    similarity regression on ratings."""
+    NU, NI, E = 20, 30, 8
+    uid = layers.data("uid", shape=[1], dtype="int64")
+    mid = layers.data("mid", shape=[1], dtype="int64")
+    rating = layers.data("score", shape=[1], dtype="float32")
+    uemb = layers.fc(layers.embedding(uid, size=[NU, E]), size=16, act="tanh")
+    memb = layers.fc(layers.embedding(mid, size=[NI, E]), size=16, act="tanh")
+    sim = layers.cos_sim(uemb, memb)
+    pred = layers.scale(sim, scale=5.0)
+    loss = layers.mean(layers.square_error_cost(pred, rating))
+
+    u = rng.randint(0, NU, (16, 1))
+    m = rng.randint(0, NI, (16, 1))
+    r = ((u + m) % 5 + 1).astype("float32")
+
+    def feeds(_):
+        return {"uid": u, "mid": m, "score": r}
+
+    vals, _ = _fit(loss, feeds, steps=40,
+                   opt=pt.optimizer.Adam(learning_rate=0.05))
+    assert vals[-1] < vals[0] * 0.5
+
+
+def test_save_load_params_roundtrip(rng, tmp_path):
+    """fluid/io.py save/load parity: train, save, reinit, load, same preds."""
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(layers.fc(x, size=8, act="relu"), size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    xb = rng.rand(8, 4).astype("float32")
+    feeds = {"x": xb, "y": rng.rand(8, 1).astype("float32")}
+    for _ in range(5):
+        exe.run(feed=feeds, fetch_list=[loss])
+    # inference on the pruned slice (running the full program would also
+    # execute the optimizer ops — fluid's test-program pattern)
+    infer = pt.default_main_program().prune([pred])
+    (p1,) = exe.run(infer, feed={"x": xb}, fetch_list=[pred], is_test=True)
+
+    pt.io.save_params(exe, str(tmp_path / "model"))
+    # corrupt the scope (startup re-init is deliberately deterministic, so
+    # overwrite instead), then reload
+    scope = pt.global_scope()
+    for p in pt.default_main_program().all_parameters():
+        scope.set(p.name, np.zeros_like(np.asarray(scope.get(p.name))))
+    (p_reinit,) = exe.run(infer, feed={"x": xb}, fetch_list=[pred],
+                          is_test=True)
+    assert not np.allclose(p1, p_reinit)
+    pt.io.load_params(exe, str(tmp_path / "model"))
+    (p2,) = exe.run(infer, feed={"x": xb}, fetch_list=[pred], is_test=True)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_save_load_inference_model(rng, tmp_path):
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(
+        pred, layers.data("lbl", shape=[1], dtype="int64")))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    xb = rng.rand(4, 4).astype("float32")
+    infer = pt.default_main_program().prune([pred])
+    (p1,) = exe.run(infer, feed={"x": xb}, fetch_list=[pred], is_test=True)
+
+    pt.io.save_inference_model(str(tmp_path / "inf"), ["x"], [pred], exe)
+
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    exe2 = pt.Executor()
+    prog, feed_names, fetch_vars = pt.io.load_inference_model(
+        str(tmp_path / "inf"), exe2)
+    (p2,) = exe2.run(prog, feed={feed_names[0]: xb},
+                     fetch_list=fetch_vars, is_test=True)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
