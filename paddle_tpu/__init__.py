@@ -64,6 +64,10 @@ from . import profiler
 from . import parallel
 from . import distributed
 from . import reader
+from . import dataset
+from . import trainer
+from . import models
+from .trainer import infer
 from . import framework  # compat alias namespace
 
 __version__ = "0.1.0"
@@ -77,5 +81,5 @@ __all__ = [
     "metrics", "io", "save_params", "load_params", "save_persistables",
     "load_persistables", "save_inference_model", "load_inference_model",
     "DataFeeder", "ParamAttr", "profiler", "parallel", "distributed",
-    "reader",
+    "reader", "dataset", "trainer", "models", "infer",
 ]

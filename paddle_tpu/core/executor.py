@@ -295,7 +295,7 @@ class Executor:
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = list(fetch_list or [])
-        scope = scope or global_scope()
+        scope = global_scope() if scope is None else scope
 
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
                        for v in fetch_list]

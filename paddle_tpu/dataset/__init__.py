@@ -1,0 +1,13 @@
+"""Dataset readers (reference: python/paddle/v2/dataset/ — mnist, cifar,
+imdb, imikolov, movielens, uci_housing, conll05, wmt14, sentiment...).
+
+The reference downloads real corpora at import time; this environment has no
+egress, so each module provides (a) loaders for locally-present files in the
+reference formats when a path is given and (b) deterministic synthetic
+generators with the same reader protocol and shapes, so every demo/benchmark
+script runs unchanged.  Swap in real data by pointing the loader at files.
+"""
+from . import mnist, cifar, imdb, imikolov, movielens, uci_housing, conll05
+
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "movielens", "uci_housing",
+           "conll05"]

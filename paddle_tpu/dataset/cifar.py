@@ -1,0 +1,48 @@
+"""CIFAR reader (reference: v2/dataset/cifar.py; pickle-batch loader +
+synthetic fallback)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .common import synthetic_classification
+
+
+def _batches_reader(paths, label_key):
+    def reader():
+        for p in paths:
+            with open(p, "rb") as f:
+                d = pickle.load(f, encoding="latin1")
+            for x, y in zip(d["data"], d[label_key]):
+                yield x.astype("float32").reshape(3, 32, 32) / 255.0, int(y)
+    return reader
+
+
+def train10(data_dir=None):
+    if data_dir:
+        paths = [os.path.join(data_dir, f"data_batch_{i}")
+                 for i in range(1, 6)]
+        if all(os.path.exists(p) for p in paths):
+            return _batches_reader(paths, "labels")
+    return synthetic_classification(4000, (3, 32, 32), 10, seed=10,
+                                    proto_seed=9)
+
+
+def test10(data_dir=None):
+    if data_dir and os.path.exists(os.path.join(data_dir, "test_batch")):
+        return _batches_reader([os.path.join(data_dir, "test_batch")],
+                               "labels")
+    return synthetic_classification(800, (3, 32, 32), 10, seed=11,
+                                    proto_seed=9)
+
+
+def train100(data_dir=None):
+    return synthetic_classification(4000, (3, 32, 32), 100, seed=100,
+                                    proto_seed=99)
+
+
+def test100(data_dir=None):
+    return synthetic_classification(800, (3, 32, 32), 100, seed=101,
+                                    proto_seed=99)

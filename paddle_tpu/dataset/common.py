@@ -1,0 +1,34 @@
+"""Shared helpers for dataset modules."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def synthetic_classification(n, feat_shape, num_classes, seed,
+                             flatten=False, proto_seed=None):
+    """Deterministic synthetic labeled data with learnable structure: class
+    k's examples cluster around a fixed random prototype.  ``proto_seed``
+    pins the prototypes so train/test splits share the distribution."""
+    rng = np.random.RandomState(seed if proto_seed is None else proto_seed)
+    protos = rng.rand(num_classes, *feat_shape).astype("float32")
+
+    def reader():
+        r = np.random.RandomState(seed + 1)
+        for _ in range(n):
+            y = int(r.randint(num_classes))
+            x = protos[y] + 0.1 * r.randn(*feat_shape).astype("float32")
+            yield (x.reshape(-1) if flatten else x, y)
+    return reader
+
+
+def synthetic_sequences(n, vocab_size, num_classes, seed, min_len=4,
+                        max_len=20):
+    """Token sequences whose label is derivable from the first token."""
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            L = int(r.randint(min_len, max_len + 1))
+            toks = r.randint(2, vocab_size, L).tolist()
+            y = int(toks[0] * num_classes // vocab_size)
+            yield toks, y
+    return reader

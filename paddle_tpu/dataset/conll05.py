@@ -1,0 +1,33 @@
+"""CoNLL-05 SRL reader (reference: v2/dataset/conll05.py; synthetic
+tagged sequences)."""
+from __future__ import annotations
+
+import numpy as np
+
+WORD_VOCAB, NUM_TAGS = 1000, 9
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(WORD_VOCAB)}
+    verb_dict = {f"v{i}": i for i in range(50)}
+    label_dict = {f"t{i}": i for i in range(NUM_TAGS)}
+    return word_dict, verb_dict, label_dict
+
+
+def _gen(seed, n):
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            L = int(r.randint(5, 25))
+            words = r.randint(0, WORD_VOCAB, L).tolist()
+            tags = [w % NUM_TAGS for w in words]      # learnable tagging
+            yield words, tags
+    return reader
+
+
+def train():
+    return _gen(60, 1000)
+
+
+def test():
+    return _gen(61, 200)

@@ -1,0 +1,21 @@
+"""IMDB sentiment reader (reference: v2/dataset/imdb.py + benchmark
+rnn/imdb.py; synthetic fallback)."""
+from __future__ import annotations
+
+from .common import synthetic_sequences
+
+VOCAB_SIZE = 5000
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(VOCAB_SIZE)}
+
+
+def train(word_idx=None):
+    v = len(word_idx) if word_idx else VOCAB_SIZE
+    return synthetic_sequences(2000, v, 2, seed=20, min_len=8, max_len=60)
+
+
+def test(word_idx=None):
+    v = len(word_idx) if word_idx else VOCAB_SIZE
+    return synthetic_sequences(400, v, 2, seed=21, min_len=8, max_len=60)

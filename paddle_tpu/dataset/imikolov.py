@@ -1,0 +1,32 @@
+"""PTB n-gram LM reader (reference: v2/dataset/imikolov.py; synthetic)."""
+from __future__ import annotations
+
+import numpy as np
+
+VOCAB = 2000
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+def train(word_idx=None, n=5):
+    v = len(word_idx) if word_idx else VOCAB
+
+    def reader():
+        r = np.random.RandomState(30)
+        for _ in range(3000):
+            start = int(r.randint(0, v - n))
+            yield tuple(range(start, start + n))   # learnable successor rule
+    return reader
+
+
+def test(word_idx=None, n=5):
+    v = len(word_idx) if word_idx else VOCAB
+
+    def reader():
+        r = np.random.RandomState(31)
+        for _ in range(500):
+            start = int(r.randint(0, v - n))
+            yield tuple(range(start, start + n))
+    return reader

@@ -1,0 +1,57 @@
+"""MNIST reader (reference: v2/dataset/mnist.py — idx-format parser +
+reader protocol; synthetic fallback when files are absent)."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from .common import synthetic_classification
+
+TRAIN_N, TEST_N = 8000, 1000
+
+
+def _idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), np.uint8).reshape(n, rows * cols)
+        return data.astype("float32") / 255.0
+
+
+def _idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        return np.frombuffer(f.read(), np.uint8).astype("int64")
+
+
+def reader_from_files(image_path, label_path):
+    imgs, labs = _idx_images(image_path), _idx_labels(label_path)
+
+    def reader():
+        for x, y in zip(imgs, labs):
+            yield x, int(y)
+    return reader
+
+
+def train(data_dir=None):
+    if data_dir and os.path.exists(os.path.join(
+            data_dir, "train-images-idx3-ubyte.gz")):
+        return reader_from_files(
+            os.path.join(data_dir, "train-images-idx3-ubyte.gz"),
+            os.path.join(data_dir, "train-labels-idx1-ubyte.gz"))
+    return synthetic_classification(TRAIN_N, (784,), 10, seed=90051,
+                                    proto_seed=90050)
+
+
+def test(data_dir=None):
+    if data_dir and os.path.exists(os.path.join(
+            data_dir, "t10k-images-idx3-ubyte.gz")):
+        return reader_from_files(
+            os.path.join(data_dir, "t10k-images-idx3-ubyte.gz"),
+            os.path.join(data_dir, "t10k-labels-idx1-ubyte.gz"))
+    return synthetic_classification(TEST_N, (784,), 10, seed=90052,
+                                    proto_seed=90050)

@@ -1,0 +1,33 @@
+"""MovieLens reader (reference: v2/dataset/movielens.py; synthetic)."""
+from __future__ import annotations
+
+import numpy as np
+
+NUM_USERS, NUM_MOVIES = 944, 1683
+
+
+def max_user_id():
+    return NUM_USERS - 1
+
+
+def max_movie_id():
+    return NUM_MOVIES - 1
+
+
+def _ratings(seed, n):
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            u = int(r.randint(NUM_USERS))
+            m = int(r.randint(NUM_MOVIES))
+            score = float((u + m) % 5 + 1)       # learnable structure
+            yield u, m, score
+    return reader
+
+
+def train():
+    return _ratings(40, 4000)
+
+
+def test():
+    return _ratings(41, 800)

@@ -1,0 +1,27 @@
+"""UCI housing reader (reference: v2/dataset/uci_housing.py; synthetic
+linear data with fixed planted weights)."""
+from __future__ import annotations
+
+import numpy as np
+
+FEATURES = 13
+_W = np.linspace(-2, 2, FEATURES).astype("float32")
+_B = 22.5
+
+
+def _gen(seed, n):
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            x = r.randn(FEATURES).astype("float32")
+            y = float(x @ _W + _B + 0.1 * r.randn())
+            yield x, y
+    return reader
+
+
+def train():
+    return _gen(50, 400)
+
+
+def test():
+    return _gen(51, 100)

@@ -37,7 +37,7 @@ class CheckpointManager:
     # -- save --------------------------------------------------------------
     def save(self, step: int, scope: Optional[Scope] = None,
              var_names=None, blocking: bool = False):
-        scope = scope or global_scope()
+        scope = global_scope() if scope is None else scope
         names = var_names or scope.keys()
         # snapshot to host synchronously (cheap vs training step); write async
         snap = {n: np.asarray(scope.get(n)) for n in names if scope.has(n)}
@@ -100,7 +100,7 @@ class CheckpointManager:
         Corrupt checkpoints (md5 mismatch) are skipped, falling back to the
         previous one — the pserver recover-on-restart behavior."""
         import jax.numpy as jnp
-        scope = scope or global_scope()
+        scope = global_scope() if scope is None else scope
         candidates = ([step] if step is not None
                       else list(reversed(self.all_steps())))
         for s in candidates:

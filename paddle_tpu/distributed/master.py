@@ -28,11 +28,13 @@ class Task:
 
 class Master:
     def __init__(self, chunks_per_task: int = 1, timeout_s: float = 60.0,
-                 failure_max: int = 3, snapshot_path: Optional[str] = None):
+                 failure_max: int = 3, snapshot_path: Optional[str] = None,
+                 num_epochs: int = 1):
         self.chunks_per_task = chunks_per_task
         self.timeout_s = timeout_s
         self.failure_max = failure_max
         self.snapshot_path = snapshot_path
+        self.num_epochs = num_epochs
         self._lock = threading.Lock()
         self.todo: List[Task] = []
         self.pending = {}           # task_id -> (Task, deadline)
@@ -58,7 +60,8 @@ class Master:
         with self._lock:
             self._requeue_timeouts()
             if not self.todo:
-                if not self.pending and self.done:
+                if not self.pending and self.done \
+                        and self.epoch + 1 < self.num_epochs:
                     # epoch finished: recycle for the next pass
                     self.epoch += 1
                     for t in self.done:

@@ -30,7 +30,7 @@ class Evaluator:
 
     def reset(self, executor, reset_program=None, scope=None):
         import jax.numpy as jnp
-        scope = scope or global_scope()
+        scope = global_scope() if scope is None else scope
         for s in self.states:
             if scope.has(s.name):
                 scope.set(s.name, jnp.zeros_like(scope.get(s.name)))
@@ -64,7 +64,7 @@ class Accuracy(Evaluator):
         self.batch_accuracy = acc
 
     def eval(self, executor, eval_program=None, scope=None):
-        scope = scope or global_scope()
+        scope = global_scope() if scope is None else scope
         total = float(np.asarray(scope.get(self.total.name))[0])
         correct = float(np.asarray(scope.get(self.correct.name))[0])
         return np.array([correct / max(total, 1.0)], np.float32)
@@ -102,7 +102,7 @@ class ChunkEvaluator(Evaluator):
         self.metrics.extend([prec, rec, f1])
 
     def eval(self, executor, eval_program=None, scope=None):
-        scope = scope or global_scope()
+        scope = global_scope() if scope is None else scope
         ni = float(np.asarray(scope.get(self.num_infer.name))[0])
         nl = float(np.asarray(scope.get(self.num_label.name))[0])
         nc = float(np.asarray(scope.get(self.num_correct.name))[0])

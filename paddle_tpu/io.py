@@ -33,7 +33,7 @@ def _san(name: str) -> str:
 def save_vars(executor=None, dirname=None, main_program=None, vars=None,
               predicate=None, scope: Optional[Scope] = None):
     main_program = main_program or default_main_program()
-    scope = scope or global_scope()
+    scope = global_scope() if scope is None else scope
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if predicate is None or predicate(v)]
@@ -69,7 +69,7 @@ def load_vars(executor=None, dirname=None, main_program=None, vars=None,
               predicate=None, scope: Optional[Scope] = None):
     import jax.numpy as jnp
     main_program = main_program or default_main_program()
-    scope = scope or global_scope()
+    scope = global_scope() if scope is None else scope
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if predicate is None or predicate(v)]

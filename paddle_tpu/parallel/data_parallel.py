@@ -58,7 +58,7 @@ class DataParallel:
     def run(self, program: Program, feed=None, fetch_list=None,
             scope: Optional[Scope] = None, **kw):
         feed = feed or {}
-        scope = scope or global_scope()
+        scope = global_scope() if scope is None else scope
         n = self.mesh.shape[self.batch_axis]
         for name, arr in feed.items():
             if np.ndim(arr) >= 1 and np.shape(arr)[0] % n != 0:

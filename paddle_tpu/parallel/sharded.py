@@ -131,7 +131,7 @@ class ShardedExecutor(Executor):
         Parameter.sharding; others replicate).  Call once after the startup
         program ran — the analog of MultiGradientMachine's value dispatch."""
         from ..core.scope import global_scope
-        scope = scope or global_scope()
+        scope = global_scope() if scope is None else scope
         for name in list(scope.keys()):
             v = self._find_var(program, name)
             if v is None or not v.persistable:

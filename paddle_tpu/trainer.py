@@ -1,0 +1,150 @@
+"""High-level trainer with the v2 event-loop surface.
+
+Reference: python/paddle/v2/trainer.py (SGD :124 train loop, event_handler
+protocol python/paddle/v2/event.py) — the API the reference's demos and
+benchmarks drive (v1_api_demo/mnist/api_train.py).  Internally this builds
+the fluid-style program (optimizer.minimize + Executor) — the two reference
+generations collapse into one path here.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from . import optimizer as optimizer_mod
+from .core.executor import Executor
+from .core.program import (Program, Variable, default_main_program,
+                           default_startup_program)
+from .core.scope import global_scope
+from .data_feeder import DataFeeder
+
+
+class events:
+    """Event types passed to event_handler (python/paddle/v2/event.py)."""
+
+    class BeginPass:
+        def __init__(self, pass_id):
+            self.pass_id = pass_id
+
+    class EndPass:
+        def __init__(self, pass_id, evaluator=None):
+            self.pass_id = pass_id
+            self.evaluator = evaluator
+
+    class BeginIteration:
+        def __init__(self, pass_id, batch_id):
+            self.pass_id = pass_id
+            self.batch_id = batch_id
+
+    class EndIteration:
+        def __init__(self, pass_id, batch_id, cost, metrics):
+            self.pass_id = pass_id
+            self.batch_id = batch_id
+            self.cost = cost
+            self.metrics = metrics
+
+
+class SGD:
+    """v2-style trainer: SGD(cost, parameters=None, update_equation=opt).
+
+    ``update_equation`` is any paddle_tpu.optimizer.Optimizer (the v2 API
+    took a v2 optimizer; same role).  ``extra_layers`` are fetched alongside
+    cost every iteration and reported in EndIteration.metrics.
+    """
+
+    def __init__(self, cost: Variable, parameters=None,
+                 update_equation=None, extra_layers: Sequence = (),
+                 is_local=True, place=None):
+        self.cost = cost
+        self.extra = list(extra_layers or ())
+        self.optimizer = update_equation or optimizer_mod.SGD(
+            learning_rate=0.01)
+        self.main_program = cost.block.program
+        self.optimizer.minimize(cost)
+        self.exe = Executor(place)
+        self._initialized = False
+
+    # -- training ----------------------------------------------------------
+    def train(self, reader: Callable, num_passes: int = 1,
+              event_handler: Optional[Callable] = None,
+              feeding=None, feed_list: Optional[Sequence[Variable]] = None):
+        """reader yields batches (lists of rows); feeding maps data-layer
+        names to row positions (v2 trainer.py feeding) or pass feed_list."""
+        event_handler = event_handler or (lambda e: None)
+        feeder = self._feeder(feeding, feed_list)
+        if not self._initialized:
+            self.exe.run(default_startup_program(), feed={}, fetch_list=[])
+            self._initialized = True
+        for pass_id in range(num_passes):
+            event_handler(events.BeginPass(pass_id))
+            for batch_id, batch in enumerate(reader()):
+                event_handler(events.BeginIteration(pass_id, batch_id))
+                feed = feeder.feed(batch)
+                out = self.exe.run(self.main_program, feed=feed,
+                                   fetch_list=[self.cost] + self.extra)
+                metrics = {getattr(v, "name", str(i)): out[1 + i]
+                           for i, v in enumerate(self.extra)}
+                event_handler(events.EndIteration(
+                    pass_id, batch_id, float(out[0]), metrics))
+            event_handler(events.EndPass(pass_id))
+
+    def test(self, reader: Callable, feeding=None, feed_list=None):
+        """Average cost (+extras) over a reader without updating params."""
+        feeder = self._feeder(feeding, feed_list)
+        test_prog = self.main_program.prune(
+            [self.cost] + self.extra).clone(for_test=True)
+        totals, count = None, 0
+        for batch in reader():
+            out = self.exe.run(test_prog, feed=feeder.feed(batch),
+                               fetch_list=[self.cost] + self.extra,
+                               is_test=True)
+            vals = [np.asarray(o, np.float64) for o in out]
+            totals = vals if totals is None else [
+                t + v for t, v in zip(totals, vals)]
+            count += 1
+        if count == 0:
+            return None
+        return [t / count for t in totals]
+
+    # -- helpers -----------------------------------------------------------
+    def _feeder(self, feeding, feed_list):
+        if feed_list is None:
+            gb = self.main_program.global_block()
+            data_vars = [v for v in gb.vars.values() if v.is_data]
+            if feeding is not None:
+                order = sorted(feeding, key=lambda k: feeding[k])
+                feed_list = [gb.var(n) for n in order]
+            else:
+                feed_list = data_vars
+        return DataFeeder(feed_list)
+
+    def save_parameter_to_tar(self, f=None, dirname=None):
+        from . import io
+        io.save_params(self.exe, dirname or f, self.main_program)
+
+
+def infer(output_layer, parameters=None, input=None, feeding=None,
+          feed_list=None, executor=None, program: Optional[Program] = None):
+    """v2 paddle.infer analog: run the pruned inference slice on a batch."""
+    outputs = output_layer if isinstance(output_layer, (list, tuple)) \
+        else [output_layer]
+    program = program or outputs[0].block.program
+    infer_prog = program.prune(outputs).clone(for_test=True)
+    exe = executor or Executor()
+    gb = program.global_block()
+    if feed_list is None:
+        if feeding is not None:
+            order = sorted(feeding, key=lambda k: feeding[k])
+            feed_list = [gb.var(n) for n in order]
+        else:
+            feed_list = [v for v in gb.vars.values() if v.is_data]
+    # keep only feeds the pruned program actually reads
+    needed = set()
+    for op in infer_prog.global_block().ops:
+        needed.update(op.input_names)
+    feed_list = [v for v in feed_list if v.name in needed]
+    feeder = DataFeeder(feed_list)
+    feed = feeder.feed(input)
+    res = exe.run(infer_prog, feed=feed, fetch_list=outputs, is_test=True)
+    return res if len(res) > 1 else res[0]
