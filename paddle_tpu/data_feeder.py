@@ -43,15 +43,7 @@ class DataFeeder:
                     arr = arr[..., None]       # label [B] -> [B,1]
                 out[var.name] = arr.astype(var.dtype)
             elif var.lod_level == 1:
-                lens = np.asarray([len(r) for r in col], np.int32)
-                T = _round_up(int(lens.max()) if len(lens) else 1,
-                              self.seq_bucket_multiple)
-                first = np.asarray(col[0])
-                feat_shape = first.shape[1:] if first.ndim > 1 else ()
-                arr = np.zeros((len(col), T) + feat_shape, dtype=var.dtype)
-                for i, row in enumerate(col):
-                    r = np.asarray(row, dtype=var.dtype)
-                    arr[i, :len(row)] = r
+                arr, lens = self._pad_rows(col, var)
                 if var.shape is not None and len(var.shape) == arr.ndim + 1 \
                         and var.shape[-1] == 1:
                     arr = arr[..., None]
@@ -62,3 +54,27 @@ class DataFeeder:
                     "lod_level>=2 (nested sequences): feed pre-padded arrays "
                     "with explicit @LEN companions")
         return out
+
+    def _pad_rows(self, col, var):
+        """Pad variable-length rows; C++ fast path (native feeder_module,
+        the PyDataProvider2 analog) with a numpy fallback."""
+        dt = np.dtype(var.dtype)
+        if dt in (np.dtype("int64"), np.dtype("float32")):
+            from .native import get_native
+            native = get_native()
+            if native is not None:
+                try:
+                    return native.pad_batch(list(col),
+                                            self.seq_bucket_multiple,
+                                            dt.name)
+                except Exception:
+                    pass
+        lens = np.asarray([len(r) for r in col], np.int32)
+        T = _round_up(int(lens.max()) if len(lens) else 1,
+                      self.seq_bucket_multiple)
+        first = np.asarray(col[0])
+        feat_shape = first.shape[1:] if first.ndim > 1 else ()
+        arr = np.zeros((len(col), T) + feat_shape, dtype=var.dtype)
+        for i, row in enumerate(col):
+            arr[i, :len(row)] = np.asarray(row, dtype=var.dtype)
+        return arr, lens

@@ -1,0 +1,76 @@
+"""Process-level runtime flags (reference: gflags registry utils/Flags.cpp:18-113
+— ~40 knobs like use_gpu/trainer_count/log_period — and fluid's InitGflags,
+framework/init.cc:39).
+
+TPU-native: a typed registry with environment-variable override
+(``PADDLE_TPU_<NAME>``) and CLI parsing (``parse_args``).  Framework-internal
+behavior toggles (check_nan_inf, log_period, seq_bucket_multiple...) read
+from here so scripts and the environment can configure them uniformly.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_registry: Dict[str, dict] = {}
+
+
+def define_flag(name: str, default, help: str = "", type_=None):
+    t = type_ or (type(default) if default is not None else str)
+    _registry[name] = {"default": default, "help": help, "type": t,
+                       "value": _from_env(name, default, t)}
+
+
+def _from_env(name, default, t):
+    env = os.environ.get(f"PADDLE_TPU_{name.upper()}")
+    if env is None:
+        return default
+    if t is bool:
+        return env.lower() in ("1", "true", "yes", "on")
+    return t(env)
+
+
+def get_flag(name: str) -> Any:
+    return _registry[name]["value"]
+
+
+def set_flag(name: str, value):
+    if name not in _registry:
+        raise KeyError(f"unknown flag {name!r}; define_flag it first")
+    _registry[name]["value"] = _registry[name]["type"](value) \
+        if value is not None else None
+
+
+def all_flags() -> Dict[str, Any]:
+    return {n: e["value"] for n, e in _registry.items()}
+
+
+def parse_args(argv):
+    """Consume --name=value tokens (gflags style); returns leftovers."""
+    rest = []
+    for tok in argv:
+        if tok.startswith("--") and "=" in tok:
+            name, val = tok[2:].split("=", 1)
+            if name in _registry:
+                set_flag(name, val)
+                continue
+        rest.append(tok)
+    return rest
+
+
+# -- the reference's knobs that still mean something on TPU ------------------
+define_flag("use_tpu", True, "run on the TPU backend when present "
+            "(use_gpu analog, Flags.cpp:19)")
+define_flag("trainer_count", 1, "data-parallel width hint (Flags.cpp:22); "
+            "prefer explicit MeshConfig(dp=...)")
+define_flag("trainer_id", 0, "this process's rank (Flags.cpp:67)")
+define_flag("log_period", 100, "steps between stat reports (Flags.cpp:62)")
+define_flag("check_nan_inf", False,
+            "post-step NaN/Inf checks (FLAGS_check_nan_inf, executor.cc:25)")
+define_flag("seed", 0, "global random seed override")
+define_flag("beam_size", 4, "default generation beam width (Flags.cpp:74)")
+define_flag("seq_bucket_multiple", 8,
+            "pad sequence batches up to a multiple of this (recompile guard)")
+define_flag("init_model_path", "", "checkpoint dir to resume from "
+            "(Flags.cpp:81)")
+define_flag("save_dir", "", "parameter save root (v1 --save_dir)")

@@ -1,0 +1,53 @@
+"""Native runtime loader: builds the C++ feeder extension on first import
+(g++ is in the image; pybind11 is not, so the module uses the raw CPython
+C API).  Falls back to None so pure-Python paths keep working.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sysconfig
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "feeder_module.cpp")
+_native = None
+_tried = False
+
+
+def _build_so() -> str:
+    import numpy as np
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha1(f.read()).hexdigest()[:12]
+    so = os.path.join(_HERE, f"paddle_tpu_native-{digest}.so")
+    if os.path.exists(so):
+        return so
+    py_inc = sysconfig.get_paths()["include"]
+    np_inc = np.get_include()
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           f"-I{py_inc}", f"-I{np_inc}", _SRC, "-o", so + ".tmp",
+           "-lpthread"]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(so + ".tmp", so)
+    return so
+
+
+def get_native():
+    """The compiled module, or None if the toolchain is unavailable."""
+    global _native, _tried
+    if _tried:
+        return _native
+    _tried = True
+    try:
+        so = _build_so()
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("paddle_tpu_native", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _native = mod
+    except Exception as e:  # missing toolchain/headers: pure-Python fallback
+        import logging
+        logging.getLogger("paddle_tpu").info(
+            "native feeder unavailable (%s); using Python fallback", e)
+        _native = None
+    return _native

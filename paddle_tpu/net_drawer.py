@@ -1,0 +1,57 @@
+"""Program visualization (reference: fluid/net_drawer.py graphviz export).
+
+Emits graphviz DOT text for a Program — data/parameter/op nodes with
+dataflow edges; sub-blocks render as clusters.  No graphviz dependency:
+the DOT string can be written to a file and rendered externally.
+"""
+from __future__ import annotations
+
+from .core.program import Parameter, Program, default_main_program
+
+_OP_STYLE = 'shape=box,style=filled,fillcolor="#BBDEFB"'
+_PARAM_STYLE = 'shape=oval,style=filled,fillcolor="#C8E6C9"'
+_DATA_STYLE = 'shape=oval,style=filled,fillcolor="#FFE0B2"'
+_VAR_STYLE = 'shape=oval'
+
+
+def draw_graph(program: Program = None, path: str = None) -> str:
+    program = program or default_main_program()
+    lines = ["digraph Program {", "  rankdir=TB;"]
+    seen_vars = set()
+
+    def var_node(b_idx, name, var):
+        key = f"v_{b_idx}_{name}".replace(".", "_").replace("@", "_AT_")
+        if key in seen_vars:
+            return key
+        seen_vars.add(key)
+        if isinstance(var, Parameter):
+            style = _PARAM_STYLE
+        elif var is not None and getattr(var, "is_data", False):
+            style = _DATA_STYLE
+        else:
+            style = _VAR_STYLE
+        lines.append(f'  {key} [label="{name}",{style}];')
+        return key
+
+    for b in program.blocks:
+        prefix = "" if b.idx == 0 else "  "
+        if b.idx != 0:
+            lines.append(f"  subgraph cluster_block{b.idx} {{ "
+                         f'label="block {b.idx}";')
+        for i, op in enumerate(b.ops):
+            okey = f"op_{b.idx}_{i}"
+            lines.append(f'{prefix}  {okey} [label="{op.type}",{_OP_STYLE}];')
+            for n in op.input_names:
+                v = b.vars.get(n) or program.global_block().vars.get(n)
+                lines.append(f"{prefix}  {var_node(b.idx, n, v)} -> {okey};")
+            for n in op.output_names:
+                v = b.vars.get(n) or program.global_block().vars.get(n)
+                lines.append(f"{prefix}  {okey} -> {var_node(b.idx, n, v)};")
+        if b.idx != 0:
+            lines.append("  }")
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot

@@ -10,7 +10,8 @@ PyDataProvider2.cpp:195) is provided by ``buffered`` / ``xmap_readers`` over
 """
 from . import decorator
 from .decorator import (batch, buffered, cache, chain, compose, firstn,
-                        map_readers, shuffle, xmap_readers)
+                        map_readers, native_buffered, shuffle, xmap_readers)
 
 __all__ = ["batch", "buffered", "cache", "chain", "compose", "firstn",
-           "map_readers", "shuffle", "xmap_readers", "decorator"]
+           "map_readers", "native_buffered", "shuffle", "xmap_readers",
+           "decorator"]

@@ -8,6 +8,37 @@ import random as _random
 import threading
 
 
+def native_buffered(reader, size=4):
+    """C++ double-buffered prefetch (native AsyncBatcher — the
+    PyDataProvider2 async-pool analog, PyDataProvider2.cpp:511).  The worker
+    thread pulls from the Python reader under the GIL and parks results in a
+    C++ bounded queue; falls back to the Python ``buffered`` when the native
+    toolchain is unavailable."""
+    from ..native import get_native
+    native = get_native()
+    if native is None:
+        return buffered(reader, size)
+
+    def new_reader():
+        it = iter(reader())
+
+        def next_item():
+            try:
+                return (next(it),)      # wrap: None payloads stay distinct
+            except StopIteration:
+                return None
+        b = native.AsyncBatcher(next_item, capacity=size)
+        try:
+            while True:
+                item = b.next_batch()
+                if item is None:
+                    return
+                yield item[0]
+        finally:
+            b.close()
+    return new_reader
+
+
 def map_readers(func, *readers):
     def reader():
         rs = [r() for r in readers]

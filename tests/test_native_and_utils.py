@@ -1,0 +1,124 @@
+"""Native feeder runtime + utility-subsystem tests (reference analogs:
+PyDataProvider2 provider tests, utils/tests Stat tests, gflags usage,
+fluid net_drawer)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.native import get_native
+
+
+def _native():
+    n = get_native()
+    if n is None:
+        pytest.skip("native toolchain unavailable")
+    return n
+
+
+def test_native_pad_batch_matches_python():
+    n = _native()
+    rows = [[1, 2, 3], [4, 5], [6, 7, 8, 9, 10]]
+    padded, lens = n.pad_batch(rows, 4, "int64")
+    assert padded.shape == (3, 8) and padded.dtype == np.int64
+    np.testing.assert_array_equal(lens, [3, 2, 5])
+    np.testing.assert_array_equal(padded[2, :5], [6, 7, 8, 9, 10])
+    assert padded[1, 2:].sum() == 0
+    # float32 + 2-D numpy rows (time x feature)
+    rows = [np.arange(6, dtype="float32").reshape(3, 2),
+            np.ones((1, 2), "float32")]
+    padded, lens = n.pad_batch(rows, 1, "float32")
+    assert padded.shape == (2, 3, 2)
+    np.testing.assert_array_equal(padded[0], np.arange(6).reshape(3, 2))
+    assert padded[1, 1:].sum() == 0
+
+
+def test_native_pad_dtype_casting():
+    n = _native()
+    padded, _ = n.pad_batch([np.array([1, 2], np.int32)], 1, "int64")
+    assert padded.dtype == np.int64
+
+
+def test_data_feeder_uses_native_consistently():
+    main = pt.Program()
+    with pt.program_guard(main, pt.Program()):
+        w = layers.data("w", shape=[], dtype="int64", lod_level=1)
+    feeder = pt.DataFeeder([w], seq_bucket_multiple=4)
+    feed = feeder.feed([([1, 2, 3],), ([9],)])
+    assert feed["w"].shape == (2, 4)
+    np.testing.assert_array_equal(feed["w@LEN"], [3, 1])
+
+
+def test_async_batcher_order_and_end():
+    n = _native()
+    items = iter(range(100))
+
+    def nxt():
+        try:
+            return (next(items),)
+        except StopIteration:
+            return None
+    b = n.AsyncBatcher(nxt, capacity=8)
+    got = []
+    while True:
+        item = b.next_batch()
+        if item is None:
+            break
+        got.append(item[0])
+    b.close()
+    assert got == list(range(100))
+
+
+def test_native_buffered_reader():
+    r = pt.reader.native_buffered(lambda: iter(range(50)), size=4)
+    assert list(r()) == list(range(50))
+    # reusable
+    assert list(r()) == list(range(50))
+
+
+def test_flags_env_and_parse(monkeypatch):
+    from paddle_tpu import flags
+    assert flags.get_flag("log_period") == 100
+    flags.set_flag("log_period", 5)
+    assert flags.get_flag("log_period") == 5
+    rest = flags.parse_args(["--beam_size=7", "positional", "--unknown=1"])
+    assert flags.get_flag("beam_size") == 7
+    assert rest == ["positional", "--unknown=1"]
+    with pytest.raises(KeyError):
+        flags.set_flag("nonexistent", 1)
+    flags.set_flag("log_period", 100)
+
+
+def test_net_drawer_dot():
+    from paddle_tpu import net_drawer
+    x = layers.data("x", shape=[4], dtype="float32")
+    h = layers.fc(x, size=2, act="softmax")
+    dot = net_drawer.draw_graph(pt.default_main_program())
+    assert dot.startswith("digraph") and "mul" in dot and "softmax" in dot
+    assert "fc_0_w_0" in dot.replace(".", "_")
+
+
+def test_stat_timers():
+    from paddle_tpu import profiler
+    st = profiler.Stat()
+    with st.timer("fwd"):
+        pass
+    with st.timer("fwd"):
+        pass
+    with st.timer("bwd"):
+        pass
+    rep = st.report()
+    assert "fwd" in rep and "count=2" in rep
+    st.reset()
+    assert st.report() == "======= StatSet ======="
+
+
+def test_executor_error_mentions_op(rng):
+    """CustomStackTrace analog: failures carry the op context."""
+    x = layers.data("x", shape=[4], dtype="float32")
+    layers.fc(x, size=2)
+    exe = pt.Executor(use_jit=False)
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    with pytest.raises(Exception) as ei:
+        exe.run(feed={}, fetch_list=[])   # missing feed
+    assert "x" in str(ei.value)
