@@ -20,12 +20,16 @@ from .tensor_parallel import column_parallel_spec, row_parallel_spec, \
     shard_params
 from .ring_attention import ring_attention
 from .sharded import ShardedExecutor
+from .embedding import sharded_lookup
 from . import pipeline
+from . import collective
+from . import embedding
 
 __all__ = [
     "MeshConfig", "get_mesh", "make_mesh", "mesh_guard",
     "all_gather", "all_reduce", "broadcast", "psum", "reduce_scatter",
     "ppermute", "barrier", "DataParallel", "shard_batch",
     "column_parallel_spec", "row_parallel_spec", "shard_params",
-    "ring_attention", "ShardedExecutor", "pipeline",
+    "ring_attention", "ShardedExecutor", "pipeline", "sharded_lookup",
+    "embedding", "collective",
 ]
