@@ -34,7 +34,8 @@ def main():
     opt = pt.optimizer.Momentum(learning_rate=0.01 / BATCH, momentum=0.9)
     opt.minimize(loss)
 
-    exe = pt.Executor()
+    # bf16 compute + fp32 master weights: the TPU-idiomatic training mode
+    exe = pt.Executor(amp=True)
     exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
 
     rng = np.random.RandomState(0)

@@ -128,10 +128,12 @@ class Env:
 # Lowering context passed to op implementations
 # ---------------------------------------------------------------------------
 class LoweringContext:
-    def __init__(self, program: Program, base_key, is_test: bool = False):
+    def __init__(self, program: Program, base_key, is_test: bool = False,
+                 amp: bool = False):
         self.program = program
         self.base_key = base_key      # traced PRNG key folding in the step
         self.is_test = is_test
+        self.amp = amp
         self.op: Optional[Operator] = None
         self.env: Optional[Env] = None
         self._op_uid = 0
@@ -242,11 +244,21 @@ def _run_backward(forward_ops: Sequence[Operator], bw_op: Operator,
     init = env.snapshot()
     wrt_vals = {n: init[n] for n in wrt_names}
     block = env.block
+    amp = ctx.amp
 
     def f(wrt):
         fenv = Env(block)
-        fenv.local.update(init)
-        fenv.local.update(wrt)
+        if amp:
+            # bf16 mixed precision: forward+backward compute in bf16
+            # (activations AND the in-graph copies of the params), while the
+            # wrt leaves stay fp32 so grads come back fp32 for the master-
+            # weight optimizer update.  jax.grad differentiates through the
+            # cast, so this is the canonical AMP recipe at zero extra cost.
+            fenv.local.update({k: _to_bf16(v) for k, v in init.items()})
+            fenv.local.update({k: _to_bf16(v) for k, v in wrt.items()})
+        else:
+            fenv.local.update(init)
+            fenv.local.update(wrt)
         interpret_ops(forward_ops, fenv, ctx)
         loss = fenv.get(loss_name)
         if loss.ndim > 0:
@@ -255,15 +267,30 @@ def _run_backward(forward_ops: Sequence[Operator], bw_op: Operator,
                     f"append_backward loss {loss_name!r} must be a scalar, "
                     f"got shape {loss.shape}")
             loss = loss.reshape(())
+        if amp:
+            loss = loss.astype(jnp.float32)
         return loss, fenv.local
 
     (loss_val, fwd_vals), grads = jax.value_and_grad(f, has_aux=True)(wrt_vals)
     for name, val in fwd_vals.items():
         env.set(name, val)
+    # keep the master fp32 params visible downstream (optimizer ops read the
+    # param name from env where the bf16 forward copy was materialized)
+    if amp:
+        for n, v in wrt_vals.items():
+            env.set(n, v)
     env.set(loss_name, loss_val)
     for n in wrt_names:
         g = grads[n]
+        if amp and g.dtype != wrt_vals[n].dtype:
+            g = g.astype(wrt_vals[n].dtype)
         env.set(grad_var_name(n), g)
+
+
+def _to_bf16(v):
+    if hasattr(v, "dtype") and v.dtype == jnp.float32:
+        return v.astype(jnp.bfloat16)
+    return v
 
 
 # ---------------------------------------------------------------------------
@@ -277,10 +304,11 @@ class Executor:
     """
 
     def __init__(self, place: Optional[Place] = None, use_jit: bool = True,
-                 check_nan_inf: bool = False):
+                 check_nan_inf: bool = False, amp: bool = False):
         self.place = place or TPUPlace()
         self.use_jit = use_jit
         self.check_nan_inf = check_nan_inf
+        self.amp = amp                # bf16 compute, fp32 master weights
         self._cache: Dict = {}
         self._step = 0
 
@@ -377,17 +405,32 @@ class Executor:
             {v.name for b in program.blocks for v in b.vars.values()
              if v.persistable})
 
+        amp = self.amp
+        has_backward = any(op.type == "backward"
+                           for op in program.global_block().ops)
+
         def fn(feed_arrays, state, step):
             base_key = jax.random.fold_in(
                 jax.random.PRNGKey(program.random_seed), step)
             env = Env(program.global_block())
             env.local.update(state)
             env.local.update(feed_arrays)
-            ctx = LoweringContext(program, base_key, is_test=is_test)
+            if amp and not has_backward:
+                # pure-inference AMP: whole net computes in bf16
+                env.local = {k: _to_bf16(v) for k, v in env.local.items()}
+            ctx = LoweringContext(program, base_key, is_test=is_test,
+                                  amp=amp)
             interpret_block_with_backward(program.global_block(), env, ctx)
             fetches = [env.get(n) if env.has(n) else None for n in fetch_names]
             new_state = {k: env.get(k) for k in persistable_names
                          if env.has(k)}
+            # AMP: persistable state keeps its incoming dtype (bn running
+            # stats etc. stay fp32 across steps; jit signature stays stable)
+            for k, v in list(new_state.items()):
+                old = state.get(k)
+                if old is not None and hasattr(old, "dtype") and \
+                        hasattr(v, "dtype") and v.dtype != old.dtype:
+                    new_state[k] = v.astype(old.dtype)
             return fetches, new_state
 
         return fn

@@ -1,0 +1,63 @@
+"""bf16 mixed-precision (AMP) tests — a NEW TPU-first capability beyond the
+reference (its nearest analog is float16.h storage, math/float16.h, never
+wired into training)."""
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _mlp(rng):
+    x = layers.data("x", shape=[16], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    h = layers.fc(x, size=32, act="relu")
+    pred = layers.fc(h, size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+    feeds = {"x": rng.rand(16, 16).astype("float32"),
+             "y": rng.randint(0, 10, (16, 1))}
+    return loss, feeds
+
+
+def test_amp_training_converges_and_keeps_fp32_master(rng):
+    loss, feeds = _mlp(rng)
+    exe = pt.Executor(amp=True)
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    vals = [float(exe.run(feed=feeds, fetch_list=[loss])[0])
+            for _ in range(20)]
+    assert np.isfinite(vals).all() and vals[-1] < vals[0] * 0.7, vals
+    # master weights and optimizer moments stay fp32
+    scope = pt.global_scope()
+    for p in pt.default_main_program().all_parameters():
+        assert scope.get(p.name).dtype == jnp.float32, p.name
+
+
+def test_amp_tracks_fp32_loss(rng):
+    loss, feeds = _mlp(rng)
+    prog = pt.default_main_program()
+    exe32 = pt.Executor()
+    exe32.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    ref = [float(exe32.run(prog, feed=feeds, fetch_list=[loss])[0])
+           for _ in range(5)]
+
+    pt.core.reset_global_scope()
+    exe16 = pt.Executor(amp=True)
+    exe16.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    exe16._step = 0
+    got = [float(exe16.run(prog, feed=feeds, fetch_list=[loss])[0])
+           for _ in range(5)]
+    # bf16 has ~3 decimal digits; trajectories must agree loosely
+    np.testing.assert_allclose(ref, got, rtol=0.05)
+
+
+def test_amp_inference(rng):
+    x = layers.data("x", shape=[8], dtype="float32")
+    pred = layers.fc(x, size=4, act="softmax")
+    exe = pt.Executor(amp=True)
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    (out,) = exe.run(feed={"x": rng.rand(4, 8).astype("float32")},
+                     fetch_list=[pred], is_test=True)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+    np.testing.assert_allclose(np.asarray(out, np.float32).sum(-1), 1.0,
+                               atol=2e-2)
