@@ -127,3 +127,74 @@ def _iou_similarity(ctx, ins, attrs):
     area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
     return {"Out": inter / jnp.maximum(area_a[:, None] + area_b[None, :]
                                        - inter, 1e-10)}
+
+
+@register_op("multiclass_nms", "detection_output")
+def _detection_output(ctx, ins, attrs):
+    """detection_output_op (math/detection_util.h GetDetectionOutput):
+    decode + per-class NMS, static-shape TPU version.
+
+    Inputs: Scores [N, num_priors, C] (post-softmax), BBoxes
+    [N, num_priors, 4] (decoded corner-form boxes).  Greedy NMS runs as a
+    fixed-length fori_loop with masking — no dynamic shapes; suppressed or
+    sub-threshold slots return label -1 (the reference emits a ragged
+    LoDTensor; here the fixed [N, keep_top_k, 6] tensor carries (label,
+    score, x1, y1, x2, y2) rows padded with -1).
+    """
+    from jax import lax
+
+    scores, boxes = ins["Scores"][0], ins["BBoxes"][0]
+    score_thresh = attrs.get("score_threshold", 0.01)
+    nms_thresh = attrs.get("nms_threshold", 0.45)
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 16))
+    background = int(attrs.get("background_label", 0))
+    N, P, C = scores.shape
+
+    def iou(b, ref):
+        x1 = jnp.maximum(b[..., 0], ref[..., 0])
+        y1 = jnp.maximum(b[..., 1], ref[..., 1])
+        x2 = jnp.minimum(b[..., 2], ref[..., 2])
+        y2 = jnp.minimum(b[..., 3], ref[..., 3])
+        inter = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
+        area = lambda v: jnp.clip(v[..., 2] - v[..., 0], 0) * \
+            jnp.clip(v[..., 3] - v[..., 1], 0)
+        return inter / jnp.maximum(area(b) + area(ref) - inter, 1e-10)
+
+    def nms_one_class(cls_scores, cls_boxes):
+        k = min(nms_top_k, P)
+        top_s, top_i = lax.top_k(cls_scores, k)
+        cand = cls_boxes[top_i]                       # [k,4]
+        alive = top_s > score_thresh
+
+        def body(i, keep):
+            ref = cand[i]
+            sup = (iou(cand, ref[None]) > nms_thresh) & \
+                  (jnp.arange(k) > i) & keep[i]
+            return keep & ~sup
+        keep = lax.fori_loop(0, k, body, alive)
+        return top_s * keep, cand, keep
+
+    def one_image(s, b):
+        all_s, all_b, all_l = [], [], []
+        for c in range(C):
+            if c == background:
+                continue
+            ks, kb, keep = nms_one_class(s[:, c], b)
+            all_s.append(jnp.where(keep, ks, -1.0))
+            all_b.append(kb)
+            all_l.append(jnp.full(ks.shape, c, jnp.float32))
+        cs = jnp.concatenate(all_s)
+        cb = jnp.concatenate(all_b)
+        cl = jnp.concatenate(all_l)
+        k2 = min(keep_top_k, cs.shape[0])
+        fs, fi = lax.top_k(cs, k2)
+        lab = jnp.where(fs > score_thresh, cl[fi], -1.0)
+        row = jnp.concatenate([lab[:, None], fs[:, None], cb[fi]], axis=1)
+        if k2 < keep_top_k:
+            row = jnp.pad(row, ((0, keep_top_k - k2), (0, 0)),
+                          constant_values=-1.0)
+        return row
+
+    out = jax.vmap(one_image)(scores, boxes)
+    return {"Out": out}

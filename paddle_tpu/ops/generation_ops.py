@@ -104,3 +104,18 @@ def _beam_search(ctx, ins, attrs):
     if lp > 0:
         scores = scores / jnp.power(flens_f.astype(jnp.float32) + 1e-6, lp)
     return {"Ids": ids, "Scores": scores, "Lens": flens_f}
+
+
+@register_op("beam_search_decode")
+def _beam_search_decode(ctx, ins, attrs):
+    """beam_search_decode_op compat: the beam_search lowering already
+    performs the backtrace, so decode is a pass-through of (Ids, Scores)."""
+    return {"SentenceIds": ins["Ids"][0], "SentenceScores": ins["Scores"][0]}
+
+
+@register_op("recurrent")
+def _recurrent_alias(ctx, ins, attrs):
+    """RecurrentOp name-compat alias for the rnn lowering
+    (recurrent_op.cc:39)."""
+    from ..core.registry import get_op_impl
+    return get_op_impl("rnn")(ctx, ins, attrs)

@@ -114,7 +114,7 @@ def _pool2d(ctx, ins, attrs):
     return {"Out": out}
 
 
-@register_op("max_pool2d_with_index")
+@register_op("max_pool2d_with_index", "pool2d_with_index")
 def _max_pool2d_with_index(ctx, ins, attrs):
     """pool_with_index_op: returns flat H*W indices of maxima (for unpool).
     Patch extraction keeps this one fused XLA computation."""
@@ -342,3 +342,4 @@ def _im2sequence(ctx, ins, attrs):
     n, ckk, oh, ow = patches.shape
     out = patches.reshape(n, ckk, oh * ow).transpose(0, 2, 1)
     return {"Out": out}
+
