@@ -65,6 +65,9 @@ from . import parallel
 from . import distributed
 from . import reader
 from . import dataset
+from . import lr_decay
+from . import net_drawer
+from . import flags
 from . import trainer
 from . import models
 from .trainer import infer

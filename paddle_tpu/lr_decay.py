@@ -1,0 +1,104 @@
+"""Learning-rate decay schedules as program sub-graphs.
+
+Reference: fluid/learning_rate_decay.py (exponential_decay, natural_exp_decay,
+inverse_time_decay, polynomial_decay, piecewise_decay appended as LR-decay
+ops by optimizer.py:213+) and v1 LearningRateScheduler.cpp.
+
+Each schedule builds on the persistable ``@STEP_COUNTER@`` incremented once
+per executor run, so the decayed LR is part of the same compiled step.
+"""
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ["exponential_decay", "natural_exp_decay", "inverse_time_decay",
+           "polynomial_decay", "piecewise_decay", "noam_decay"]
+
+
+def _global_step_f32():
+    counter = layers.autoincreased_step_counter(begin=0)
+    return layers.cast(counter, "float32")
+
+
+def _step_div(decay_steps, staircase):
+    gs = _global_step_f32()
+    div = layers.scale(gs, scale=1.0 / decay_steps)
+    if staircase:
+        div = layers.floor(div)
+    return div
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * decay_rate ^ (step / decay_steps)."""
+    import math
+    div = _step_div(decay_steps, staircase)
+    # rate**div == exp(div * ln(rate))
+    return layers.scale(
+        layers.exp(layers.scale(div, scale=math.log(decay_rate))),
+        scale=float(learning_rate))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * exp(-decay_rate * step / decay_steps)."""
+    div = _step_div(decay_steps, staircase)
+    return layers.scale(layers.exp(layers.scale(div, scale=-decay_rate)),
+                        scale=float(learning_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """lr / (1 + decay_rate * step / decay_steps)."""
+    div = _step_div(decay_steps, staircase)
+    denom = layers.scale(div, scale=decay_rate, bias=1.0)
+    return layers.scale(layers.reciprocal(denom),
+                        scale=float(learning_rate))
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    gs = _global_step_f32()
+    if cycle:
+        ratio = layers.scale(gs, scale=1.0 / decay_steps)
+        mult = layers.elementwise_max(
+            layers.ceil(ratio), layers.fill_constant([1], "float32", 1.0))
+        steps = layers.scale(mult, scale=float(decay_steps))
+    else:
+        steps = layers.fill_constant([1], "float32", float(decay_steps))
+        gs = layers.elementwise_min(gs, steps)
+    frac = layers.elementwise_div(gs, steps)
+    one_minus = layers.scale(frac, scale=-1.0, bias=1.0)
+    powed = layers.pow(one_minus, factor=power)
+    return layers.scale(powed,
+                        scale=float(learning_rate - end_learning_rate),
+                        bias=float(end_learning_rate))
+
+
+def piecewise_decay(boundaries, values):
+    """Piecewise-constant LR: values[i] while step < boundaries[i]."""
+    assert len(values) == len(boundaries) + 1
+    gs = _global_step_f32()
+    lr = layers.fill_constant([1], "float32", float(values[-1]))
+    # build from the last boundary backwards with where-selects
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        cond = layers.less_than(
+            gs, layers.fill_constant([1], "float32", float(b)))
+        ie_val = layers.fill_constant([1], "float32", float(v))
+        helper_out = layers.elementwise_add(
+            layers.elementwise_mul(layers.cast(cond, "float32"), ie_val),
+            layers.elementwise_mul(
+                layers.scale(layers.cast(cond, "float32"), scale=-1.0,
+                             bias=1.0), lr))
+        lr = helper_out
+    return lr
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    """Transformer LR: d^-0.5 * min(step^-0.5, step * warmup^-1.5)."""
+    gs = layers.elementwise_max(
+        _global_step_f32(), layers.fill_constant([1], "float32", 1.0))
+    a = layers.pow(gs, factor=-0.5)
+    b = layers.scale(gs, scale=warmup_steps ** -1.5)
+    return layers.scale(layers.elementwise_min(a, b),
+                        scale=float(learning_rate) * d_model ** -0.5)

@@ -310,3 +310,9 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+
+# LR-decay schedules re-exported here for the fluid surface
+# (fluid appended them from learning_rate_decay.py via optimizer.py)
+from .lr_decay import (exponential_decay, natural_exp_decay,        # noqa: E402,F401
+                       inverse_time_decay, polynomial_decay,
+                       piecewise_decay, noam_decay)

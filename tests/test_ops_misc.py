@@ -1,0 +1,247 @@
+"""Per-op tests for metrics, CTC, NCE, hsigmoid, detection, control flow,
+LR schedules, evaluators — the remaining SURVEY §2.2 categories."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from op_test import check_grad, check_output, run_op
+
+R = np.random.RandomState(17)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+def test_auc_matches_sklearn_style():
+    n = 200
+    label = R.randint(0, 2, (n, 1))
+    # informative scores
+    score = np.clip(label[:, 0] * 0.3 + R.rand(n) * 0.7, 0, 1)
+    pred = np.stack([1 - score, score], 1).astype("float32")
+    got = run_op("auc", {"Predict": ("p", pred), "Label": ("l", label)},
+                 {"num_thresholds": 200}, ["AUC"])
+    auc = float(got["auc__out0"][0])
+
+    # brute-force pairwise AUC
+    pos = score[label[:, 0] == 1]
+    neg = score[label[:, 0] == 0]
+    pairs = (pos[:, None] > neg[None, :]).mean() + \
+        0.5 * (pos[:, None] == neg[None, :]).mean()
+    assert abs(auc - pairs) < 0.02, (auc, pairs)
+
+
+def test_precision_recall_op():
+    idx = np.array([0, 1, 1, 2, 2, 2])
+    lab = np.array([0, 1, 2, 2, 2, 0])
+    got = run_op("precision_recall",
+                 {"Indices": ("i", idx.reshape(-1, 1)),
+                  "Labels": ("l", lab.reshape(-1, 1))},
+                 {"class_number": 3}, ["BatchMetrics"])
+    m = got["batchmetrics__out0"]
+    # micro precision = accuracy here = 4/6
+    np.testing.assert_allclose(m[3], 4 / 6, atol=1e-6)
+
+
+def test_positive_negative_pair():
+    qid = np.array([0, 0, 0, 1, 1])
+    label = np.array([2, 1, 0, 1, 0]).astype("float32")
+    score = np.array([0.9, 0.8, 0.85, 0.3, 0.6]).astype("float32")
+    got = run_op("positive_negative_pair",
+                 {"Score": ("s", score.reshape(-1, 1)),
+                  "Label": ("l", label.reshape(-1, 1)),
+                  "QueryID": ("q", qid.reshape(-1, 1))},
+                 {}, ["PositivePair", "NegativePair"])
+    # q0 pairs: (0>1 ok), (0>2 ok), (1>2 wrong: 0.8<0.85); q1: (3>4 wrong)
+    assert float(got["positivepair__out0"][0]) == 2.0
+    assert float(got["negativepair__out0"][0]) == 2.0
+
+
+def test_chunk_eval_iob():
+    """IOB chunking F1 (ChunkEvaluator/chunk_eval_op)."""
+    # tags: 0=B, 1=I, 2=O  (single chunk type, IOB)
+    label = np.array([[0, 1, 2, 0, 1, 1]])
+    # prediction gets first chunk right, second wrong boundary
+    pred = np.array([[0, 1, 2, 2, 0, 1]])
+    got = run_op("chunk_eval",
+                 {"Inference": ("p", pred), "Label": ("l", label)},
+                 {"num_chunk_types": 1, "chunk_scheme": "IOB"},
+                 ["Precision", "Recall", "F1-Score"])
+    p = float(got["precision__out0"][0])
+    r = float(got["recall__out0"][0])
+    assert 0 < p <= 1 and 0 < r <= 1
+    np.testing.assert_allclose(p, 0.5, atol=1e-6)   # 1 of 2 predicted right
+    np.testing.assert_allclose(r, 0.5, atol=1e-6)   # 1 of 2 gold found
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+def test_warpctc_simple_case():
+    """T=1, single label: loss = -log softmax(logits)[label]."""
+    logits = R.randn(1, 1, 4).astype("float32")
+    label = np.array([[1]])
+    got = run_op("warpctc",
+                 {"Logits": ("x", logits), "Label": ("l", label)},
+                 {"blank": 0}, ["Loss"],
+                 lens={"x": np.array([1]), "l": np.array([1])})
+    p = np.exp(logits[0, 0]) / np.exp(logits[0, 0]).sum()
+    np.testing.assert_allclose(got["loss__out0"].reshape(-1),
+                               [-np.log(p[1])], rtol=1e-4)
+
+
+def test_warpctc_two_step_enumeration():
+    """T=2, label [a]: paths = {blank,a}, {a,blank}, {a,a} -> sum probs."""
+    logits = R.randn(1, 2, 3).astype("float32")
+    a = 2
+    label = np.array([[a]])
+    got = run_op("warpctc",
+                 {"Logits": ("x", logits), "Label": ("l", label)},
+                 {"blank": 0}, ["Loss"],
+                 lens={"x": np.array([2]), "l": np.array([1])})
+    sm = np.exp(logits[0]) / np.exp(logits[0]).sum(-1, keepdims=True)
+    prob = sm[0, 0] * sm[1, a] + sm[0, a] * sm[1, 0] + sm[0, a] * sm[1, a]
+    np.testing.assert_allclose(got["loss__out0"].reshape(-1),
+                               [-np.log(prob)], rtol=1e-4)
+
+
+def test_warpctc_grad_runs():
+    logits = R.randn(2, 4, 5).astype("float32")
+    label = np.array([[1, 2], [3, -1]])
+    check_grad("warpctc",
+               {"Logits": ("x", logits), "Label": ("l", label)},
+               {"blank": 0}, wrt=["x"], out_slots=["Loss"],
+               lens={"x": np.array([4, 3])}, max_relative_error=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# nce / hsigmoid
+# ---------------------------------------------------------------------------
+def test_nce_cost_finite_and_trainable(rng):
+    x = layers.data("x", shape=[8], dtype="float32")
+    lbl = layers.data("lbl", shape=[1], dtype="int64")
+    cost = layers.nce(x, lbl, num_total_classes=50, num_neg_samples=5)
+    loss = layers.mean(cost)
+    pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    feeds = {"x": rng.rand(16, 8).astype("float32"),
+             "lbl": rng.randint(0, 50, (16, 1))}
+    vals = [float(exe.run(feed=feeds, fetch_list=[loss])[0])
+            for _ in range(10)]
+    assert np.isfinite(vals).all() and vals[-1] < vals[0]
+
+
+def test_hsigmoid_trains(rng):
+    x = layers.data("x", shape=[8], dtype="float32")
+    lbl = layers.data("lbl", shape=[1], dtype="int64")
+    cost = layers.hsigmoid(x, lbl, num_classes=16)
+    loss = layers.mean(cost)
+    pt.optimizer.SGD(0.5).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    feeds = {"x": rng.rand(16, 8).astype("float32"),
+             "lbl": rng.randint(0, 16, (16, 1))}
+    vals = [float(exe.run(feed=feeds, fetch_list=[loss])[0])
+            for _ in range(10)]
+    assert np.isfinite(vals).all() and vals[-1] < vals[0]
+
+
+# ---------------------------------------------------------------------------
+# detection
+# ---------------------------------------------------------------------------
+def test_roi_pool():
+    x = np.arange(64, dtype="float32").reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 3, 3]], "float32")   # batch 0, 4x4 region
+    got = run_op("roi_pool", {"X": ("x", x), "ROIs": ("r", rois)},
+                 {"pooled_height": 2, "pooled_width": 2,
+                  "spatial_scale": 1.0}, ["Out"])
+    out = got["out__out0"]
+    assert out.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(out[0, 0], [[9, 11], [25, 27]])
+
+
+def test_iou_similarity():
+    a = np.array([[0, 0, 10, 10]], "float32")
+    b = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], "float32")
+    got = run_op("iou_similarity", {"X": ("x", a), "Y": ("y", b)}, {},
+                 ["Out"])
+    np.testing.assert_allclose(got["out__out0"][0],
+                               [1.0, 25.0 / 175.0], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# control flow constructs
+# ---------------------------------------------------------------------------
+def test_ifelse_construct(rng):
+    x = layers.data("x", shape=[1], dtype="float32")
+    limit = layers.fill_constant([1], "float32", 0.5)
+    cond = layers.less_than(x, limit)
+    ie = layers.control_flow.IfElse(cond)
+    with ie.true_block():
+        ie.output(layers.scale(ie.input(x), scale=10.0))
+    with ie.false_block():
+        ie.output(layers.scale(ie.input(x), scale=-1.0))
+    out = ie()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    res = exe.run(feed={"x": np.array([[0.2], [0.8]], "float32")},
+                  fetch_list=[out])
+    np.testing.assert_allclose(res[0].reshape(-1), [2.0, -0.8], rtol=1e-5)
+
+
+def test_static_rnn_cumsum(rng):
+    seq = layers.data("seq", shape=[2], dtype="float32", lod_level=1)
+    rnn = layers.control_flow.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(seq)
+        acc = rnn.memory(shape=[2])
+        new = layers.elementwise_add(acc, x_t)
+        rnn.update_memory(acc, new)
+        rnn.step_output(new)
+    out = rnn()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    data = rng.rand(2, 4, 2).astype("float32")
+    (res,) = exe.run(feed={"seq": data, "seq@LEN": np.array([4, 4])},
+                     fetch_list=[out])
+    np.testing.assert_allclose(res, np.cumsum(data, axis=1), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# LR schedules
+# ---------------------------------------------------------------------------
+def test_lr_decay_schedules(rng):
+    from paddle_tpu.optimizer import exponential_decay
+    x = layers.data("x", shape=[2], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    lr = exponential_decay(learning_rate=0.1, decay_steps=2,
+                           decay_rate=0.5, staircase=True)
+    opt = pt.optimizer.SGD(learning_rate=lr)
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    feeds = {"x": rng.rand(4, 2).astype("float32"),
+             "y": rng.rand(4, 1).astype("float32")}
+    lrs = [float(exe.run(feed=feeds, fetch_list=[lr])[0])
+           for _ in range(5)]
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025],
+                               rtol=1e-5)
+
+
+def test_evaluator_accuracy(rng):
+    x = layers.data("x", shape=[4], dtype="float32")
+    lbl = layers.data("lbl", shape=[1], dtype="int64")
+    pred = layers.fc(x, size=3, act="softmax")
+    ev = pt.evaluator.Accuracy(input=pred, label=lbl)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    ev.reset(exe)
+    for _ in range(3):
+        exe.run(feed={"x": rng.rand(8, 4).astype("float32"),
+                      "lbl": rng.randint(0, 3, (8, 1))},
+                fetch_list=[pred])
+    acc = ev.eval(exe)
+    assert 0.0 <= float(np.asarray(acc)) <= 1.0
