@@ -1,0 +1,496 @@
+"""v1 config DSL compatibility layer (reference:
+python/paddle/trainer_config_helpers/ — layers.py 137 layer functions,
+activations.py, optimizers.py, poolings.py; consumed by
+python/paddle/trainer/config_parser.py).
+
+The reference's benchmark configs (benchmark/paddle/image/*.py,
+benchmark/paddle/rnn/rnn.py) and v1 demos are plain Python files evaluated
+with this DSL in scope.  Here each DSL call appends to the current
+paddle_tpu default program directly — there is no TrainerConfig proto stage —
+so a v1 config file "launches unchanged" via ``load_v1_config`` and trains on
+TPU with the modern executor.
+
+Covered surface = everything the shipped benchmarks/demos use: settings,
+get_config_arg, define_py_data_sources2, data_layer, fc_layer,
+img_conv_layer, img_pool_layer, img_cmrnorm_layer, batch_norm_layer,
+dropout_layer, embedding_layer, concat_layer, addto_layer, simple_lstm,
+lstmemory, last_seq, first_seq, classification_cost, cross_entropy(_cost),
+regression_cost, outputs, activation/pooling/optimizer/regularization
+objects.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from .. import layers as L
+from .. import optimizer as opt_mod
+from .. import regularizer as reg_mod
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "settings", "get_config_arg", "define_py_data_sources2", "outputs",
+    "data_layer", "fc_layer", "img_conv_layer", "img_pool_layer",
+    "img_cmrnorm_layer", "batch_norm_layer", "dropout_layer",
+    "embedding_layer", "concat_layer", "addto_layer", "simple_lstm",
+    "lstmemory", "last_seq", "first_seq", "max_pooling_seq",
+    "classification_cost", "cross_entropy", "cross_entropy_cost",
+    "regression_cost", "mse_cost",
+    "img_conv_group", "conv_projection", "ExtraAttr",
+    "ExtraLayerAttribute",
+    "LinearActivation", "ReluActivation", "SigmoidActivation",
+    "TanhActivation", "SoftmaxActivation", "IdentityActivation",
+    "MaxPooling", "AvgPooling", "SumPooling",
+    "MomentumOptimizer", "AdamOptimizer", "AdaGradOptimizer",
+    "RMSPropOptimizer", "AdaDeltaOptimizer",
+    "L1Regularization", "L2Regularization",
+    "load_v1_config", "V1Config",
+]
+
+
+# ---------------------------------------------------------------------------
+# config-level state
+# ---------------------------------------------------------------------------
+class _ConfigState:
+    def __init__(self):
+        self.args = {}
+        self.settings = {}
+        self.outputs = []
+        self.data_sources = None
+        self.data_layers = {}
+
+
+_state = _ConfigState()
+
+
+def get_config_arg(name, type_=str, default=None):
+    """command-line config args (config_parser get_config_arg)."""
+    v = _state.args.get(name, default)
+    if v is None:
+        return None
+    if type_ is bool and isinstance(v, str):
+        return v.lower() in ("1", "true", "yes")
+    return type_(v)
+
+
+def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
+             regularization=None, gradient_clipping_threshold=None, **kw):
+    _state.settings = {
+        "batch_size": batch_size,
+        "learning_rate": learning_rate,
+        "learning_method": learning_method,
+        "regularization": regularization,
+        "gradient_clipping_threshold": gradient_clipping_threshold,
+    }
+
+
+def define_py_data_sources2(train_list, test_list, module=None, obj=None,
+                            args=None):
+    """Recorded for the caller; the TPU runner feeds via reader/DataFeeder
+    instead of the embedded PyDataProvider2."""
+    _state.data_sources = {"train_list": train_list, "test_list": test_list,
+                           "module": module, "obj": obj, "args": args}
+
+
+def outputs(*vars_):
+    _state.outputs = [v for v in vars_]
+
+
+# ---------------------------------------------------------------------------
+# activation / pooling / optimizer / regularization objects
+# ---------------------------------------------------------------------------
+class _Act:
+    act = None
+
+    def __init__(self):
+        pass
+
+
+class LinearActivation(_Act):
+    act = None
+
+
+IdentityActivation = LinearActivation
+
+
+class ReluActivation(_Act):
+    act = "relu"
+
+
+class SigmoidActivation(_Act):
+    act = "sigmoid"
+
+
+class TanhActivation(_Act):
+    act = "tanh"
+
+
+class SoftmaxActivation(_Act):
+    act = "softmax"
+
+
+def _act_name(a):
+    if a is None:
+        return None
+    if isinstance(a, str):
+        return a
+    return a.act
+
+
+class MaxPooling:
+    ptype = "max"
+
+
+class AvgPooling:
+    ptype = "avg"
+
+
+class SumPooling:
+    ptype = "sum"
+
+
+class MomentumOptimizer:
+    def __init__(self, momentum=0.9, sparse=False):
+        self.momentum = momentum
+
+    def make(self, lr, reg):
+        return opt_mod.Momentum(learning_rate=lr, momentum=self.momentum,
+                                regularization=reg)
+
+
+class AdamOptimizer:
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def make(self, lr, reg):
+        return opt_mod.Adam(learning_rate=lr, beta1=self.beta1,
+                            beta2=self.beta2, epsilon=self.epsilon,
+                            regularization=reg)
+
+
+class AdaGradOptimizer:
+    def make(self, lr, reg):
+        return opt_mod.Adagrad(learning_rate=lr, regularization=reg)
+
+
+class RMSPropOptimizer:
+    def make(self, lr, reg):
+        return opt_mod.RMSProp(learning_rate=lr, regularization=reg)
+
+
+class AdaDeltaOptimizer:
+    def make(self, lr, reg):
+        return opt_mod.Adadelta(learning_rate=lr, regularization=reg)
+
+
+class L1Regularization:
+    def __init__(self, rate):
+        self.rate = rate
+
+    def make(self):
+        return reg_mod.L1Decay(self.rate)
+
+
+class L2Regularization:
+    def __init__(self, rate):
+        self.rate = rate
+
+    def make(self):
+        return reg_mod.L2Decay(self.rate)
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+def data_layer(name, size, height=None, width=None, depth=None, **kw):
+    """v1 data_layer: flat ``size`` input.  Image configs pass height/width
+    via img_conv_layer's num_channels; sequence configs treat size as the
+    vocab.  The var records ``v1_size`` so embedding/conv can recover
+    semantics."""
+    v = L.data(name, shape=[size], dtype="float32")
+    v.v1_size = size
+    _state.data_layers[name] = v
+    return v
+
+
+def _as_image(input, num_channels):
+    """Reshape a flat v1 data layer to [C, H, W] (square images, the v1
+    convention when height/width are unspecified)."""
+    if input.shape is not None and len(input.shape) == 4:
+        return input
+    size = getattr(input, "v1_size", None) or int(np.prod(input.shape[1:]))
+    hw = int(round(math.sqrt(size // num_channels)))
+    return L.reshape(input, [-1, num_channels, hw, hw])
+
+
+class ExtraLayerAttribute:
+    """v1 ExtraLayerAttribute (drop_rate is the only knob the benchmark
+    configs use)."""
+
+    def __init__(self, drop_rate=None, **kw):
+        self.drop_rate = drop_rate
+
+
+ExtraAttr = ExtraLayerAttribute
+
+
+def _apply_layer_attr(out, layer_attr):
+    if layer_attr is not None and getattr(layer_attr, "drop_rate", None):
+        out = L.dropout(out, layer_attr.drop_rate)
+    return out
+
+
+def fc_layer(input, size, act=None, name=None, param_attr=None,
+             bias_attr=None, layer_attr=None, **kw):
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    flat = []
+    for v in inputs:
+        if v.shape is not None and len(v.shape) > 2 and v.lod_level == 0:
+            v = L.reshape(v, [-1, int(np.prod(v.shape[1:]))])
+        flat.append(v)
+    nfd = 2 if flat[0].lod_level else 1
+    out = L.fc(flat if len(flat) > 1 else flat[0], size=size,
+               num_flatten_dims=nfd, act=_act_name(act), name=name,
+               param_attr=param_attr, bias_attr=bias_attr)
+    return _apply_layer_attr(out, layer_attr)
+
+
+def img_conv_layer(input, filter_size, num_filters, name=None,
+                   num_channels=None, act=None, groups=1, stride=1,
+                   padding=0, bias_attr=None, param_attr=None,
+                   shared_biases=True, layer_attr=None, filter_size_y=None,
+                   stride_y=None, padding_y=None, trans=False, **kw):
+    if num_channels is not None:
+        input = _as_image(input, num_channels)
+    fs = (filter_size, filter_size_y) if filter_size_y else filter_size
+    st = (stride, stride_y) if stride_y else stride
+    pd = (padding, padding_y) if padding_y else padding
+    f = L.conv2d_transpose if trans else L.conv2d
+    return f(input, num_filters=num_filters, filter_size=fs, stride=st,
+             padding=pd, groups=groups, act=_act_name(act), name=name,
+             param_attr=param_attr, bias_attr=bias_attr)
+
+
+def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
+                   name=None, num_channels=None, ceil_mode=True, **kw):
+    if num_channels is not None:
+        input = _as_image(input, num_channels)
+    ptype = pool_type.ptype if pool_type is not None else "max"
+    return L.pool2d(input, pool_size=pool_size, pool_type=ptype,
+                    pool_stride=stride, pool_padding=padding,
+                    ceil_mode=ceil_mode, name=name)
+
+
+def img_cmrnorm_layer(input, size=5, scale=0.0001, power=0.75, name=None,
+                      num_channels=None, **kw):
+    """v1 cross-map response norm == LRN (ImgCMRNormLayer)."""
+    if num_channels is not None:
+        input = _as_image(input, num_channels)
+    return L.lrn(input, n=size, alpha=scale, beta=power, name=name)
+
+
+def batch_norm_layer(input, act=None, name=None, num_channels=None,
+                     bias_attr=None, param_attr=None, use_global_stats=None,
+                     moving_average_fraction=0.9, **kw):
+    if num_channels is not None and (input.shape is None or
+                                     len(input.shape) != 4):
+        input = _as_image(input, num_channels)
+    return L.batch_norm(input, act=_act_name(act),
+                        momentum=moving_average_fraction,
+                        param_attr=param_attr, bias_attr=bias_attr,
+                        name=name)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type=None, param_attr=None, **kw):
+    """trainer_config_helpers.networks img_conv_group."""
+    from .. import nets
+    if num_channels is not None:
+        input = _as_image(input, num_channels)
+    return nets.img_conv_group(
+        input, conv_num_filter=list(conv_num_filter), pool_size=pool_size,
+        conv_padding=conv_padding, conv_filter_size=conv_filter_size,
+        conv_act=_act_name(conv_act),
+        conv_with_batchnorm=conv_with_batchnorm,
+        conv_batchnorm_drop_rate=conv_batchnorm_drop_rate,
+        pool_stride=pool_stride,
+        pool_type=pool_type.ptype if pool_type is not None else "max",
+        param_attr=param_attr)
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, **kw):
+    """v1 conv projection (used inside MixedLayer/concat): plain conv here."""
+    return img_conv_layer(input, filter_size=filter_size,
+                          num_filters=num_filters,
+                          num_channels=num_channels, stride=stride,
+                          padding=padding)
+
+
+def dropout_layer(input, dropout_rate, name=None):
+    return L.dropout(input, dropout_prob=dropout_rate, name=name)
+
+
+def embedding_layer(input, size, name=None, param_attr=None, **kw):
+    vocab = getattr(input, "v1_size", None)
+    if vocab is None:
+        raise ValueError("embedding_layer input must be a data_layer with "
+                         "its vocab as size")
+    ids = input
+    if ids.dtype != np.dtype("int64"):
+        # v1 integer_value_sequence arrives as the same data layer; re-type
+        ids.dtype = np.dtype("int64")
+        ids.lod_level = 1
+        ids.shape = (-1, -1)
+    return L.embedding(ids, size=[vocab, size], param_attr=param_attr,
+                       name=name)
+
+
+def concat_layer(input, act=None, name=None, **kw):
+    return L.concat(list(input), axis=1, name=name)
+
+
+def addto_layer(input, act=None, name=None, bias_attr=None, **kw):
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    out = inputs[0]
+    for v in inputs[1:]:
+        out = L.elementwise_add(out, v)
+    a = _act_name(act)
+    if a:
+        out = getattr(L, a)(out)
+    return out
+
+
+def simple_lstm(input, size, name=None, reverse=False, act=None,
+                gate_act=None, **kw):
+    """trainer_config_helpers simple_lstm: fc(4*size) + lstmemory."""
+    proj = L.fc(input, size=size * 4, num_flatten_dims=2)
+    hid, _ = L.dynamic_lstm(proj, size=size * 4, is_reverse=reverse,
+                            name=name)
+    return hid
+
+
+def lstmemory(input, name=None, reverse=False, act=None, gate_act=None,
+              size=None, **kw):
+    """v1 lstmemory: input must already be the 4x gate projection."""
+    hid, _ = L.dynamic_lstm(input, size=input.shape[-1], is_reverse=reverse,
+                            name=name)
+    return hid
+
+
+def last_seq(input, name=None, **kw):
+    return L.sequence_last_step(input, name=name)
+
+
+def first_seq(input, name=None, **kw):
+    return L.sequence_first_step(input, name=name)
+
+
+def max_pooling_seq(input, name=None, **kw):
+    return L.sequence_pool(input, "max", name=name)
+
+
+def _label_layer(label):
+    if getattr(label, "is_data", False) and \
+            label.dtype != np.dtype("int64"):
+        label.dtype = np.dtype("int64")
+        if label.shape is not None and label.shape[-1] != 1:
+            label.shape = (-1, 1)
+    return label
+
+
+def classification_cost(input, label, name=None, evaluator=None, **kw):
+    label = _label_layer(label)
+    return L.mean(L.cross_entropy(input, label), name=name)
+
+
+def cross_entropy_cost(input, label, name=None, **kw):
+    return classification_cost(input, label, name)
+
+
+cross_entropy = cross_entropy_cost
+
+
+def regression_cost(input, label, name=None, **kw):
+    return L.mean(L.square_error_cost(input, label), name=name)
+
+
+mse_cost = regression_cost
+
+
+# ---------------------------------------------------------------------------
+# config loader
+# ---------------------------------------------------------------------------
+class V1Config:
+    """Result of evaluating a v1 config file: the built program + metadata."""
+
+    def __init__(self, main_program, startup_program, outputs, settings,
+                 data_layers, data_sources):
+        self.main_program = main_program
+        self.startup_program = startup_program
+        self.outputs = outputs
+        self.settings = settings
+        self.data_layers = data_layers
+        self.data_sources = data_sources
+
+    def make_optimizer(self):
+        s = self.settings
+        lr = s.get("learning_rate", 1e-3)
+        reg_obj = s.get("regularization")
+        reg = reg_obj.make() if reg_obj is not None else None
+        method = s.get("learning_method")
+        if method is None:
+            return opt_mod.SGD(learning_rate=lr, regularization=reg)
+        return method.make(lr, reg)
+
+    def minimize_outputs(self):
+        """append_backward + optimizer on the first output (the cost)."""
+        from ..core.program import program_guard
+        with program_guard(self.main_program, self.startup_program):
+            self.make_optimizer().minimize(self.outputs[0])
+        return self.outputs[0]
+
+
+def _install_import_shim():
+    """Make ``from paddle.trainer_config_helpers import *`` resolve to THIS
+    module so reference config files execute verbatim."""
+    import sys
+    import types
+    this = sys.modules[__name__]
+    if "paddle.trainer_config_helpers" in sys.modules:
+        return
+    pkg = sys.modules.get("paddle")
+    if pkg is None:
+        pkg = types.ModuleType("paddle")
+        sys.modules["paddle"] = pkg
+    pkg.trainer_config_helpers = this
+    sys.modules["paddle.trainer_config_helpers"] = this
+
+
+def load_v1_config(path, **config_args):
+    """Evaluate a v1 config file (the config_parser.parse_config role,
+    config_parser.py:126) against a fresh program pair.  Python-2-era
+    configs work: ``xrange`` is aliased and the ``paddle`` import is
+    shimmed."""
+    import paddle_tpu as pt
+
+    global _state
+    _state = _ConfigState()
+    _state.args = dict(config_args)
+    _install_import_shim()
+    main, startup = pt.Program(), pt.Program()
+    ns = {k: globals()[k] for k in __all__
+          if k not in ("load_v1_config", "V1Config")}
+    ns["__file__"] = path
+    ns["xrange"] = range
+    with pt.program_guard(main, startup):
+        with open(path) as f:
+            code = compile(f.read(), path, "exec")
+        exec(code, ns)
+    return V1Config(main, startup, list(_state.outputs),
+                    dict(_state.settings), dict(_state.data_layers),
+                    _state.data_sources)

@@ -1,0 +1,103 @@
+"""v1 config DSL compat tests: configs written in the reference's
+trainer_config_helpers DSL build and train on the TPU-native runtime
+(reference: config_parser_test.py + trainer tests with sample configs)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.trainer_config_helpers import load_v1_config
+
+REF_IMG = "/root/reference/benchmark/paddle/image"
+
+
+def _write_cfg(tmp_path, body):
+    p = tmp_path / "cfg.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_own_v1_mlp_config_trains(tmp_path, rng):
+    path = _write_cfg(tmp_path, """
+        from paddle.trainer_config_helpers import *
+        settings(batch_size=8, learning_rate=0.1,
+                 learning_method=MomentumOptimizer(0.9))
+        img = data_layer(name='pixel', size=64)
+        lab = data_layer(name='label', size=10)
+        h = fc_layer(input=img, size=32, act=ReluActivation())
+        net = fc_layer(input=h, size=10, act=SoftmaxActivation())
+        loss = classification_cost(input=net, label=lab)
+        outputs(loss)
+    """)
+    cfg = load_v1_config(path)
+    loss = cfg.minimize_outputs()
+    exe = pt.Executor()
+    exe.run(cfg.startup_program, feed={}, fetch_list=[])
+    feeds = {"pixel": rng.rand(8, 64).astype("float32"),
+             "label": rng.randint(0, 10, (8, 1))}
+    vals = [float(exe.run(cfg.main_program, feed=feeds,
+                          fetch_list=[loss])[0]) for _ in range(5)]
+    assert np.isfinite(vals).all() and vals[-1] < vals[0]
+
+
+def test_own_v1_conv_config_builds(tmp_path):
+    path = _write_cfg(tmp_path, """
+        from paddle.trainer_config_helpers import *
+        settings(batch_size=4, learning_rate=0.01,
+                 regularization=L2Regularization(5e-4))
+        img = data_layer(name='image', size=3 * 16 * 16)
+        lab = data_layer(name='label', size=10)
+        conv = img_conv_layer(input=img, filter_size=3, num_channels=3,
+                              num_filters=8, padding=1,
+                              act=ReluActivation())
+        pool = img_pool_layer(input=conv, pool_size=2, stride=2,
+                              pool_type=MaxPooling())
+        bn = batch_norm_layer(input=pool, act=ReluActivation())
+        out = fc_layer(input=bn, size=10, act=SoftmaxActivation(),
+                       layer_attr=ExtraAttr(drop_rate=0.5))
+        loss = classification_cost(input=out, label=lab)
+        outputs(loss)
+    """)
+    cfg = load_v1_config(path)
+    assert len(cfg.outputs) == 1
+    ops = [op.type for op in cfg.main_program.global_block().ops]
+    for t in ("conv2d", "pool2d", "batch_norm", "dropout", "cross_entropy"):
+        assert t in ops, (t, ops)
+
+
+@pytest.mark.skipif(not os.path.exists(REF_IMG),
+                    reason="reference tree not mounted")
+@pytest.mark.parametrize("name,args", [
+    ("alexnet.py", {"batch_size": 4}),
+    ("smallnet_mnist_cifar.py", {"batch_size": 4}),
+    ("vgg.py", {"batch_size": 4, "layer_num": 16}),
+    ("resnet.py", {"batch_size": 4, "layer_num": 50}),
+    ("googlenet.py", {"batch_size": 4, "use_gpu": False}),
+])
+def test_reference_benchmark_configs_build(name, args):
+    """The reference's own benchmark/paddle/image configs evaluate
+    UNCHANGED against the compat DSL (BASELINE.json north star: 'benchmark
+    scripts launch unchanged')."""
+    cfg = load_v1_config(os.path.join(REF_IMG, name), **args)
+    assert cfg.outputs, name
+    assert len(cfg.main_program.global_block().ops) > 10
+
+
+@pytest.mark.skipif(not os.path.exists(REF_IMG),
+                    reason="reference tree not mounted")
+def test_reference_smallnet_config_trains(rng):
+    cfg = load_v1_config(os.path.join(REF_IMG, "smallnet_mnist_cifar.py"),
+                         batch_size=4)
+    loss = cfg.minimize_outputs()
+    exe = pt.Executor()
+    exe.run(cfg.startup_program, feed={}, fetch_list=[])
+    feeds = {"image": rng.rand(4, 3 * 32 * 32).astype("float32"),
+             "label": rng.randint(0, 10, (4, 1))}
+    data_names = list(cfg.data_layers)
+    # the config's own data layer names drive the feed
+    feeds = {data_names[0]: feeds["image"], data_names[1]: feeds["label"]}
+    vals = [float(exe.run(cfg.main_program, feed=feeds,
+                          fetch_list=[loss])[0]) for _ in range(4)]
+    assert np.isfinite(vals).all() and vals[-1] < vals[0]
