@@ -29,6 +29,7 @@ __all__ = [
     "elementwise_pow", "pad", "roi_pool", "smooth_l1", "bilinear_interp",
     "warpctc", "linear_chain_crf", "crf_decoding", "label_smooth",
     "autoincreased_step_counter",
+    "flash_attention",
     "log_loss", "hinge_loss", "huber_loss", "square_error_cost", "rank_loss",
     "margin_rank_loss", "squared_l2_distance", "squared_l2_norm",
     "kldiv_loss", "modified_huber_loss", "bilinear_tensor_product",
@@ -1145,3 +1146,18 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     helper.append_op(type="increment", inputs={"X": [counter]},
                      outputs={"Out": [counter]}, attrs={"step": float(step)})
     return counter
+
+
+def flash_attention(q, k, v, causal=False, block_q=512, block_k=512,
+                    name=None):
+    """Fused O(T)-memory attention (Pallas kernel on TPU; exact).  q/k/v:
+    [B, T, H, D] or [BH, T, D].  The long-context path the reference never
+    had — pairs with parallel.ring_attention for sp-sharded sequences."""
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype, q.shape)
+    helper.append_op(type="flash_attention",
+                     inputs={"Q": [q], "K": [k], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"causal": causal, "block_q": block_q,
+                            "block_k": block_k})
+    return out

@@ -21,6 +21,7 @@ from . import io_ops          # noqa: F401
 from . import detection_ops   # noqa: F401
 from . import crf_ops         # noqa: F401
 from . import generation_ops  # noqa: F401
+from . import pallas_kernels  # noqa: F401
 
 
 @register_op("backward")

@@ -1,0 +1,211 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference hand-wrote CUDA for its hot paths (paddle/cuda hl_*.cu — fused
+LSTM, attention-ish matrix kernels).  The TPU-native analog is Pallas: this
+module provides a fused flash-attention kernel (online-softmax, O(T) memory,
+K/V streamed through VMEM) used by ``nets.scaled_dot_product_attention`` and
+available to models directly.
+
+The kernel computes exact attention; backward recomputes via the reference
+jnp implementation (jax.custom_vjp), trading FLOPs for not materializing the
+[T,T] probability matrix in the forward pass.  On non-TPU backends the jnp
+reference runs instead (CPU tests exercise the kernel in interpret mode).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  block_k, num_k_blocks, causal, sm_scale, block_q):
+    """Grid (bh, q_blocks, k_blocks), k innermost/sequential: K/V stream
+    through VMEM one [block_k, D] tile at a time (O(T) memory), with the
+    online-softmax running stats (m, l) and the output accumulator living in
+    VMEM scratch across the k dimension."""
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        q32 = q_ref[0].astype(jnp.float32) * sm_scale      # [bq, D]
+        kblk = k_ref[0].astype(jnp.float32)                # [bk, D]
+        vblk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q32, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        if causal:
+            qpos = j * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = m_new
+
+    if causal:
+        # blocks strictly above the diagonal contribute nothing — skip them
+        pl.when(kb * block_k <= (j + 1) * block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kb == num_k_blocks - 1)
+    def _write():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-20)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    BH, T, D = q.shape
+    nk = T // block_k
+    grid = (BH, T // block_q, nk)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return pl.pallas_call(
+        functools.partial(_flash_kernel, block_k=block_k, num_k_blocks=nk,
+                          causal=causal, sm_scale=sm_scale,
+                          block_q=block_q),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, D), lambda i, j, kb: (i, kb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda i, j, kb: (i, j, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v)
+
+
+def _reference_attention(q, k, v, causal, sm_scale):
+    s = jnp.einsum("bqd,bkd->bqk", q * sm_scale, k)
+    if causal:
+        T = s.shape[-1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # recompute-based backward through the reference formulation
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal,
+                                                sm_scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
+                    block_k=128, use_pallas=None, interpret=None):
+    """Fused attention.  q,k,v: [B, T, H, D] (or [BH, T, D]).
+
+    use_pallas=None auto-selects: the Pallas kernel on TPU, interpret-mode
+    kernel under explicit request, jnp reference otherwise.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    squeeze_heads = q.ndim == 4
+    if squeeze_heads:
+        B, T, H, D = q.shape
+        rs = lambda x: jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)
+        q3, k3, v3 = rs(q), rs(k), rs(v)
+    else:
+        q3, k3, v3 = q, k, v
+    if use_pallas is None:
+        use_pallas = _HAVE_PALLAS and \
+            jax.devices()[0].platform not in ("cpu",)
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    T = q3.shape[1]
+    if use_pallas or interpret:
+        bq = min(block_q, T)
+        bk = min(block_k, T)
+        pad = (-T) % bq
+        padk = (-T) % bk
+        padn = max(pad, padk)
+        if padn:
+            # pad keys with NEG_INF-masked zeros: enforce via an extra mask
+            # on scores is not expressible here, so pad and fix lengths by
+            # masking value rows to zero and key rows to -inf via q padding
+            q3 = jnp.pad(q3, ((0, 0), (0, padn), (0, 0)))
+            k3 = jnp.pad(k3, ((0, 0), (0, padn), (0, 0)),
+                         constant_values=0.0)
+            v3 = jnp.pad(v3, ((0, 0), (0, padn), (0, 0)))
+            # zero-padded keys produce score 0; mask them by shifting with
+            # a large negative bias folded into k's last feature is fragile,
+            # so fall back to reference for ragged tails
+            out = _reference_attention(q3[:, :T], k3[:, :T], v3[:, :T],
+                                       causal, sm_scale)
+        else:
+            out = _flash(q3, k3, v3, causal, sm_scale, bq, bk,
+                         bool(interpret))
+    else:
+        out = _reference_attention(q3, k3, v3, causal, sm_scale)
+    if squeeze_heads:
+        out = jnp.moveaxis(out.reshape(B, H, T, D), 1, 2)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# op registration (layer: layers.flash_attention)
+# ---------------------------------------------------------------------------
+from ..core.registry import register_op  # noqa: E402
+
+
+@register_op("flash_attention")
+def _flash_attention_op(ctx, ins, attrs):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    return {"Out": flash_attention(
+        q, k, v,
+        causal=attrs.get("causal", False),
+        block_q=attrs.get("block_q", 512),
+        block_k=attrs.get("block_k", 512))}
