@@ -297,11 +297,19 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
     st = _pair(pool_stride)
     pd = _pair(pool_padding)
     n, c, h, w = input.shape
+
+    def _out(size, k, p, s):
+        if size is None or size < 0:
+            return -1
+        if ceil_mode:
+            return -(-(size + 2 * p - k) // s) + 1
+        return (size + 2 * p - k) // s + 1
+
     if global_pooling:
         oh = ow = 1
     else:
-        oh = _conv_out(h, ks[0], pd[0], st[0])
-        ow = _conv_out(w, ks[1], pd[1], st[1])
+        oh = _out(h, ks[0], pd[0], st[0])
+        ow = _out(w, ks[1], pd[1], st[1])
     out = helper.create_variable_for_type_inference(
         input.dtype, (n, c, oh, ow))
     helper.append_op(type="pool2d", inputs={"X": [input]},
@@ -309,7 +317,8 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
                      attrs={"pooling_type": pool_type, "ksize": ks,
                             "strides": st, "paddings": pd,
                             "global_pooling": global_pooling,
-                            "exclusive": exclusive})
+                            "exclusive": exclusive,
+                            "ceil_mode": ceil_mode})
     return out
 
 

@@ -78,7 +78,7 @@ def _conv3d(ctx, ins, attrs):
 
 
 def _pool2d_core(x, ptype, ksize, strides, pads, global_pooling, exclusive,
-                 adaptive=False):
+                 adaptive=False, ceil_mode=False):
     if global_pooling or adaptive and tuple(ksize) == (1, 1):
         axis = (2, 3)
         if ptype == "max":
@@ -89,14 +89,24 @@ def _pool2d_core(x, ptype, ksize, strides, pads, global_pooling, exclusive,
     pads = _pair(pads)
     window = (1, 1) + ksize
     ws = (1, 1) + strides
-    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    extra = (0, 0)
+    if ceil_mode:
+        # v1 default (PoolLayer ceil): pad right/bottom so partial windows
+        # produce an output element
+        def _extra(size, k, p, s):
+            rem = (size + 2 * p - k) % s
+            return (s - rem) % s if rem else 0
+        extra = (_extra(x.shape[2], ksize[0], pads[0], strides[0]),
+                 _extra(x.shape[3], ksize[1], pads[1], strides[1]))
+    padding = ((0, 0), (0, 0), (pads[0], pads[0] + extra[0]),
+               (pads[1], pads[1] + extra[1]))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max,
                                  window, ws, padding)
     s = lax.reduce_window(x, 0.0, lax.add,
                           window, ws, padding)
-    if exclusive and (pads[0] or pads[1]):
+    if exclusive and (pads[0] or pads[1] or extra[0] or extra[1]):
         ones = jnp.ones_like(x)
         cnt = lax.reduce_window(ones, 0.0, lax.add,
                                 window, ws, padding)
@@ -110,7 +120,8 @@ def _pool2d(ctx, ins, attrs):
     out = _pool2d_core(
         x, attrs.get("pooling_type", "max"), attrs.get("ksize", [2, 2]),
         attrs.get("strides", [1, 1]), attrs.get("paddings", [0, 0]),
-        attrs.get("global_pooling", False), attrs.get("exclusive", True))
+        attrs.get("global_pooling", False), attrs.get("exclusive", True),
+        ceil_mode=attrs.get("ceil_mode", False))
     return {"Out": out}
 
 

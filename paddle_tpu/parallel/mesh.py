@@ -62,6 +62,20 @@ def make_mesh(config: Optional[MeshConfig] = None,
     return Mesh(arr, axis_names=tuple(axis_names))
 
 
+def make_hybrid_mesh(ici_config: MeshConfig, dcn_dp: int = 1,
+                     dcn_pp: int = 1) -> Mesh:
+    """Multi-slice/multi-host mesh: outer axes span DCN (slow network),
+    inner axes stay on ICI — the scaling-book layout where only dp/pp
+    gradients ride DCN.  Axis names: dcn_dp, dcn_pp + the ICI axes."""
+    from jax.experimental import mesh_utils
+    names = [n for n, s in ici_config.axis_sizes()]
+    sizes = [s for n, s in ici_config.axis_sizes()]
+    dev = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=sizes, dcn_mesh_shape=[dcn_dp, dcn_pp] + [1] * (len(sizes) - 2),
+        devices=jax.devices())
+    return Mesh(dev, axis_names=tuple(names))
+
+
 def get_mesh() -> Mesh:
     """The ambient mesh (set with mesh_guard), defaulting to a 1-D 'dp' mesh
     over all local devices."""

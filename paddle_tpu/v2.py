@@ -1,0 +1,177 @@
+"""paddle.v2 API compatibility namespace.
+
+Reference: python/paddle/v2/__init__.py — the surface the v1_api_demo scripts
+and cluster tutorials drive: ``paddle.init``, ``paddle.layer.*`` (DSL),
+``paddle.activation.*``, ``paddle.optimizer.*``, ``paddle.trainer.SGD``,
+``paddle.dataset``, ``paddle.reader``, ``paddle.batch``, ``paddle.infer``,
+``paddle.parameters``.
+
+Usage (a v1_api_demo/mnist/api_train.py-shaped script)::
+
+    import paddle_tpu.v2 as paddle
+    paddle.init(use_gpu=False, trainer_count=1)
+    images = paddle.layer.data(name='pixel', size=784)
+    label = paddle.layer.data(name='label', size=10)
+    h = paddle.layer.fc(input=images, size=128,
+                        act=paddle.activation.Relu())
+    out = paddle.layer.fc(input=h, size=10,
+                          act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=out, label=label)
+    trainer = paddle.trainer.SGD(
+        cost=cost, update_equation=paddle.optimizer.Momentum(0.9,
+                                                             learning_rate=0.1))
+    trainer.train(paddle.batch(paddle.dataset.mnist.train(), 128),
+                  num_passes=2, event_handler=...)
+"""
+from __future__ import annotations
+
+import types as _types
+
+from . import dataset, reader  # noqa: F401
+from . import trainer as _trainer_mod
+from . import optimizer as _opt
+from .reader import batch  # noqa: F401
+from .trainer import events, infer  # noqa: F401
+from . import trainer_config_helpers as _dsl
+
+
+def init(use_gpu=None, use_tpu=None, trainer_count=1, **kw):
+    """paddle.init — device selection is owned by JAX/XLA; flags recorded."""
+    from . import flags
+    if trainer_count:
+        flags.set_flag("trainer_count", trainer_count)
+    return None
+
+
+# -- paddle.layer ------------------------------------------------------------
+layer = _types.SimpleNamespace(
+    data=_dsl.data_layer,
+    fc=_dsl.fc_layer,
+    img_conv=_dsl.img_conv_layer,
+    img_pool=_dsl.img_pool_layer,
+    img_cmrnorm=_dsl.img_cmrnorm_layer,
+    batch_norm=_dsl.batch_norm_layer,
+    dropout=_dsl.dropout_layer,
+    embedding=_dsl.embedding_layer,
+    concat=_dsl.concat_layer,
+    addto=_dsl.addto_layer,
+    lstmemory=_dsl.lstmemory,
+    simple_lstm=_dsl.simple_lstm,
+    last_seq=_dsl.last_seq,
+    first_seq=_dsl.first_seq,
+    classification_cost=_dsl.classification_cost,
+    cross_entropy_cost=_dsl.cross_entropy_cost,
+    square_error_cost=_dsl.regression_cost,
+    regression_cost=_dsl.regression_cost,
+)
+
+# -- paddle.activation / paddle.pooling --------------------------------------
+activation = _types.SimpleNamespace(
+    Linear=_dsl.LinearActivation, Relu=_dsl.ReluActivation,
+    Sigmoid=_dsl.SigmoidActivation, Tanh=_dsl.TanhActivation,
+    Softmax=_dsl.SoftmaxActivation, Identity=_dsl.IdentityActivation,
+)
+pooling = _types.SimpleNamespace(
+    Max=_dsl.MaxPooling, Avg=_dsl.AvgPooling, Sum=_dsl.SumPooling,
+)
+
+
+# -- paddle.optimizer (v2 signature: momentum first, lr kwarg) ---------------
+class _V2Opt:
+    def _make(self):
+        raise NotImplementedError
+
+
+class Momentum(_V2Opt):
+    def __init__(self, momentum=0.9, learning_rate=1e-3, regularization=None,
+                 **kw):
+        self._o = _opt.Momentum(learning_rate=learning_rate,
+                                momentum=momentum,
+                                regularization=_reg(regularization))
+
+    def _make(self):
+        return self._o
+
+
+class Adam(_V2Opt):
+    def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999,
+                 regularization=None, **kw):
+        self._o = _opt.Adam(learning_rate=learning_rate, beta1=beta1,
+                            beta2=beta2,
+                            regularization=_reg(regularization))
+
+    def _make(self):
+        return self._o
+
+
+class AdaGrad(_V2Opt):
+    def __init__(self, learning_rate=1e-3, regularization=None, **kw):
+        self._o = _opt.Adagrad(learning_rate=learning_rate,
+                               regularization=_reg(regularization))
+
+    def _make(self):
+        return self._o
+
+
+class RMSProp(_V2Opt):
+    def __init__(self, learning_rate=1e-3, regularization=None, **kw):
+        self._o = _opt.RMSProp(learning_rate=learning_rate,
+                               regularization=_reg(regularization))
+
+    def _make(self):
+        return self._o
+
+
+def _reg(r):
+    if r is None:
+        return None
+    if hasattr(r, "make"):
+        return r.make()
+    return r
+
+
+optimizer = _types.SimpleNamespace(Momentum=Momentum, Adam=Adam,
+                                   AdaGrad=AdaGrad, RMSProp=RMSProp)
+
+
+# -- paddle.parameters (the v2 Parameters facade over the scope) -------------
+class Parameters:
+    """v2 parameters.create analog: a view over the global scope."""
+
+    @staticmethod
+    def create(*cost):
+        return Parameters()
+
+    def keys(self):
+        from .core.scope import global_scope
+        return global_scope().keys()
+
+    def get(self, name):
+        import numpy as np
+        from .core.scope import global_scope
+        return np.asarray(global_scope().get(name))
+
+    def set(self, name, value):
+        import jax.numpy as jnp
+        from .core.scope import global_scope
+        global_scope().set(name, jnp.asarray(value))
+
+
+parameters = _types.SimpleNamespace(create=Parameters.create,
+                                    Parameters=Parameters)
+
+
+# -- paddle.trainer ----------------------------------------------------------
+class _SGDShim(_trainer_mod.SGD):
+    """v2 SGD(cost, parameters=None, update_equation=v2-optimizer)."""
+
+    def __init__(self, cost=None, parameters=None, update_equation=None,
+                 extra_layers=None, is_local=True, **kw):
+        ue = update_equation._make() if isinstance(update_equation, _V2Opt) \
+            else update_equation
+        super().__init__(cost, parameters=parameters, update_equation=ue,
+                         extra_layers=extra_layers or (), is_local=is_local)
+
+
+trainer = _types.SimpleNamespace(SGD=_SGDShim)
+event = events
