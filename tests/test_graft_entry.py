@@ -1,0 +1,53 @@
+"""Driver-path regression tests for ``__graft_entry__``.
+
+Round-1 failure (MULTICHIP_r01.json ok=false): the driver's process had
+already initialized the JAX backend (single real TPU) before calling
+``dryrun_multichip``, so env/config mutation inside the function was dead
+and the device-count assert fired.  These tests run the entry module the
+way the driver does — in a bare subprocess whose backend is initialized
+*before* the call, with only ONE visible device — and require success.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code, extra_env=None, timeout=1800):
+    env = dict(os.environ)
+    # Simulate the driver's bare environment: single-device platform, no
+    # virtual-mesh flags inherited from the test conftest.
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env.update(extra_env or {})
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_dryrun_multichip_after_backend_init():
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import jax\n"
+        "assert len(jax.devices()) == 1, jax.devices()\n"
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"
+        "print('DRYRUN_OK')\n" % REPO
+    )
+    proc = _run(code)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-4000:])
+    assert "DRYRUN_OK" in proc.stdout
+
+
+def test_entry_compiles_single_chip():
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import jax\n"
+        "import __graft_entry__ as g\n"
+        "fn, args = g.entry()\n"
+        "jax.jit(fn).lower(*args).compile()\n"
+        "print('ENTRY_OK')\n" % REPO
+    )
+    proc = _run(code)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-4000:])
+    assert "ENTRY_OK" in proc.stdout
