@@ -67,6 +67,11 @@ class DataFeeder:
                     return native.pad_batch(list(col),
                                             self.seq_bucket_multiple,
                                             dt.name)
+                except ValueError:
+                    # bad input (inconsistent row dims etc.) — surface the
+                    # native path's diagnostic rather than letting the numpy
+                    # fallback fail with an unrelated broadcast error
+                    raise
                 except Exception:
                     pass
         lens = np.asarray([len(r) for r in col], np.int32)

@@ -1163,7 +1163,8 @@ def flash_attention(q, k, v, causal=False, block_q=512, block_k=512,
     [B, T, H, D] or [BH, T, D].  The long-context path the reference never
     had — pairs with parallel.ring_attention for sp-sharded sequences."""
     helper = LayerHelper("flash_attention", name=name)
-    out = helper.create_variable_for_type_inference(q.dtype, q.shape)
+    out_shape = tuple(q.shape[:-1]) + (v.shape[-1],)
+    out = helper.create_variable_for_type_inference(q.dtype, out_shape)
     helper.append_op(type="flash_attention",
                      inputs={"Q": [q], "K": [k], "V": [v]},
                      outputs={"Out": [out]},

@@ -38,22 +38,39 @@ static PyObject* pad_batch(PyObject* self, PyObject* args) {
   if (!seq) return nullptr;
   Py_ssize_t B = PySequence_Fast_GET_SIZE(seq);
 
-  // first pass: lengths and (for 2-D rows) the feature dim
+  // first pass: lengths and (for 2-D rows) the feature dim.  Every row must
+  // agree on the feature dim (0 = scalar timesteps); otherwise the copy pass
+  // below would read/write with a mismatched stride.
   std::vector<Py_ssize_t> lens(B);
-  Py_ssize_t T = 1, D = 0;  // D==0 => scalar timesteps
+  Py_ssize_t T = 1, D = -1;  // D: -1 unset, 0 => scalar timesteps
   for (Py_ssize_t i = 0; i < B; ++i) {
     PyObject* row = PySequence_Fast_GET_ITEM(seq, i);
+    Py_ssize_t row_d = 0;
     if (PyArray_Check(row)) {
       PyArrayObject* a = (PyArrayObject*)row;
+      if (PyArray_NDIM(a) > 2) {
+        PyErr_Format(PyExc_ValueError,
+                     "pad_batch: row %zd has ndim %d (max 2)", i,
+                     PyArray_NDIM(a));
+        Py_DECREF(seq); return nullptr;
+      }
       lens[i] = PyArray_NDIM(a) > 0 ? PyArray_DIM(a, 0) : 1;
-      if (PyArray_NDIM(a) > 1) D = PyArray_DIM(a, 1);
+      if (PyArray_NDIM(a) > 1) row_d = PyArray_DIM(a, 1);
     } else {
       Py_ssize_t n = PySequence_Size(row);
       if (n < 0) { Py_DECREF(seq); return nullptr; }
       lens[i] = n;
     }
+    if (D == -1) D = row_d;
+    else if (row_d != D) {
+      PyErr_Format(PyExc_ValueError,
+                   "pad_batch: inconsistent feature dims across rows "
+                   "(row %zd has dim %zd, expected %zd)", i, row_d, D);
+      Py_DECREF(seq); return nullptr;
+    }
     if (lens[i] > T) T = lens[i];
   }
+  if (D < 0) D = 0;  // empty batch
   if (bucket > 1) T = ((T + bucket - 1) / bucket) * bucket;
 
   bool is_f32 = strcmp(dtype, "float32") == 0;
@@ -117,6 +134,11 @@ struct Batcher {
   size_t capacity;
   bool done;
   bool stop;
+  // exception raised by the reader callable in the worker thread, to be
+  // re-raised from next_batch() on the consumer thread
+  PyObject* err_type;
+  PyObject* err_value;
+  PyObject* err_tb;
 };
 
 static void batcher_worker(Batcher* b) {
@@ -131,7 +153,11 @@ static void batcher_worker(Batcher* b) {
     PyObject* batch = PyObject_CallObject(b->next_fn, nullptr);
     bool end = (batch == nullptr) || (batch == Py_None);
     if (batch == Py_None) { Py_DECREF(batch); batch = nullptr; }
-    if (batch == nullptr && PyErr_Occurred()) PyErr_Clear();
+    if (batch == nullptr && PyErr_Occurred()) {
+      // park the exception for the consumer thread; do NOT swallow it
+      std::lock_guard<std::mutex> lk(*b->mu);
+      PyErr_Fetch(&b->err_type, &b->err_value, &b->err_tb);
+    }
     PyGILState_Release(g);
     {
       std::lock_guard<std::mutex> lk(*b->mu);
@@ -162,6 +188,9 @@ static PyObject* batcher_new(PyTypeObject* type, PyObject* args,
   b->capacity = (size_t)capacity;
   b->done = false;
   b->stop = false;
+  b->err_type = nullptr;
+  b->err_value = nullptr;
+  b->err_tb = nullptr;
   b->worker = new std::thread(batcher_worker, b);
   return (PyObject*)b;
 }
@@ -179,7 +208,16 @@ static PyObject* batcher_next_batch(PyObject* self, PyObject*) {
   }
   Py_END_ALLOW_THREADS
   b->cv_put->notify_all();
-  if (out == nullptr) Py_RETURN_NONE;
+  if (out == nullptr) {
+    PyObject *t = nullptr, *v = nullptr, *tb = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(*b->mu);
+      t = b->err_type; v = b->err_value; tb = b->err_tb;
+      b->err_type = b->err_value = b->err_tb = nullptr;
+    }
+    if (t) { PyErr_Restore(t, v, tb); return nullptr; }
+    Py_RETURN_NONE;
+  }
   return out;  // ownership transferred
 }
 
@@ -213,6 +251,9 @@ static void batcher_dealloc(PyObject* self) {
   delete b->mu;
   delete b->cv_put;
   delete b->cv_get;
+  Py_XDECREF(b->err_type);
+  Py_XDECREF(b->err_value);
+  Py_XDECREF(b->err_tb);
   Py_XDECREF(b->next_fn);
   Py_TYPE(self)->tp_free(self);
 }

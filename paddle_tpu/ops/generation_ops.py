@@ -27,9 +27,21 @@ def _beam_search(ctx, ins, attrs):
     lp = float(attrs.get("length_penalty", 0.0))
 
     ctx_names = attrs.get("ctx_step_names", [])
-    inits = [jnp.repeat(v, K, axis=0) for v in ins.get("InitStates", [])]
-    ctxs = [jnp.repeat(v, K, axis=0) for v in ins.get("Contexts", [])]
-    B = ins["InitStates"][0].shape[0]
+    init_in = ins.get("InitStates", [])
+    ctx_in = ins.get("Contexts", [])
+    inits = [jnp.repeat(v, K, axis=0) for v in init_in]
+    ctxs = [jnp.repeat(v, K, axis=0) for v in ctx_in]
+    # batch size from whichever input exists — a stateless decoder (no
+    # memory()) legitimately has no InitStates
+    if init_in:
+        B = init_in[0].shape[0]
+    elif ctx_in:
+        B = ctx_in[0].shape[0]
+    else:
+        raise ValueError(
+            "beam_search: cannot infer batch size — decoder registered "
+            "neither memories (InitStates) nor context inputs (Contexts); "
+            "pass at least one non-step input to BeamSearchDecoder")
     BK = B * K
     env = ctx.env
 

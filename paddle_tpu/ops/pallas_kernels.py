@@ -83,9 +83,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    BH, T, D = q.shape
-    nk = T // block_k
-    grid = (BH, T // block_q, nk)
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    Dv = v.shape[2]
+    nk = Tk // block_k
+    grid = (BH, Tq // block_q, nk)
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
@@ -94,16 +96,16 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         functools.partial(_flash_kernel, block_k=block_k, num_k_blocks=nk,
                           causal=causal, sm_scale=sm_scale,
                           block_q=block_q),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, Dv), q.dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda i, j, kb: (i, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, block_k, D), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, Dv), lambda i, j, kb: (i, kb, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda i, j, kb: (i, j, 0)),
+        out_specs=pl.BlockSpec((1, block_q, Dv), lambda i, j, kb: (i, j, 0)),
         scratch_shapes=[
-            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, Dv), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
@@ -115,8 +117,8 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 def _reference_attention(q, k, v, causal, sm_scale):
     s = jnp.einsum("bqd,bkd->bqk", q * sm_scale, k)
     if causal:
-        T = s.shape[-1]
-        mask = jnp.tril(jnp.ones((T, T), bool))
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
         s = jnp.where(mask[None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bqk,bkd->bqd", p, v)
@@ -148,50 +150,46 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
                     block_k=128, use_pallas=None, interpret=None):
     """Fused attention.  q,k,v: [B, T, H, D] (or [BH, T, D]).
 
-    use_pallas=None auto-selects: the Pallas kernel on TPU, interpret-mode
-    kernel under explicit request, jnp reference otherwise.
+    use_pallas=None auto-selects the Pallas kernel on TPU only; every other
+    backend gets the exact jnp reference.  interpret=True (explicit, as the
+    CPU tests do) runs the kernel through the Pallas interpreter instead.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     squeeze_heads = q.ndim == 4
     if squeeze_heads:
-        B, T, H, D = q.shape
-        rs = lambda x: jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)
+        B, Tq_out, H, _ = q.shape
+
+        def rs(x):
+            b, t, h, d = x.shape
+            return jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
+
         q3, k3, v3 = rs(q), rs(k), rs(v)
     else:
         q3, k3, v3 = q, k, v
+    if q3.shape[-1] != k3.shape[-1]:
+        raise ValueError(
+            f"flash_attention: q feature dim {q3.shape[-1]} != k feature "
+            f"dim {k3.shape[-1]}")
     if use_pallas is None:
-        use_pallas = _HAVE_PALLAS and \
-            jax.devices()[0].platform not in ("cpu",)
-    if interpret is None:
-        interpret = jax.devices()[0].platform == "cpu"
-    T = q3.shape[1]
+        use_pallas = _HAVE_PALLAS and jax.default_backend() == "tpu"
+    interpret = bool(interpret)
+    Tq, Tk = q3.shape[1], k3.shape[1]
     if use_pallas or interpret:
-        bq = min(block_q, T)
-        bk = min(block_k, T)
-        pad = (-T) % bq
-        padk = (-T) % bk
-        padn = max(pad, padk)
-        if padn:
-            # pad keys with NEG_INF-masked zeros: enforce via an extra mask
-            # on scores is not expressible here, so pad and fix lengths by
-            # masking value rows to zero and key rows to -inf via q padding
-            q3 = jnp.pad(q3, ((0, 0), (0, padn), (0, 0)))
-            k3 = jnp.pad(k3, ((0, 0), (0, padn), (0, 0)),
-                         constant_values=0.0)
-            v3 = jnp.pad(v3, ((0, 0), (0, padn), (0, 0)))
-            # zero-padded keys produce score 0; mask them by shifting with
-            # a large negative bias folded into k's last feature is fragile,
-            # so fall back to reference for ragged tails
-            out = _reference_attention(q3[:, :T], k3[:, :T], v3[:, :T],
-                                       causal, sm_scale)
+        bq = min(block_q, Tq)
+        bk = min(block_k, Tk)
+        if Tq % bq or Tk % bk or (causal and Tq != Tk):
+            # ragged tail (kernel needs block-divisible lengths) or causal
+            # cross-attention (kernel's diagonal offset assumes Tq==Tk):
+            # run the exact jnp reference
+            out = _reference_attention(q3, k3, v3, causal, sm_scale)
         else:
-            out = _flash(q3, k3, v3, causal, sm_scale, bq, bk,
-                         bool(interpret))
+            out = _flash(q3, k3, v3, causal, sm_scale, bq, bk, interpret)
     else:
         out = _reference_attention(q3, k3, v3, causal, sm_scale)
     if squeeze_heads:
-        out = jnp.moveaxis(out.reshape(B, H, T, D), 1, 2)
+        out = jnp.moveaxis(
+            out.reshape(B, H, Tq_out, v.shape[-1]), 1, 2)
     return out
 
 

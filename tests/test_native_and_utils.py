@@ -69,6 +69,41 @@ def test_async_batcher_order_and_end():
     assert got == list(range(100))
 
 
+def test_async_batcher_propagates_reader_errors():
+    """A bug in the user's reader must surface, not silently end the epoch
+    (reference contrast: PyDataProvider2 forwards provider exceptions)."""
+    n = _native()
+    state = {"i": 0}
+
+    def nxt():
+        state["i"] += 1
+        if state["i"] == 3:
+            raise RuntimeError("reader exploded")
+        return (state["i"],)
+
+    b = n.AsyncBatcher(nxt, capacity=2)
+    got = []
+    with pytest.raises(RuntimeError, match="reader exploded"):
+        while True:
+            item = b.next_batch()
+            if item is None:
+                break
+            got.append(item[0])
+    b.close()
+    assert got == [1, 2]
+
+
+def test_pad_batch_rejects_inconsistent_dims():
+    n = _native()
+    with pytest.raises(ValueError, match="inconsistent feature dims"):
+        n.pad_batch([np.ones((2, 3), "float32"),
+                     np.ones((2, 4), "float32")], 1, "float32")
+    with pytest.raises(ValueError, match="inconsistent feature dims"):
+        n.pad_batch([np.ones((2, 3), "float32"), [1.0, 2.0]], 1, "float32")
+    with pytest.raises(ValueError, match="ndim"):
+        n.pad_batch([np.ones((2, 3, 4), "float32")], 1, "float32")
+
+
 def test_native_buffered_reader():
     r = pt.reader.native_buffered(lambda: iter(range(50)), size=4)
     assert list(r()) == list(range(50))

@@ -67,6 +67,35 @@ def test_flash_gradient_matches_reference():
                                    atol=1e-4, rtol=1e-4)
 
 
+def test_flash_cross_attention_kernel():
+    """Tq != Tk and Dv != Dq run through the kernel itself (encoder-decoder
+    attention): the key-block count must come from K's length and the output
+    feature dim from V's."""
+    BH, Tq, Tk, D, Dv = 2, 64, 128, 16, 32
+    q = R.randn(BH, Tq, D).astype("float32")
+    k = R.randn(BH, Tk, D).astype("float32")
+    v = R.randn(BH, Tk, Dv).astype("float32")
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          block_q=64, block_k=64, use_pallas=True,
+                          interpret=True)
+    assert out.shape == (BH, Tq, Dv)
+    np.testing.assert_allclose(np.asarray(out),
+                               _ref(q, k, v, False, D ** -0.5),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_causal_cross_falls_back():
+    BH, Tq, Tk, D = 1, 64, 128, 16
+    q = R.randn(BH, Tq, D).astype("float32")
+    k = R.randn(BH, Tk, D).astype("float32")
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(k),
+                          causal=True, block_q=64, block_k=64,
+                          use_pallas=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               _ref(q, k, k, True, D ** -0.5),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_flash_ragged_tail_falls_back():
     BH, T, D = 1, 100, 16     # not a block multiple
     q = R.randn(BH, T, D).astype("float32")
