@@ -19,11 +19,23 @@ class Scope:
     def __init__(self, parent: Optional["Scope"] = None):
         self._vars: Dict[str, object] = {}
         self.parent = parent
+        # bumped when the KEY SET changes (not on value replacement) — lets
+        # the executor cache name-resolution work across steps
+        self._keys_version = 0
 
     def new_scope(self) -> "Scope":
         return Scope(parent=self)
 
+    def keys_version(self) -> int:
+        v, s = 0, self
+        while s is not None:
+            v += s._keys_version
+            s = s.parent
+        return v
+
     def set(self, name: str, value):
+        if name not in self._vars:
+            self._keys_version += 1
         self._vars[name] = value
 
     def get(self, name: str):
@@ -52,12 +64,15 @@ class Scope:
         return self._vars.items()
 
     def delete(self, name: str):
+        if name in self._vars:
+            self._keys_version += 1
         self._vars.pop(name, None)
 
     def numpy(self, name: str) -> np.ndarray:
         return np.asarray(self.get(name))
 
     def clear(self):
+        self._keys_version += 1
         self._vars.clear()
 
     def __contains__(self, name):

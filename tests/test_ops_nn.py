@@ -73,6 +73,25 @@ def test_conv2d_grad():
                max_relative_error=2e-2)
 
 
+def test_conv2d_stem_space_to_depth():
+    """7x7/s2/p3 stem conv triggers the space-to-depth rewrite; must be
+    exact vs the direct formulation (padded taps are zero)."""
+    x = R.rand(2, 3, 16, 16).astype("float32")
+    w = R.rand(8, 3, 7, 7).astype("float32")
+    check_output("conv2d", {"Input": ("x", x), "Filter": ("w", w)},
+                 {"strides": [2, 2], "paddings": [3, 3]},
+                 {"Output": np_conv2d(x, w, 2, 3)}, atol=1e-3, rtol=1e-3)
+
+
+def test_conv2d_stem_space_to_depth_grad():
+    x = R.rand(1, 3, 10, 10).astype("float32")
+    w = R.rand(2, 3, 7, 7).astype("float32")
+    check_grad("conv2d", {"Input": ("x", x), "Filter": ("w", w)},
+               {"strides": [2, 2], "paddings": [3, 3]},
+               wrt=["x", "w"], out_slots=["Output"],
+               max_relative_error=2e-2)
+
+
 def test_conv2d_groups():
     x = R.rand(1, 4, 6, 6).astype("float32")
     w = R.rand(4, 2, 3, 3).astype("float32")
