@@ -25,6 +25,7 @@ into a single ``jax.jit`` function per (program-version, feed-signature):
 from __future__ import annotations
 
 import logging
+import weakref
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -316,7 +317,6 @@ class Executor:
         # executables whose parameters carry another compile's exotic layout.
         self.auto_layout = auto_layout
         self._cache: Dict = {}
-        self._state_keys_cache: Dict = {}
         self._fmt_registry: Dict = {}  # state var name -> pinned Format
         self._step = 0
 
@@ -358,11 +358,16 @@ class Executor:
                tuple(sorted((n, a.shape, str(a.dtype))
                             for n, a in feed_arrays.items())),
                tuple(fetch_names), tuple(sorted(state_keys)), is_test)
-        fn = self._cache.get(sig)
+        entry = self._cache.get(sig)
+        fn = None
+        if entry is not None:
+            prog_ref, fn = entry
+            if prog_ref() is not program:   # id() reuse after GC
+                fn = None
         if fn is None:
             fn = self._build(program, sorted(feed_arrays), fetch_names,
                              sorted(state_keys), is_test)
-            self._cache[sig] = fn
+            self._cache[sig] = (weakref.ref(program), fn)
 
         step = self._step
         self._step += 1
@@ -383,16 +388,24 @@ class Executor:
     def _state_keys(self, program: Program, scope: Scope) -> List[str]:
         """Persistable vars referenced by the program that exist in scope.
 
-        Cached per (program identity+version, scope identity+key set): this
-        walks every op in the program, which would otherwise dominate the
-        per-step host time for big nets (~ms/step on ResNet-50).
+        Cached on the Program object (dies with it; cleared on version bump)
+        with a weakref identity check on the Scope so an id()-reusing new
+        Scope can never hit a stale entry.  This walks every op in the
+        program, which would otherwise dominate the per-step host time for
+        big nets (~ms/step on ResNet-50).
         """
-        ck = (id(program), program.version, id(scope), scope.keys_version())
-        hit = self._state_keys_cache.get(ck)
-        if hit is not None:
-            return hit
+        cache = getattr(program, "_state_keys_cache", None)
+        if cache is None or cache["version"] != program.version:
+            cache = {"version": program.version, "entries": {}}
+            program._state_keys_cache = cache
+        sk = (id(scope), scope.keys_version())
+        entry = cache["entries"].get(sk)
+        if entry is not None:
+            scope_ref, keys = entry
+            if scope_ref() is scope:
+                return keys
         keys = self._state_keys_uncached(program, scope)
-        self._state_keys_cache[ck] = keys
+        cache["entries"][sk] = (weakref.ref(scope), keys)
         return keys
 
     def _state_keys_uncached(self, program: Program,
@@ -519,23 +532,32 @@ class _AutoLayoutStep:
         if self._failed:
             return self._plain(feeds, state, step)
         step = np.int64(step)
-        try:
-            if self._compiled is None:
+        if self._compiled is None:
+            # Only the compile/layout-API phase may fall back: a failure here
+            # means AUTO layouts are unavailable, not that the program is
+            # broken.  Execution errors below must propagate — the state has
+            # been donated, so a silent plain-jit re-run would operate on
+            # deleted buffers and mask the real error.
+            try:
                 self._compiled = self._compile(feeds, state, step)
                 state = jax.tree.map(jax.device_put, state,
                                      self._state_formats)
-            try:
-                return self._compiled(feeds, state, step)
-            except ValueError:
-                # state arrays in foreign layouts (first step after a
-                # checkpoint restore etc.): normalize and retry
-                state = jax.tree.map(jax.device_put, state,
-                                     self._state_formats)
-                return self._compiled(feeds, state, step)
-        except Exception:
-            # layout API unavailable / backend quirk: plain jit forever
-            self._failed = True
-            return self._plain(feeds, state, step)
+            except Exception as e:
+                logger.warning(
+                    "auto_layout: AUTO-layout compilation failed (%s: %s); "
+                    "this executor falls back to plain jit permanently",
+                    type(e).__name__, e)
+                self._failed = True
+                return self._plain(feeds, state, step)
+        try:
+            return self._compiled(feeds, state, step)
+        except ValueError:
+            # state arrays in foreign layouts (first step after a
+            # checkpoint restore etc.): this is raised at argument-check
+            # time, before donation — normalize and retry
+            state = jax.tree.map(jax.device_put, state,
+                                 self._state_formats)
+            return self._compiled(feeds, state, step)
 
 
 def _nan_check_impl(names, fetches):

@@ -72,6 +72,12 @@ class Variable:
     def program(self) -> "Program":
         return self.block.program
 
+    @property
+    def ndim(self) -> int:
+        if self.shape is None:
+            raise ValueError(f"Variable {self.name!r} has no static shape")
+        return len(self.shape)
+
     def astype(self, dtype):
         from .. import layers
         return layers.cast(x=self, dtype=dtype)

@@ -64,11 +64,12 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     # route 3-D [B, T, D] self/cross attention through the fused kernel;
     # 4-D callers here historically used [B, H, T, D], which conflicts with
     # flash_attention's [B, T, H, D] convention, so keep those on matmuls
-    if dropout_rate == 0.0 and queries.ndim == 3:
+    if dropout_rate == 0.0 and len(queries.shape) == 3:
         return layers.flash_attention(queries, keys, values)
     d = queries.shape[-1]
     scaled_q = layers.scale(queries, scale=float(d) ** -0.5)
     logits = layers.matmul(scaled_q, keys, transpose_y=True)
     weights = layers.softmax(logits)
-    weights = layers.dropout(weights, dropout_rate)
+    if dropout_rate > 0.0:
+        weights = layers.dropout(weights, dropout_rate)
     return layers.matmul(weights, values)

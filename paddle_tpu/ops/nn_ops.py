@@ -215,7 +215,11 @@ def _batch_norm(ctx, ins, attrs):
     else:
         # single-pass stats: mean and mean-of-squares with fp32 accumulation
         # (one read of x for both reductions; under AMP x is bf16 and the
-        # fp32 accumulate keeps the stats honest)
+        # fp32 accumulate keeps the stats honest).  Caveat: E[x^2]-E[x]^2
+        # cancels catastrophically when |mean| >> std; the fp32 accumulate
+        # and the clamp below bound the damage, and post-BN activations in
+        # practice are near zero-mean, but a pathological input distribution
+        # can lose stat precision vs the two-pass form.
         use_mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
         m2 = jnp.mean(lax.square(x.astype(jnp.float32)), axis=axes)
         use_var = jnp.maximum(m2 - lax.square(use_mean), 0.0)
