@@ -1,0 +1,110 @@
+"""Composite net helper tests (reference: fluid/nets.py users, e.g.
+fluid/tests/book image/sentiment configs and test_machine_translation's
+attention block)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, nets
+
+
+def np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis, keepdims=True))
+    return e / e.sum(axis, keepdims=True)
+
+
+def np_attention(q, k, v):
+    d = q.shape[-1]
+    logits = (q * d ** -0.5) @ np.swapaxes(k, -1, -2)
+    return np_softmax(logits) @ v
+
+
+@pytest.mark.parametrize("shape", [(2, 16, 8), (2, 4, 16, 8)])
+def test_scaled_dot_product_attention(rng, shape):
+    """3-D inputs route through the fused flash-attention kernel, 4-D
+    through the matmul fallback; both must match the numpy reference."""
+    q = rng.randn(*shape).astype(np.float32)
+    k = rng.randn(*shape).astype(np.float32)
+    v = rng.randn(*shape).astype(np.float32)
+    qv = layers.data("q", shape=list(shape), dtype="float32",
+                     append_batch_size=False)
+    kv = layers.data("k", shape=list(shape), dtype="float32",
+                     append_batch_size=False)
+    vv = layers.data("v", shape=list(shape), dtype="float32",
+                     append_batch_size=False)
+    out = nets.scaled_dot_product_attention(qv, kv, vv)
+    exe = pt.Executor()
+    (o,) = exe.run(feed={"q": q, "k": k, "v": v}, fetch_list=[out])
+    np.testing.assert_allclose(o, np_attention(q, k, v), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_scaled_dot_product_attention_dropout_path(rng):
+    """dropout_rate > 0 uses the unfused path; at test time (is_test) the
+    default downgrade_in_infer dropout scales the attention weights by
+    (1 - rate), so the output is (1 - rate) * reference."""
+    shape = (2, 6, 8)
+    q = rng.randn(*shape).astype(np.float32)
+    k = rng.randn(*shape).astype(np.float32)
+    v = rng.randn(*shape).astype(np.float32)
+    qv = layers.data("q", shape=list(shape), dtype="float32",
+                     append_batch_size=False)
+    kv = layers.data("k", shape=list(shape), dtype="float32",
+                     append_batch_size=False)
+    vv = layers.data("v", shape=list(shape), dtype="float32",
+                     append_batch_size=False)
+    out = nets.scaled_dot_product_attention(qv, kv, vv, dropout_rate=0.3)
+    # the dropout op must be present on this path...
+    assert any(op.type == "dropout"
+               for op in pt.default_main_program().current_block().ops)
+    exe = pt.Executor()
+    (o,) = exe.run(feed={"q": q, "k": k, "v": v}, fetch_list=[out],
+                   is_test=True)
+    np.testing.assert_allclose(o, 0.7 * np_attention(q, k, v), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_scaled_dot_product_attention_no_dropout_op_at_rate_zero(rng):
+    """rate 0.0 must not append a dropout op (it would burn an RNG key and
+    perturb the stream for downstream ops)."""
+    shape = (2, 4, 6, 8)  # 4-D: matmul path, where the guard lives
+    qv = layers.data("q", shape=list(shape), dtype="float32",
+                     append_batch_size=False)
+    nets.scaled_dot_product_attention(qv, qv, qv, dropout_rate=0.0)
+    assert not any(op.type == "dropout"
+                   for op in pt.default_main_program().current_block().ops)
+
+
+def test_glu(rng):
+    x = rng.randn(3, 8).astype(np.float32)
+    xv = layers.data("x", shape=[8], dtype="float32")
+    out = nets.glu(xv)
+    exe = pt.Executor()
+    (o,) = exe.run(feed={"x": x}, fetch_list=[out])
+    a, b = np.split(x, 2, axis=-1)
+    np.testing.assert_allclose(o, a / (1 + np.exp(-b)), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_simple_img_conv_pool_shapes(rng):
+    x = rng.randn(2, 1, 28, 28).astype(np.float32)
+    xv = layers.data("img", shape=[1, 28, 28], dtype="float32")
+    out = nets.simple_img_conv_pool(xv, num_filters=4, filter_size=5,
+                                    pool_size=2, pool_stride=2, act="relu")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    (o,) = exe.run(feed={"img": x}, fetch_list=[out])
+    assert o.shape == (2, 4, 12, 12)
+    assert (o >= 0).all()
+
+
+def test_img_conv_group_with_batchnorm(rng):
+    x = rng.randn(2, 3, 16, 16).astype(np.float32)
+    xv = layers.data("img", shape=[3, 16, 16], dtype="float32")
+    out = nets.img_conv_group(xv, conv_num_filter=[4, 4], pool_size=2,
+                              conv_act="relu", conv_with_batchnorm=True,
+                              pool_stride=2)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    (o,) = exe.run(feed={"img": x}, fetch_list=[out])
+    assert o.shape == (2, 4, 8, 8)
