@@ -143,7 +143,9 @@ def test_checkpoint_roundtrip_and_corruption(tmp_path):
     assert cm.all_steps() == [2, 3]             # gc kept last 2
 
     # corrupt newest -> restore falls back to previous (pserver recovery)
-    with open(os.path.join(str(tmp_path), "ckpt-3", "w.npy"), "wb") as f:
+    import glob
+    (wfile,) = glob.glob(os.path.join(str(tmp_path), "ckpt-3", "w.*.npy"))
+    with open(wfile, "wb") as f:
         f.write(b"garbage")
     fresh = pt.Scope()
     step = cm.restore(scope=fresh)
