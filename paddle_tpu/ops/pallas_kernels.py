@@ -6,10 +6,14 @@ module provides a fused flash-attention kernel (online-softmax, O(T) memory,
 K/V streamed through VMEM) used by ``nets.scaled_dot_product_attention`` and
 available to models directly.
 
-The kernel computes exact attention; backward recomputes via the reference
-jnp implementation (jax.custom_vjp), trading FLOPs for not materializing the
-[T,T] probability matrix in the forward pass.  On non-TPU backends the jnp
-reference runs instead (CPU tests exercise the kernel in interpret mode).
+Both directions are fused kernels.  The forward computes exact attention and
+saves only the per-row logsumexp; the backward (FlashAttention-2 style)
+recomputes block-local probabilities from (q, k, lse) inside two Pallas
+kernels — one accumulating dq over key blocks, one accumulating dk/dv over
+query blocks — so the [T, T] probability matrix is never materialized in
+either direction and O(T) memory holds for *training*, not just inference.
+On non-TPU backends the jnp reference runs instead (CPU tests exercise the
+kernels in interpret mode).
 """
 from __future__ import annotations
 
@@ -30,14 +34,15 @@ NEG_INF = -1e30
 
 
 # ---------------------------------------------------------------------------
-# kernel
+# forward kernel
 # ---------------------------------------------------------------------------
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  block_k, num_k_blocks, causal, sm_scale, block_q):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                  *, block_k, num_k_blocks, causal, sm_scale, block_q):
     """Grid (bh, q_blocks, k_blocks), k innermost/sequential: K/V stream
     through VMEM one [block_k, D] tile at a time (O(T) memory), with the
     online-softmax running stats (m, l) and the output accumulator living in
-    VMEM scratch across the k dimension."""
+    VMEM scratch across the k dimension.  Also emits the per-row logsumexp
+    (the only residual the fused backward needs)."""
     j = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -78,11 +83,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(kb == num_k_blocks - 1)
     def _write():
-        o_ref[0] = (acc_ref[...] /
-                    jnp.maximum(l_ref[...], 1e-20)).astype(o_ref.dtype)
+        l_safe = jnp.maximum(l_ref[...], 1e-20)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l_safe)
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    """Returns (out, lse); lse is [BH, Tq, 1] float32."""
     BH, Tq, D = q.shape
     Tk = k.shape[1]
     Dv = v.shape[2]
@@ -96,14 +103,20 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         functools.partial(_flash_kernel, block_k=block_k, num_k_blocks=nk,
                           causal=causal, sm_scale=sm_scale,
                           block_q=block_q),
-        out_shape=jax.ShapeDtypeStruct((BH, Tq, Dv), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, Dv), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda i, j, kb: (i, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda i, j, kb: (i, kb, 0)),
             pl.BlockSpec((1, block_k, Dv), lambda i, j, kb: (i, kb, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, Dv), lambda i, j, kb: (i, j, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, Dv), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, Dv), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -112,6 +125,173 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         interpret=interpret,
         **kwargs,
     )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (FlashAttention-2: recompute p from (q, k, lse) per block)
+# ---------------------------------------------------------------------------
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, acc_ref, *, block_q, block_k, num_k_blocks,
+                         causal, sm_scale):
+    """Grid (bh, q_blocks, k_blocks), k innermost: dq for one query block
+    accumulates over streamed K/V blocks in a VMEM scratch."""
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q32 = q_ref[0].astype(jnp.float32) * sm_scale      # [bq, D]
+        kblk = k_ref[0].astype(jnp.float32)                # [bk, D]
+        vblk = v_ref[0].astype(jnp.float32)                # [bk, Dv]
+        do = do_ref[0].astype(jnp.float32)                 # [bq, Dv]
+        lse = lse_ref[0]                                   # [bq, 1]
+        delta = delta_ref[0]                               # [bq, 1]
+        s = jax.lax.dot_general(
+            q32, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        if causal:
+            qpos = j * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse)                               # normalized probs
+        dp = jax.lax.dot_general(
+            do, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - delta)
+        acc_ref[...] += jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, D]
+
+    if causal:
+        pl.when(kb * block_k <= (j + 1) * block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kb == num_k_blocks - 1)
+    def _write():
+        dq_ref[0] = (acc_ref[...] * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q,
+                          block_k, num_q_blocks, causal, sm_scale):
+    """Grid (bh, k_blocks, q_blocks), q innermost: dk/dv for one key block
+    accumulate over streamed Q/dO blocks in VMEM scratches."""
+    kb = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q32 = q_ref[0].astype(jnp.float32) * sm_scale      # [bq, D]
+        kblk = k_ref[0].astype(jnp.float32)                # [bk, D]
+        vblk = v_ref[0].astype(jnp.float32)                # [bk, Dv]
+        do = do_ref[0].astype(jnp.float32)                 # [bq, Dv]
+        lse = lse_ref[0]                                   # [bq, 1]
+        delta = delta_ref[0]                               # [bq, 1]
+        s = jax.lax.dot_general(
+            q32, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        if causal:
+            qpos = j * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse)                               # [bq, bk]
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, Dv]
+        dp = jax.lax.dot_general(
+            do, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - delta)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q32, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, D]
+
+    if causal:
+        # query blocks entirely above the diagonal see this key block masked
+        pl.when((j + 1) * block_q - 1 >= kb * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == num_q_blocks - 1)
+    def _write():
+        # q32 already carried sm_scale, so dk_acc is fully scaled
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
+               interpret):
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    Dv = v.shape[2]
+    nq = Tq // block_q
+    nk = Tk // block_k
+    # delta_i = sum_d dO_i · O_i  (rescaling term of dsoftmax); O(T·Dv) work,
+    # fused by XLA — not worth a kernel
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)                # [BH, Tq, 1]
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, num_k_blocks=nk, causal=causal,
+                          sm_scale=sm_scale),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, Dv), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_q, Dv), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda i, j, kb: (i, j, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, num_q_blocks=nq, causal=causal,
+                          sm_scale=sm_scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, Dv), v.dtype),
+        ],
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda i, kb, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda i, kb, j: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, Dv), lambda i, kb, j: (i, kb, 0)),
+            pl.BlockSpec((1, block_q, Dv), lambda i, kb, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, kb, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, kb, j: (i, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda i, kb, j: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, Dv), lambda i, kb, j: (i, kb, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, Dv), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 def _reference_attention(q, k, v, causal, sm_scale):
@@ -126,21 +306,21 @@ def _reference_attention(q, k, v, causal, sm_scale):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                        interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # recompute-based backward through the reference formulation
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal,
-                                                sm_scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q,
+                      block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
