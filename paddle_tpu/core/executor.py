@@ -306,7 +306,8 @@ class Executor:
 
     def __init__(self, place: Optional[Place] = None, use_jit: bool = True,
                  check_nan_inf: bool = False, amp: bool = False,
-                 auto_layout: bool = False):
+                 auto_layout: bool = False,
+                 compiler_options: Optional[Dict[str, object]] = None):
         self.place = place or TPUPlace()
         self.use_jit = use_jit
         self.check_nan_inf = check_nan_inf
@@ -316,6 +317,10 @@ class Executor:
         # (run the same fetch_list every call) — some PJRT backends reject
         # executables whose parameters carry another compile's exotic layout.
         self.auto_layout = auto_layout
+        # XLA backend knobs passed to Compiled (e.g. xla_tpu_scoped_vmem_
+        # limit_kib); the FLAGS-registry analog of the reference's gflags
+        # runtime switches, but scoped to one executor
+        self.compiler_options = dict(compiler_options or {})
         self._cache: Dict = {}
         self._fmt_registry: Dict = {}  # state var name -> pinned Format
         self._step = 0
@@ -432,7 +437,10 @@ class Executor:
         if not self.use_jit:
             return fn
         if self.auto_layout:
-            return _AutoLayoutStep(fn, self._fmt_registry)
+            return _AutoLayoutStep(fn, self._fmt_registry,
+                                   self.compiler_options)
+        if self.compiler_options:
+            return _OptionsStep(fn, self.compiler_options)
         return jax.jit(fn, donate_argnums=(1,))
 
     def _make_fn(self, program: Program, fetch_names: List[str],
@@ -494,12 +502,13 @@ class _AutoLayoutStep:
     plain jit if the layout API is unavailable.
     """
 
-    def __init__(self, fn, fmt_registry):
+    def __init__(self, fn, fmt_registry, compiler_options=None):
         self._fn = fn
         self._plain = jax.jit(fn, donate_argnums=(1,))
         self._compiled = None
         self._state_formats = None
         self._registry = fmt_registry  # shared across an Executor's variants
+        self._opts = dict(compiler_options or {})
         self._failed = False
 
     def _compile(self, feeds, state, step):
@@ -513,13 +522,18 @@ class _AutoLayoutStep:
         # its own AUTO layouts and the state would be layout-copied on every
         # alternation (and the axon backend rejects the ping-pong outright).
         in_state = {k: self._registry.get(k, auto) for k in state}
-        out_state = {k: self._registry.get(k, auto) for k in state}
+        # the output state can have MORE keys than the input (a startup
+        # program creates every parameter from an empty scope) — size the
+        # out_shardings spec to the output pytree, not the input
+        out_struct = jax.eval_shape(self._fn, feeds, state, step)
+        out_state = {k: self._registry.get(k, auto) for k in out_struct[1]}
         in_sh = (jax.tree.map(lambda _: dflt, feeds), in_state, dflt)
         lowered = jax.jit(
             self._fn, in_shardings=in_sh, out_shardings=(dflt, out_state),
             donate_argnums=(1,),
         ).lower(feeds, state, step)
-        comp = lowered.compile()
+        comp = lowered.compile(
+            compiler_options=self._opts if self._opts else None)
         # input_formats mirrors the arg pytree: (feeds, state, step);
         # donated buffers alias in->out, so input formats ARE the steady
         # state formats — record them for later variants
@@ -558,6 +572,36 @@ class _AutoLayoutStep:
             state = jax.tree.map(jax.device_put, state,
                                  self._state_formats)
             return self._compiled(feeds, state, step)
+
+
+class _OptionsStep:
+    """Jitted step compiled with explicit XLA compiler options (AOT
+    lower+compile path; plain ``jax.jit`` has no per-call options hook).
+    Specializations are cached per argument signature like jit would."""
+
+    def __init__(self, fn, compiler_options):
+        self._fn = fn
+        self._opts = dict(compiler_options)
+        self._cache = {}
+
+    @staticmethod
+    def _sig(feeds, state):
+        return (tuple(sorted((k, v.shape, str(v.dtype))
+                             for k, v in feeds.items()
+                             if hasattr(v, "shape"))),
+                tuple(sorted((k, v.shape, str(v.dtype))
+                             for k, v in state.items()
+                             if hasattr(v, "shape"))))
+
+    def __call__(self, feeds, state, step):
+        step = np.int64(step)
+        sig = self._sig(feeds, state)
+        comp = self._cache.get(sig)
+        if comp is None:
+            comp = jax.jit(self._fn, donate_argnums=(1,)).lower(
+                feeds, state, step).compile(compiler_options=self._opts)
+            self._cache[sig] = comp
+        return comp(feeds, state, step)
 
 
 def _nan_check_impl(names, fetches):
