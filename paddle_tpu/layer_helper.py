@@ -95,7 +95,8 @@ class LayerHelper:
             ParamAttr._to_attr(True if bias_attr is True else bias_attr),
             shape=[size], dtype=input_var.dtype, is_bias=True)
         out = self.create_variable_for_type_inference(
-            input_var.dtype, input_var.shape)
+            input_var.dtype, input_var.shape,
+            lod_level=input_var.lod_level)
         self.append_op(type="elementwise_add",
                        inputs={"X": [input_var], "Y": [b]},
                        outputs={"Out": [out]},
@@ -110,7 +111,8 @@ class LayerHelper:
             act = {"type": act}
         act_type = act.pop("type")
         out = self.create_variable_for_type_inference(
-            input_var.dtype, input_var.shape)
+            input_var.dtype, input_var.shape,
+            lod_level=input_var.lod_level)
         self.append_op(type=act_type, inputs={"X": [input_var]},
                        outputs={"Out": [out]}, attrs=act)
         return out

@@ -64,7 +64,11 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
             in_dim *= s
         w = helper.create_parameter(pa, shape=[in_dim, size], dtype=inp.dtype)
         out_shape = tuple(inp.shape[:num_flatten_dims]) + (size,)
-        tmp = helper.create_variable_for_type_inference(inp.dtype, out_shape)
+        # sequence fc ([B,T,D] with num_flatten_dims=2) keeps its LoD: the
+        # lod_level rides the var and the @LEN companion is copied below
+        tmp = helper.create_variable_for_type_inference(
+            inp.dtype, out_shape,
+            lod_level=inp.lod_level if num_flatten_dims >= 2 else 0)
         helper.append_op(type="mul", inputs={"X": [inp], "Y": [w]},
                          outputs={"Out": [tmp]},
                          attrs={"x_num_col_dims": num_flatten_dims,
@@ -74,11 +78,15 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         pre_bias = mul_results[0]
     else:
         pre_bias = helper.create_variable_for_type_inference(
-            mul_results[0].dtype, mul_results[0].shape)
+            mul_results[0].dtype, mul_results[0].shape,
+            lod_level=mul_results[0].lod_level)
         helper.append_op(type="sum", inputs={"X": mul_results},
                          outputs={"Out": [pre_bias]})
     pre_act = helper.append_bias_op(pre_bias)
-    return helper.append_activation(pre_act)
+    out = helper.append_activation(pre_act)
+    if out.lod_level and inputs[0].lod_level:
+        _copy_len(helper, inputs[0], out)
+    return out
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
@@ -622,7 +630,11 @@ def _elementwise_layer(op, x, y, axis=-1, act=None, name=None):
         x.dtype, x.shape, lod_level=max(x.lod_level, getattr(y, "lod_level", 0)))
     helper.append_op(type=op, inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [out]}, attrs={"axis": axis})
-    return helper.append_activation(out, act)
+    final = helper.append_activation(out, act)
+    if final.lod_level:
+        src = x if x.lod_level else y
+        _copy_len(helper, src, final)
+    return final
 
 
 def elementwise_add(x, y, axis=-1, act=None, name=None):

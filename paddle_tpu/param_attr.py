@@ -18,14 +18,35 @@ class ParamAttr:
                  regularizer=None,
                  trainable: bool = True,
                  gradient_clip=None,
-                 sharding=None):
+                 sharding=None,
+                 initial_std: Optional[float] = None,
+                 initial_mean: float = 0.0,
+                 initial_max: Optional[float] = None,
+                 initial_min: Optional[float] = None,
+                 is_static: bool = False,
+                 sparse_update: bool = False,
+                 **_v1_kw):
         self.name = name
+        # v1 trainer_config_helpers init spellings (ParameterAttribute,
+        # attrs.py:131): gaussian via initial_std/mean, uniform via
+        # initial_max/min; std==0 means "constant at the mean"
+        if initializer is None and initial_std is not None:
+            from .initializer import ConstantInitializer, NormalInitializer
+            initializer = (ConstantInitializer(initial_mean)
+                           if initial_std == 0.0 else
+                           NormalInitializer(initial_mean, initial_std))
+        elif initializer is None and initial_max is not None:
+            from .initializer import UniformInitializer
+            lo = initial_min if initial_min is not None else -initial_max
+            initializer = UniformInitializer(lo, initial_max)
         self.initializer = initializer
         self.learning_rate = learning_rate
         self.regularizer = regularizer
-        self.trainable = trainable
+        self.trainable = trainable and not is_static
         self.gradient_clip = gradient_clip
         self.sharding = sharding
+        self.sparse_update = sparse_update  # row-sparse hint (v1); XLA
+        #                                     gathers make this a no-op
 
     @staticmethod
     def _to_attr(arg) -> "ParamAttr":

@@ -32,6 +32,7 @@ from ..param_attr import ParamAttr
 
 __all__ = [
     "settings", "get_config_arg", "define_py_data_sources2", "outputs",
+    "inputs", "Inputs", "Outputs",
     "data_layer", "fc_layer", "img_conv_layer", "img_pool_layer",
     "img_cmrnorm_layer", "batch_norm_layer", "dropout_layer",
     "embedding_layer", "concat_layer", "addto_layer", "simple_lstm",
@@ -39,14 +40,26 @@ __all__ = [
     "classification_cost", "cross_entropy", "cross_entropy_cost",
     "regression_cost", "mse_cost",
     "img_conv_group", "conv_projection", "ExtraAttr",
-    "ExtraLayerAttribute",
+    "ExtraLayerAttribute", "ParamAttr", "default_device",
     "LinearActivation", "ReluActivation", "SigmoidActivation",
     "TanhActivation", "SoftmaxActivation", "IdentityActivation",
+    "STanhActivation", "ExpActivation", "AbsActivation",
+    "SquareActivation", "BReluActivation", "SoftReluActivation",
     "MaxPooling", "AvgPooling", "SumPooling",
     "MomentumOptimizer", "AdamOptimizer", "AdaGradOptimizer",
     "RMSPropOptimizer", "AdaDeltaOptimizer",
-    "L1Regularization", "L2Regularization",
+    "L1Regularization", "L2Regularization", "ModelAverage",
     "load_v1_config", "V1Config",
+    # sequence/generation DSL (sequence.py)
+    "memory", "recurrent_group", "StaticInput", "GeneratedInput",
+    "SubsequenceInput", "mixed_layer", "MixedLayerType",
+    "full_matrix_projection", "trans_full_matrix_projection",
+    "table_projection", "identity_projection", "dotmul_projection",
+    "scaling_projection", "recurrent_layer", "lstmemory_group",
+    "grumemory", "gru_group", "simple_gru", "beam_search",
+    "crf_layer", "crf_decoding_layer",
+    "sum_evaluator", "chunk_evaluator", "seqtext_printer_evaluator",
+    "classification_error_evaluator",
 ]
 
 
@@ -60,6 +73,9 @@ class _ConfigState:
         self.outputs = []
         self.data_sources = None
         self.data_layers = {}
+        self.named_layers = {}
+        self.evaluators = []
+        self.input_order = None
 
 
 _state = _ConfigState()
@@ -76,14 +92,20 @@ def get_config_arg(name, type_=str, default=None):
 
 
 def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
-             regularization=None, gradient_clipping_threshold=None, **kw):
+             regularization=None, gradient_clipping_threshold=None,
+             model_average=None, learning_rate_decay_a=0.0,
+             learning_rate_decay_b=0.0, **kw):
     _state.settings = {
         "batch_size": batch_size,
         "learning_rate": learning_rate,
         "learning_method": learning_method,
         "regularization": regularization,
         "gradient_clipping_threshold": gradient_clipping_threshold,
+        "model_average": model_average,
+        "learning_rate_decay_a": learning_rate_decay_a,
+        "learning_rate_decay_b": learning_rate_decay_b,
     }
+    _state.settings.update(kw)
 
 
 def define_py_data_sources2(train_list, test_list, module=None, obj=None,
@@ -96,6 +118,36 @@ def define_py_data_sources2(train_list, test_list, module=None, obj=None,
 
 def outputs(*vars_):
     _state.outputs = [v for v in vars_]
+
+
+def inputs(*layers):
+    """v1 inputs(): fixes the data-layer feed order."""
+    _state.input_order = [getattr(v, "name", v) for v in layers]
+
+
+def Inputs(*names):
+    """config_parser Inputs(): name-based variant used by .conf files."""
+    _state.input_order = list(names)
+
+
+def Outputs(*names):
+    """config_parser Outputs(): resolve by layer name at config close."""
+    _state.outputs = [_state.named_layers.get(n, n) for n in names]
+
+
+def default_device(device_id):
+    """v1 per-layer device placement hint: placement is owned by XLA on
+    TPU; accepted for config compatibility."""
+
+
+class ModelAverage:
+    """v1 settings(model_average=...): recorded; the trainer applies
+    parameter averaging over a trailing window when configured."""
+
+    def __init__(self, average_window, max_average_window=None,
+                 do_average_in_cpu=False):
+        self.average_window = average_window
+        self.max_average_window = max_average_window
 
 
 # ---------------------------------------------------------------------------
@@ -129,6 +181,30 @@ class TanhActivation(_Act):
 
 class SoftmaxActivation(_Act):
     act = "softmax"
+
+
+class STanhActivation(_Act):
+    act = "stanh"              # 1.7159 * tanh(2x/3), STanhActivation.cpp
+
+
+class ExpActivation(_Act):
+    act = "exp"
+
+
+class AbsActivation(_Act):
+    act = "abs"
+
+
+class SquareActivation(_Act):
+    act = "square"
+
+
+class BReluActivation(_Act):
+    act = "brelu"
+
+
+class SoftReluActivation(_Act):
+    act = "softrelu"
 
 
 def _act_name(a):
@@ -254,7 +330,7 @@ def fc_layer(input, size, act=None, name=None, param_attr=None,
     out = L.fc(flat if len(flat) > 1 else flat[0], size=size,
                num_flatten_dims=nfd, act=_act_name(act), name=name,
                param_attr=param_attr, bias_attr=bias_attr)
-    return _apply_layer_attr(out, layer_attr)
+    return track_layer(name, _apply_layer_attr(out, layer_attr))
 
 
 def img_conv_layer(input, filter_size, num_filters, name=None,
@@ -423,19 +499,36 @@ mse_cost = regression_cost
 
 
 # ---------------------------------------------------------------------------
+# sequence / generation DSL (recurrent_group, mixed_layer, beam_search, CRF)
+# ---------------------------------------------------------------------------
+from .sequence import (  # noqa: E402
+    memory, recurrent_group, StaticInput, GeneratedInput, SubsequenceInput,
+    mixed_layer, MixedLayerType, full_matrix_projection,
+    trans_full_matrix_projection, table_projection, identity_projection,
+    dotmul_projection, scaling_projection, recurrent_layer, lstmemory_group,
+    grumemory, gru_group, simple_gru, beam_search, crf_layer,
+    crf_decoding_layer, sum_evaluator, chunk_evaluator,
+    seqtext_printer_evaluator, classification_error_evaluator, track_layer)
+
+
+# ---------------------------------------------------------------------------
 # config loader
 # ---------------------------------------------------------------------------
 class V1Config:
     """Result of evaluating a v1 config file: the built program + metadata."""
 
     def __init__(self, main_program, startup_program, outputs, settings,
-                 data_layers, data_sources):
+                 data_layers, data_sources, evaluators=None,
+                 named_layers=None, input_order=None):
         self.main_program = main_program
         self.startup_program = startup_program
         self.outputs = outputs
         self.settings = settings
         self.data_layers = data_layers
         self.data_sources = data_sources
+        self.evaluators = evaluators or []
+        self.named_layers = named_layers or {}
+        self.input_order = input_order
 
     def make_optimizer(self):
         s = self.settings
@@ -493,4 +586,6 @@ def load_v1_config(path, **config_args):
         exec(code, ns)
     return V1Config(main, startup, list(_state.outputs),
                     dict(_state.settings), dict(_state.data_layers),
-                    _state.data_sources)
+                    _state.data_sources, evaluators=list(_state.evaluators),
+                    named_layers=dict(_state.named_layers),
+                    input_order=_state.input_order)

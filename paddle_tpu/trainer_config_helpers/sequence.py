@@ -1,0 +1,515 @@
+"""v1 sequence/generation DSL: recurrent_group, memory(), mixed_layer +
+projections, recurrent/lstm/gru groups, CRF layers, beam-search generation.
+
+Reference surface: python/paddle/trainer_config_helpers/layers.py —
+memory():4079, recurrent_group():3492, mixed_layer():817 + the projection
+family (full_matrix_projection():548, table_projection():588,
+identity_projection():682, trans_full_matrix_projection():633,
+dotmul_projection():722, scaling_projection():651), recurrent_layer():3225,
+lstmemory_group (networks.py:771), beam_search():3905 with
+StaticInput/GeneratedInput, crf_layer():5791, crf_decoding_layer():5852.
+
+TPU-native lowering: a recurrent_group becomes a ``StaticRNN`` sub-block
+that the executor runs as ONE lax.scan (padded batch + @LEN masking — no
+per-sequence dispatch like the reference's RecurrentGradientMachine,
+gserver/gradientmachines/RecurrentGradientMachine.cpp).  ``memory()`` maps
+to scan carries, resolved to their update layer by v1's name-matching
+convention at group close.  Generation maps onto the static-shape
+``BeamSearchDecoder`` scan (layers/generation.py) — beams ride the batch
+dimension, statics are tiled per beam by the lowering.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers as L
+from ..core import unique_name
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "memory", "recurrent_group", "StaticInput", "GeneratedInput",
+    "SubsequenceInput", "mixed_layer", "MixedLayerType",
+    "full_matrix_projection", "trans_full_matrix_projection",
+    "table_projection", "identity_projection", "dotmul_projection",
+    "scaling_projection", "recurrent_layer", "lstmemory_group",
+    "grumemory", "gru_group", "simple_gru", "beam_search",
+    "crf_layer", "crf_decoding_layer",
+    "sum_evaluator", "chunk_evaluator", "seqtext_printer_evaluator",
+    "classification_error_evaluator",
+]
+
+
+# ---------------------------------------------------------------------------
+# group context: memory()/layer-name resolution inside a step function
+# ---------------------------------------------------------------------------
+class _GroupCtx:
+    """Per-recurrent_group bookkeeping.  v1 links a memory to its updater by
+    layer NAME (memory(name="s") <-> fc_layer(name="s")); layer wrappers call
+    ``track`` so the group can resolve the pairs when the step closes."""
+
+    def __init__(self, rnn, kind):
+        self.rnn = rnn
+        self.kind = kind            # "rnn" | "beam"
+        self.layer_by_name = {}
+        self.pending = []           # (mem var, layer name)
+        self.boot_by_name = {}
+
+
+_group_stack: list = []
+
+
+def track_layer(name, out):
+    """Record a named layer output for memory resolution (and config-level
+    Outputs())."""
+    from . import _state
+    if name:
+        if _group_stack:
+            _group_stack[-1].layer_by_name[name] = out
+        _state.named_layers[name] = out
+    return out
+
+
+def memory(name=None, size=None, boot_layer=None, is_seq=False,
+           boot_with_const_id=None, boot_bias=None, **kw):
+    """v1 memory (layers.py:4079): the previous step's output of the layer
+    called ``name``; zeros (or ``boot_layer``) at t=0."""
+    if not _group_stack:
+        raise RuntimeError("memory() must be called inside a "
+                           "recurrent_group/beam_search step function")
+    g = _group_stack[-1]
+    if g.kind == "beam":
+        if boot_layer is None:
+            raise ValueError("beam_search memory needs boot_layer (the "
+                             "per-sequence decoder init)")
+        mem = g.rnn.memory(init=boot_layer)
+    elif boot_layer is not None:
+        mem = g.rnn.memory(init=boot_layer)
+    else:
+        mem = g.rnn.memory(shape=[size])
+    g.pending.append((mem, name))
+    return mem
+
+
+def _resolve_memories(g):
+    for mem, nm in g.pending:
+        upd = g.layer_by_name.get(nm)
+        if upd is None:
+            raise ValueError(
+                f"memory(name={nm!r}) has no matching layer named {nm!r} "
+                f"inside the step function (v1 name-link convention)")
+        g.rnn.update_memory(mem, upd)
+
+
+class StaticInput:
+    """Read-only non-sequence input to a recurrent_group/beam_search step
+    (layers.py StaticInput): the same tensor every step."""
+
+    def __init__(self, input, size=None, is_seq=False):
+        self.input = input
+        self.size = size
+        self.is_seq = is_seq
+
+
+SubsequenceInput = StaticInput  # nested-sequence marker; level-2 unsupported
+
+
+class GeneratedInput:
+    """Generation-mode input: the embedding of the previously generated
+    token (layers.py GeneratedInput)."""
+
+    def __init__(self, size, embedding_name, embedding_size):
+        self.size = size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+
+
+def recurrent_group(step, input, name=None, reverse=False, **kw):
+    """v1 recurrent_group (layers.py:3492) -> StaticRNN scan.
+
+    ``input``: sequence var(s) ([B,T,D] padded + @LEN) and/or StaticInput.
+    The step function receives per-step [B,D] slices (statics unchanged) and
+    returns the step output(s); memories declared inside link by name.
+    """
+    items = list(input) if isinstance(input, (list, tuple)) else [input]
+    if reverse:
+        items = [it if isinstance(it, StaticInput)
+                 else L.sequence_reverse(it) for it in items]
+    rnn = L.StaticRNN(name=name)
+    g = _GroupCtx(rnn, "rnn")
+    with rnn.step():
+        _group_stack.append(g)
+        try:
+            args = []
+            # sequence inputs must register first so memory() can size its
+            # zero-init from the sequence's batch dim
+            for it in items:
+                if not isinstance(it, StaticInput):
+                    args.append(rnn.step_input(it))
+                else:
+                    args.append(None)
+            for i, it in enumerate(items):
+                if isinstance(it, StaticInput):
+                    args[i] = it.input
+            outs = step(*args)
+            outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+            for o in outs:
+                rnn.step_output(o)
+            _resolve_memories(g)
+        finally:
+            _group_stack.pop()
+    res = rnn.outputs
+    if reverse:
+        res = [L.sequence_reverse(r) for r in res]
+    return res[0] if len(res) == 1 else res
+
+
+# ---------------------------------------------------------------------------
+# projections + mixed_layer
+# ---------------------------------------------------------------------------
+class _Projection:
+    def __init__(self, input, param_attr=None):
+        self.input = input
+        self.param_attr = param_attr
+
+    def _nfd(self):
+        v = self.input
+        return 2 if getattr(v, "lod_level", 0) else 1
+
+
+class full_matrix_projection(_Projection):
+    """y = x * W  (layers.py:548)."""
+
+    def build(self, size):
+        return L.fc(self.input, size=size, num_flatten_dims=self._nfd(),
+                    param_attr=self.param_attr, bias_attr=False)
+
+
+class trans_full_matrix_projection(_Projection):
+    """y = x * W^T, W declared [size, in] (layers.py:633) — the weight-tying
+    projection (shares e.g. an embedding table by param name)."""
+
+    def build(self, size):
+        x = self.input
+        in_dim = x.shape[-1]
+        helper = LayerHelper("trans_fc", param_attr=self.param_attr)
+        w = helper.create_parameter(self.param_attr, shape=[size, in_dim],
+                                    dtype=x.dtype)
+        return L.matmul(x, w, transpose_y=True)
+
+
+class table_projection(_Projection):
+    """Embedding-table lookup of integer ids (layers.py:588)."""
+
+    def build(self, size):
+        ids = self.input
+        vocab = getattr(ids, "v1_size", None)
+        if vocab is None:
+            raise ValueError("table_projection input must be an id "
+                             "data_layer (its size is the vocab)")
+        if ids.dtype != np.dtype("int64"):
+            ids.dtype = np.dtype("int64")
+            ids.lod_level = 1
+            ids.shape = (-1, -1)
+        return L.embedding(ids, size=[vocab, size],
+                           param_attr=self.param_attr)
+
+
+class identity_projection(_Projection):
+    def __init__(self, input, offset=None, size=None):
+        super().__init__(input)
+        self.offset = offset
+        self.size = size
+
+    def build(self, size):
+        if self.offset is None:
+            return self.input
+        return L.slice(self.input, axes=[len(self.input.shape) - 1],
+                       starts=[self.offset], ends=[self.offset + size])
+
+
+class dotmul_projection(_Projection):
+    """y = x . w (per-feature scale, layers.py:722)."""
+
+    def build(self, size):
+        x = self.input
+        helper = LayerHelper("dotmul_proj", param_attr=self.param_attr)
+        w = helper.create_parameter(self.param_attr, shape=[size],
+                                    dtype=x.dtype)
+        return L.elementwise_mul(x, w, axis=-1)
+
+
+class scaling_projection(_Projection):
+    """y = w * x with scalar w (layers.py:651)."""
+
+    def build(self, size):
+        x = self.input
+        helper = LayerHelper("scaling_proj", param_attr=self.param_attr)
+        w = helper.create_parameter(self.param_attr, shape=[1],
+                                    dtype=x.dtype)
+        return L.elementwise_mul(x, w)
+
+
+class MixedLayerType:
+    """mixed_layer handle: usable as ``mixed_layer(input=[proj, ...])`` or
+    as the v1 context-manager form::
+
+        with mixed_layer(size=H) as m:
+            m += full_matrix_projection(input=x)
+
+    On close the object BECOMES the output Variable (class swap), so it can
+    be passed to any later layer untouched — the v1 configs do exactly
+    that."""
+
+    def __init__(self, size, act=None, bias_attr=None, name=None,
+                 layer_attr=None):
+        self.size = size
+        self.act = act
+        self.bias_attr = bias_attr
+        self.name = name
+        self.layer_attr = layer_attr
+        self.projections = []
+
+    def __iadd__(self, proj):
+        if not isinstance(proj, _Projection):
+            proj = identity_projection(proj)
+        self.projections.append(proj)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._finalize()
+        return False
+
+    def _finalize(self):
+        from . import _act_name, _apply_layer_attr
+        if not self.projections:
+            raise ValueError("mixed_layer closed with no projections")
+        parts = [p.build(self.size) for p in self.projections]
+        out = parts[0]
+        for p in parts[1:]:
+            out = L.elementwise_add(out, p)
+        if self.bias_attr not in (None, False):
+            helper = LayerHelper("mixed_bias")
+            battr = self.bias_attr if isinstance(self.bias_attr, ParamAttr) \
+                else ParamAttr()
+            b = helper.create_parameter(battr, shape=[self.size],
+                                        dtype=out.dtype, is_bias=True)
+            out = L.elementwise_add(out, b, axis=-1)
+        a = _act_name(self.act)
+        if a:
+            out = getattr(L, a)(out)
+        out = _apply_layer_attr(out, self.layer_attr)
+        track_layer(self.name, out)
+        # become the Variable: v1 passes the mixed_layer object itself to
+        # downstream layers (class swap shares the var's state dict)
+        self.__class__ = out.__class__
+        self.__dict__ = out.__dict__
+        return self
+
+
+def mixed_layer(size=0, input=None, act=None, bias_attr=None, name=None,
+                layer_attr=None, **kw):
+    m = MixedLayerType(size, act=act, bias_attr=bias_attr, name=name,
+                       layer_attr=layer_attr)
+    if input is None:
+        return m               # context-manager form
+    projs = input if isinstance(input, (list, tuple)) else [input]
+    for p in projs:
+        m += p
+    return m._finalize()
+
+
+# ---------------------------------------------------------------------------
+# recurrent layers built on the group machinery
+# ---------------------------------------------------------------------------
+def recurrent_layer(input, act=None, bias_attr=None, param_attr=None,
+                    name=None, reverse=False, **kw):
+    """v1 simple full-matrix recurrence (layers.py:3225, RecurrentLayer.cpp):
+    out_t = act(in_t + out_{t-1} * W + b); in is the pre-projected input."""
+    from . import _act_name
+    size = input.shape[-1]
+    nm = name or unique_name.generate("recurrent")
+
+    def _step(x):
+        mem = memory(name=nm, size=size)
+        proj = L.fc(mem, size=size, num_flatten_dims=1,
+                    param_attr=param_attr, bias_attr=bias_attr)
+        out = L.elementwise_add(x, proj)
+        a = _act_name(act)
+        if a:
+            out = getattr(L, a)(out)
+        return track_layer(nm, out)
+
+    return recurrent_group(step=_step, input=input, reverse=reverse)
+
+
+def lstmemory_group(input, size=None, name=None, reverse=False, act=None,
+                    gate_act=None, state_act=None, param_attr=None,
+                    lstm_bias_attr=None, **kw):
+    """networks.py:771 lstmemory_group.  The per-step LSTM unit over the
+    pre-projected [B,T,4H] input is exactly the fused ``lstm`` scan op —
+    same math, one kernel (no per-step Python group needed)."""
+    from . import _act_name
+    size = size or input.shape[-1] // 4
+    hid, _ = L.dynamic_lstm(
+        input, size=size * 4, is_reverse=reverse, param_attr=param_attr,
+        bias_attr=lstm_bias_attr, use_peepholes=True,
+        gate_activation=_act_name(gate_act) or "sigmoid",
+        cell_activation=_act_name(state_act) or "tanh",
+        candidate_activation=_act_name(act) or "tanh", name=name)
+    return track_layer(name, hid)
+
+
+def grumemory(input, size=None, name=None, reverse=False, act=None,
+              gate_act=None, param_attr=None, bias_attr=None, **kw):
+    """v1 grumemory (layers.py:3056): input is the [B,T,3H] projection."""
+    from . import _act_name
+    size = size or input.shape[-1] // 3
+    hid = L.dynamic_gru(
+        input, size=size, is_reverse=reverse, param_attr=param_attr,
+        bias_attr=bias_attr,
+        gate_activation=_act_name(gate_act) or "sigmoid",
+        candidate_activation=_act_name(act) or "tanh", name=name)
+    return track_layer(name, hid)
+
+
+gru_group = grumemory
+
+
+def simple_gru(input, size, name=None, reverse=False, act=None,
+               gate_act=None, mixed_param_attr=None, gru_param_attr=None,
+               mixed_bias_param_attr=None, gru_bias_attr=None, **kw):
+    """networks.py simple_gru: fc(3H) + grumemory."""
+    proj = L.fc(input, size=size * 3, num_flatten_dims=2,
+                param_attr=mixed_param_attr,
+                bias_attr=mixed_bias_param_attr)
+    return grumemory(proj, size=size, name=name, reverse=reverse, act=act,
+                     gate_act=gate_act, param_attr=gru_param_attr,
+                     bias_attr=gru_bias_attr)
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+def _seq_label_layer(label):
+    """Coerce a v1 label data_layer into a per-token id sequence [B,T]."""
+    if getattr(label, "is_data", False) and \
+            label.dtype != np.dtype("int64"):
+        label.dtype = np.dtype("int64")
+        label.lod_level = 1
+        label.shape = (-1, -1)
+    return label
+
+
+def crf_layer(input, label, size=None, param_attr=None, name=None,
+              weight=None, layer_attr=None, **kw):
+    """v1 CRFLayer (layers.py:5791): negative log-likelihood cost."""
+    label = _seq_label_layer(label)
+    ll = L.linear_chain_crf(input, label, param_attr=param_attr, name=name)
+    cost = L.mean(ll)
+    return track_layer(name, cost)
+
+
+def crf_decoding_layer(input, size=None, label=None, param_attr=None,
+                       name=None, layer_attr=None, **kw):
+    """v1 CRFDecodingLayer: viterbi path (with label: per-token error)."""
+    if label is not None:
+        label = _seq_label_layer(label)
+    out = L.crf_decoding(input, param_attr, label=label, name=name)
+    return track_layer(name, out)
+
+
+# ---------------------------------------------------------------------------
+# generation: v1 beam_search -> BeamSearchDecoder scan
+# ---------------------------------------------------------------------------
+def beam_search(step, input, bos_id, eos_id, beam_size=1, max_length=30,
+                name=None, num_results_per_sample=None, **kw):
+    """v1 beam_search (layers.py:3905).  ``input`` mixes StaticInput items
+    and exactly one GeneratedInput; the step function returns the next-token
+    probability layer [*, V].  Returns the generated ids [B, K, max_len]
+    (registered as ``__beam_search_predict__`` for Outputs())."""
+    items = list(input) if isinstance(input, (list, tuple)) else [input]
+    gens = [it for it in items if isinstance(it, GeneratedInput)]
+    if len(gens) != 1:
+        raise ValueError("beam_search needs exactly one GeneratedInput")
+    gen = gens[0]
+    bs = L.BeamSearchDecoder(beam_size=beam_size, bos_id=bos_id,
+                             eos_id=eos_id, max_len=max_length,
+                             vocab_size=gen.size, name=name)
+    g = _GroupCtx(bs, "beam")
+    with bs.step():
+        _group_stack.append(g)
+        try:
+            tok = bs.token()
+            emb = L.embedding(
+                tok, size=[gen.size, gen.embedding_size],
+                param_attr=ParamAttr(name=gen.embedding_name))
+            args = []
+            for it in items:
+                if isinstance(it, GeneratedInput):
+                    args.append(emb)
+                else:
+                    args.append(bs.context(it.input))
+            probs = step(*args)
+            _resolve_memories(g)
+            bs.set_probs(probs)
+        finally:
+            _group_stack.pop()
+    ids, scores, lens = bs.outputs
+    track_layer("__beam_search_predict__", ids)
+    track_layer(name, ids)
+    return ids
+
+
+# ---------------------------------------------------------------------------
+# v1 evaluators: recorded on the config; chunk F1 wires the chunk_eval op
+# ---------------------------------------------------------------------------
+def _record_evaluator(kind, **kw):
+    from . import _state
+    _state.evaluators.append({"kind": kind, **kw})
+
+
+def sum_evaluator(input, name=None, weight=None, **kw):
+    _record_evaluator("sum", name=name, input=input)
+
+
+def classification_error_evaluator(input, label, name=None, **kw):
+    _record_evaluator("classification_error", name=name, input=input,
+                      label=label)
+
+
+def chunk_evaluator(input, label, chunk_scheme, num_chunk_types, name=None,
+                    **kw):
+    """v1 chunk F1 (ChunkEvaluator.cpp) -> chunk_eval op outputs recorded on
+    the config (precision/recall/F1 fetchable by the runner)."""
+    label = _seq_label_layer(label)
+    helper = LayerHelper("chunk_eval", name=name)
+    outs = {nm: helper.create_variable_for_type_inference("float32")
+            for nm in ("Precision", "Recall", "F1-Score")}
+    counts = {nm: helper.create_variable_for_type_inference("int64")
+              for nm in ("NumInferChunks", "NumLabelChunks",
+                         "NumCorrectChunks")}
+    helper.append_op(
+        type="chunk_eval",
+        inputs={"Inference": [input], "Label": [label]},
+        outputs={**{k: [v] for k, v in outs.items()},
+                 **{k: [v] for k, v in counts.items()}},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": []})
+    _record_evaluator("chunk", name=name, precision=outs["Precision"],
+                      recall=outs["Recall"], f1=outs["F1-Score"])
+    return outs["F1-Score"]
+
+
+def seqtext_printer_evaluator(input, result_file=None, id_input=None,
+                              dict_file=None, name=None, **kw):
+    """v1 seqtext printer: recorded; the runner decodes ids via the dict
+    and writes result_file (no side effects at config-build time)."""
+    _record_evaluator("seqtext_printer", name=name, input=input,
+                      id_input=id_input, dict_file=dict_file,
+                      result_file=result_file)
