@@ -1,0 +1,129 @@
+"""Pipeline parallelism tests (CPU mesh): per-stage parameters sharded on
+'pp', GPipe microbatched training matching a single-device reference
+(reference capability: ParallelNeuralNetwork.cpp per-layer device placement
+with queue-pipelined activations)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import MeshConfig, make_mesh, pipeline
+
+S = 4          # stages
+D = 16
+M = 4          # microbatches
+B = 8          # global batch
+
+R = np.random.RandomState(7)
+
+
+def _stage(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _loss(out, y):
+    return jnp.mean((out - y) ** 2)
+
+
+def _init_stages():
+    return [{"w": jnp.asarray(R.randn(D, D).astype("float32") * 0.3),
+             "b": jnp.asarray(R.randn(D).astype("float32") * 0.1)}
+            for _ in range(S)]
+
+
+def _reference_train(stages, x, y, lr, mom, steps):
+    """Single-device reference: sequential 4-layer net, same SGD."""
+    params = jax.tree.map(jnp.asarray, stages)
+    vel = jax.tree.map(jnp.zeros_like, params)
+
+    def loss_fn(params, x, y):
+        h = x
+        for p in params:
+            h = _stage(p, h)
+        return _loss(h, y)
+
+    losses = []
+    for _ in range(steps):
+        lv, g = jax.value_and_grad(loss_fn)(params, x, y)
+        vel = jax.tree.map(lambda v, gr: mom * v + gr, vel, g)
+        params = jax.tree.map(lambda p, v: p - lr * v, params, vel)
+        losses.append(float(lv))
+    return params, losses
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_pipeline_training_matches_single_device(remat):
+    """pp=4 GPipe training == the sequential single-device run: same per-step
+    losses and (bitwise-close) final per-stage weights."""
+    mesh = make_mesh(MeshConfig(pp=S), devices=jax.devices()[:S])
+    stages = _init_stages()
+    x = R.randn(B, D).astype("float32")
+    y = R.randn(B, D).astype("float32")
+    lr, mom, steps = 0.1, 0.9, 5
+
+    ref_params, ref_losses = _reference_train(stages, x, y, lr, mom, steps)
+
+    params = pipeline.place_stage_params(
+        pipeline.stack_stage_params(*stages), mesh)
+    vel = jax.tree.map(jnp.zeros_like, params)
+    step = pipeline.make_pipeline_train_step(
+        _stage, _loss, mesh, num_microbatches=M, learning_rate=lr,
+        momentum=mom, remat=remat)
+    losses = []
+    for _ in range(steps):
+        params, vel, lv = step(params, vel, x, y)
+        losses.append(float(lv))
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5, atol=1e-6)
+    for i in range(S):
+        np.testing.assert_allclose(
+            np.asarray(params["w"][i]), np.asarray(ref_params[i]["w"]),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(params["b"][i]), np.asarray(ref_params[i]["b"]),
+            rtol=1e-5, atol=1e-6)
+
+
+def test_stage_params_actually_sharded():
+    """Each device holds exactly its own stage slice of the stacked params
+    (addressable shard shape [1, D, D]) — the memory-scaling property the
+    round-2 scaffold lacked."""
+    mesh = make_mesh(MeshConfig(pp=S), devices=jax.devices()[:S])
+    params = pipeline.place_stage_params(
+        pipeline.stack_stage_params(*_init_stages()), mesh)
+    w = params["w"]
+    assert w.shape == (S, D, D)
+    shards = w.addressable_shards
+    assert len(shards) == S
+    for sh in shards:
+        assert sh.data.shape == (1, D, D)
+
+
+def test_pipeline_forward_heterogeneous_switch():
+    """lax.switch adapter: heterogeneous per-stage callables (different
+    param pytrees) still pipeline; output matches sequential composition."""
+    from jax.experimental.shard_map import shard_map
+
+    mesh = make_mesh(MeshConfig(pp=S), devices=jax.devices()[:S])
+    fns = [lambda p, x: jnp.tanh(x @ p["w"]),
+           lambda p, x: x * p["scale"] + p["shift"],
+           lambda p, x: jnp.tanh(x @ p["w"]),
+           lambda p, x: x + p["bias"]]
+    ps = [{"w": jnp.asarray(R.randn(D, D).astype("float32") * 0.3)},
+          {"scale": jnp.float32(1.5), "shift": jnp.float32(0.1)},
+          {"w": jnp.asarray(R.randn(D, D).astype("float32") * 0.3)},
+          {"bias": jnp.asarray(R.randn(D).astype("float32"))}]
+    sfn = pipeline.switch_stage_fn(fns, ps)
+    xs = R.randn(M, 2, D).astype("float32")
+    dummy = jnp.zeros((S, 1))
+
+    pipe = shard_map(
+        lambda w, x: pipeline.pipeline_forward(sfn, w, x, axis_name="pp"),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P())
+    outs = np.asarray(jax.jit(pipe)(dummy, xs))
+
+    h = xs
+    for f, p in zip(fns, ps):
+        h = jax.vmap(lambda xx: f(p, xx))(h)
+    np.testing.assert_allclose(outs, np.asarray(h), rtol=1e-5, atol=1e-5)
