@@ -5,14 +5,20 @@ Reference: go/master/service.go — partition dataset chunks into tasks
 with a timeout, TaskFinished (:411) retires it, TaskFailed (:455) re-queues
 with a per-task failure budget (failureMax :140), state snapshots (:207).
 
-TPU-native: a thread-safe in-process service (multi-host deployments put it
-on process 0 and reach it over the jax.distributed client or any KV store;
-trainers are stateless consumers exactly as in the reference design
-doc/design/cluster_train/README.md)."""
+TPU-native deployment: ``Master`` is the thread-safe queue object;
+``MasterServer`` serves it over TCP (newline-framed JSON-RPC — the Go
+master's net/rpc role) so trainers in OTHER processes/hosts consume tasks
+through ``MasterClient``, which duck-types the in-process API.  A trainer
+that dies mid-task simply stops renewing: the task deadline lapses and the
+chunk re-queues for a surviving trainer — elasticity comes from the queue
+contract, not from process supervision (design doc:
+doc/design/cluster_train/master_server.md)."""
 from __future__ import annotations
 
 import dataclasses
 import json
+import socket
+import socketserver
 import threading
 import time
 from typing import Callable, List, Optional
@@ -81,6 +87,12 @@ class Master:
                 self.done.append(ent[0])
             self._snapshot()
 
+    def stats(self) -> dict:
+        """Queue counters (the Go master's /debug status view)."""
+        with self._lock:
+            return {"todo": len(self.todo), "pending": len(self.pending),
+                    "done": len(self.done), "epoch": self.epoch}
+
     def task_failed(self, task_id: int):
         """Re-queue unless failure budget exhausted (service.go:455-472)."""
         with self._lock:
@@ -126,6 +138,148 @@ class Master:
         self.todo = [Task(**t) for t in
                      state["todo"] + state["pending"]]
         self.done = [Task(**t) for t in state["done"]]
+
+
+class MasterServer:
+    """Serve a Master over TCP (go/master RPC server analog).
+
+    Wire protocol: one JSON object per line, ``{"method": m, "params": {...}}``
+    -> ``{"result": ...}`` or ``{"error": "..."}``.  Threaded: each trainer
+    connection gets its own handler thread; Master methods are internally
+    locked.
+    """
+
+    METHODS = ("get_task", "task_finished", "task_failed", "set_dataset",
+               "stats", "ping")
+
+    def __init__(self, master: Master, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.master = master
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    try:
+                        req = json.loads(line)
+                        resp = {"result": outer._dispatch(
+                            req.get("method"), req.get("params") or {})}
+                    except Exception as e:  # noqa: BLE001 — report to client
+                        resp = {"error": f"{type(e).__name__}: {e}"}
+                    self.wfile.write(
+                        (json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+
+    def _dispatch(self, method, params):
+        if method not in self.METHODS:
+            raise ValueError(f"unknown method {method!r}")
+        if method == "ping":
+            return "pong"
+        if method == "get_task":
+            t = self.master.get_task()
+            return dataclasses.asdict(t) if t is not None else None
+        if method == "set_dataset":
+            return self.master.set_dataset(params["chunks"])
+        if method == "stats":
+            return self.master.stats()
+        return getattr(self.master, method)(params["task_id"])
+
+    def start(self) -> "MasterServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def address(self):
+        return f"{self.host}:{self.port}"
+
+
+class MasterClient:
+    """Trainer-side RPC stub with the Master's duck-typed API, so
+    ``TaskQueueClient`` works unchanged against a remote master (the Go
+    master_client / v2 master.client analog)."""
+
+    def __init__(self, address: str, timeout_s: float = 30.0,
+                 retries: int = 3, retry_wait_s: float = 0.5):
+        host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout_s
+        self._retries = retries
+        self._retry_wait = retry_wait_s
+        self._sock = None
+        self._file = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
+        self._file = self._sock.makefile("rwb")
+
+    def _call(self, method, **params):
+        with self._lock:
+            last = None
+            for _ in range(self._retries):
+                try:
+                    if self._file is None:
+                        self._connect()
+                    self._file.write((json.dumps(
+                        {"method": method, "params": params}) +
+                        "\n").encode())
+                    self._file.flush()
+                    line = self._file.readline()
+                    if not line:
+                        raise ConnectionError("master closed connection")
+                    resp = json.loads(line)
+                    if "error" in resp:
+                        raise RuntimeError(f"master: {resp['error']}")
+                    return resp["result"]
+                except (OSError, ConnectionError, json.JSONDecodeError) as e:
+                    last = e
+                    self.close()
+                    time.sleep(self._retry_wait)
+            raise ConnectionError(
+                f"master at {self._addr} unreachable: {last}")
+
+    # -- Master duck-type --------------------------------------------------
+    def get_task(self) -> Optional[Task]:
+        d = self._call("get_task")
+        return Task(**d) if d is not None else None
+
+    def task_finished(self, task_id: int):
+        return self._call("task_finished", task_id=task_id)
+
+    def task_failed(self, task_id: int):
+        return self._call("task_failed", task_id=task_id)
+
+    def set_dataset(self, chunks: List):
+        return self._call("set_dataset", chunks=chunks)
+
+    def stats(self) -> dict:
+        return self._call("stats")
+
+    def ping(self) -> str:
+        return self._call("ping")
+
+    def close(self):
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+        self._file = None
 
 
 class TaskQueueClient:
