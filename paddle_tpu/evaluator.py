@@ -110,3 +110,120 @@ class ChunkEvaluator(Evaluator):
         r = nc / max(nl, 1.0)
         f1 = 2 * p * r / max(p + r, 1e-6)
         return np.array([p, r, f1], np.float32)
+
+
+class DetectionMAP:
+    """Detection mean-average-precision (reference:
+    gserver/evaluators/DetectionMAPEvaluator.cpp; fluid detection_map_op).
+
+    Host-side streaming evaluator over fetched detection outputs — metric
+    aggregation has no MXU work, so it stays off-device by design (the
+    reference's evaluator also runs on CPU).  Feed it the static-shape
+    [N, K, 6] rows from ``layers.detection_output`` ((label, score, x1, y1,
+    x2, y2), -1-padded) plus padded ground truth; padding rows (label < 0)
+    are ignored.
+
+    ap_version: '11point' (VOC07 interpolation, the v1 default) or
+    'integral' (area under the raw PR curve).
+    """
+
+    def __init__(self, overlap_threshold=0.5, ap_version="11point",
+                 evaluate_difficult=True):
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self.evaluate_difficult = evaluate_difficult
+        self.reset()
+
+    def reset(self, *a, **kw):
+        self._dets = []      # (img_id, label, score, box)
+        self._gts = []       # (img_id, label, box, difficult)
+        self._img_count = 0
+
+    def update(self, detections, gt_boxes, gt_labels, gt_difficult=None):
+        """detections [N,K,6]; gt_boxes [N,M,4]; gt_labels [N,M] (pad<0)."""
+        det = np.asarray(detections)
+        gtb = np.asarray(gt_boxes)
+        gtl = np.asarray(gt_labels)
+        if gtl.ndim == 3:
+            gtl = gtl[..., 0]
+        gtd = (np.zeros_like(gtl, bool) if gt_difficult is None
+               else np.asarray(gt_difficult).astype(bool))
+        for i in range(det.shape[0]):
+            img = self._img_count
+            self._img_count += 1
+            for row in det[i]:
+                if row[0] >= 0:
+                    self._dets.append((img, int(row[0]), float(row[1]),
+                                       row[2:6].copy()))
+            for m in range(gtb.shape[1]):
+                if gtl[i, m] >= 0:
+                    self._gts.append((img, int(gtl[i, m]), gtb[i, m].copy(),
+                                      bool(gtd[i, m])))
+
+    @staticmethod
+    def _iou(a, b):
+        x1 = max(a[0], b[0]); y1 = max(a[1], b[1])
+        x2 = min(a[2], b[2]); y2 = min(a[3], b[3])
+        inter = max(x2 - x1, 0.0) * max(y2 - y1, 0.0)
+        ua = max(a[2] - a[0], 0) * max(a[3] - a[1], 0) + \
+            max(b[2] - b[0], 0) * max(b[3] - b[1], 0) - inter
+        return inter / ua if ua > 0 else 0.0
+
+    def _ap(self, tp, fp, n_pos):
+        if n_pos == 0:
+            return None
+        tp = np.cumsum(tp).astype(np.float64)
+        fp = np.cumsum(fp).astype(np.float64)
+        recall = tp / n_pos
+        precision = tp / np.maximum(tp + fp, 1e-12)
+        if self.ap_version == "11point":
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                p = precision[recall >= t].max() if (recall >= t).any() \
+                    else 0.0
+                ap += p / 11.0
+            return ap
+        # integral: sum precision deltas over recall steps
+        ap = 0.0
+        prev_r = 0.0
+        for p, r in zip(precision, recall):
+            ap += p * (r - prev_r)
+            prev_r = r
+        return ap
+
+    def eval(self, *a, **kw):
+        labels = sorted({g[1] for g in self._gts})
+        aps = []
+        for c in labels:
+            gts = [g for g in self._gts if g[1] == c]
+            n_pos = sum(1 for g in gts
+                        if self.evaluate_difficult or not g[3])
+            dets = sorted((d for d in self._dets if d[1] == c),
+                          key=lambda d: -d[2])
+            matched = set()
+            tp = np.zeros(len(dets)); fp = np.zeros(len(dets))
+            for k, (img, _, _, box) in enumerate(dets):
+                # VOC protocol: each detection is assigned to its
+                # MAX-overlap gt (matched or not); a duplicate hit on an
+                # already-claimed gt is a false positive
+                best, best_j = 0.0, -1
+                for j, (gimg, _, gbox, _) in enumerate(gts):
+                    if gimg != img:
+                        continue
+                    ov = self._iou(box, gbox)
+                    if ov > best:
+                        best, best_j = ov, j
+                if best >= self.overlap_threshold and best_j >= 0:
+                    if not self.evaluate_difficult and gts[best_j][3]:
+                        pass       # matched a difficult gt: ignored
+                    elif best_j not in matched:
+                        matched.add(best_j)
+                        tp[k] = 1
+                    else:
+                        fp[k] = 1  # duplicate detection of a claimed gt
+                else:
+                    fp[k] = 1
+            ap = self._ap(tp, fp, n_pos)
+            if ap is not None:
+                aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

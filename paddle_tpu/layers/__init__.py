@@ -12,5 +12,6 @@ from .io import data         # noqa: F401
 from .ops import *           # noqa: F401,F403
 from .ops import elementwise_binary_dispatch  # noqa: F401
 from . import detection      # noqa: F401
-from .detection import prior_box, box_coder, iou_similarity  # noqa: F401
+from .detection import (prior_box, box_coder, iou_similarity,  # noqa: F401
+                        ssd_loss, detection_output)  # noqa: F401
 from .generation import BeamSearchDecoder  # noqa: F401

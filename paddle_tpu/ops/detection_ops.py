@@ -41,14 +41,24 @@ def _roi_pool(ctx, ins, attrs):
         oh = jnp.clip(bin_h, 0, ph - 1).astype(jnp.int32)
         ow = jnp.clip(bin_w, 0, pw - 1).astype(jnp.int32)
         neg = jnp.asarray(-3.4e38, x.dtype)
-        masked = jnp.where(valid_h[None, :, None] & valid_w[None, None, :],
-                           img, neg)
+        valid = valid_h[None, :, None] & valid_w[None, None, :]
+        masked = jnp.where(valid, img, neg)
         out = jnp.full((C, ph, pw), neg, x.dtype)
         out = out.at[:, oh[:, None], ow[None, :]].max(masked)
-        return jnp.where(out <= neg / 2, 0.0, out)
+        # Argmax (roi_pool_op.h argmax data): flat h*W+w index of each
+        # bin's max — a pixel is its bin's argmax iff it attains the bin
+        # max; ties resolve to the smallest flat index via scatter-min
+        flat = (hs[:, None] * W + ws[None, :]).astype(jnp.int64)  # [H,W]
+        is_max = valid & (img == out[:, oh[:, None], ow[None, :]])
+        cand = jnp.where(is_max, flat[None], jnp.int64(H * W))
+        amax = jnp.full((C, ph, pw), jnp.int64(H * W))
+        amax = amax.at[:, oh[:, None], ow[None, :]].min(cand)
+        empty = out <= neg / 2
+        return (jnp.where(empty, 0.0, out),
+                jnp.where(empty | (amax >= H * W), jnp.int64(-1), amax))
 
-    out = jax.vmap(one_roi)(rois.astype(jnp.float32))
-    return {"Out": out, "Argmax": jnp.zeros_like(out, dtype=jnp.int64)}
+    out, amax = jax.vmap(one_roi)(rois.astype(jnp.float32))
+    return {"Out": out, "Argmax": amax}
 
 
 @register_op("prior_box")
@@ -127,6 +137,116 @@ def _iou_similarity(ctx, ins, attrs):
     area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
     return {"Out": inter / jnp.maximum(area_a[:, None] + area_b[None, :]
                                        - inter, 1e-10)}
+
+
+@register_op("ssd_loss")
+def _ssd_loss(ctx, ins, attrs):
+    """MultiBoxLoss (gserver/layers/MultiBoxLoss.cpp; fluid ssd_loss):
+    prior-to-ground-truth matching, smooth-L1 localization loss on matched
+    priors, softmax confidence loss with 3:1 hard negative mining.
+
+    Static-shape TPU design: ground truth arrives PADDED [N, M, ...] with
+    label < 0 marking padding rows (no LoD) — matching, mining, and both
+    losses are vmapped batch programs with masks; the ragged reference
+    pipeline (bipartite match + CPU sort) becomes one fused XLA program.
+
+    Inputs: Location [N,P,4] predicted encodings; Confidence [N,P,C]
+    logits; GTBox [N,M,4] corner-form; GTLabel [N,M] int (pad<0);
+    PriorBox [P,4]; PriorBoxVar [P,4] (optional).
+    Output Loss [N,1].
+    """
+    from jax import lax
+
+    loc = ins["Location"][0]
+    conf = ins["Confidence"][0]
+    gt_box = ins["GTBox"][0]
+    gt_label = ins["GTLabel"][0].astype(jnp.int32)
+    if gt_label.ndim == 3:
+        gt_label = gt_label.squeeze(-1)
+    prior = ins["PriorBox"][0].reshape(-1, 4)
+    pvar = (ins["PriorBoxVar"][0].reshape(-1, 4)
+            if ins.get("PriorBoxVar") else
+            jnp.broadcast_to(jnp.asarray([0.1, 0.1, 0.2, 0.2], loc.dtype),
+                             prior.shape))
+    overlap_t = attrs.get("overlap_threshold", 0.5)
+    neg_ratio = attrs.get("neg_pos_ratio", 3.0)
+    loc_w = attrs.get("loc_loss_weight", 1.0)
+    conf_w = attrs.get("conf_loss_weight", 1.0)
+    background = int(attrs.get("background_label", 0))
+    N, P, C = conf.shape
+    M = gt_box.shape[1]
+
+    pw = prior[:, 2] - prior[:, 0]
+    ph_ = prior[:, 3] - prior[:, 1]
+    pcx = (prior[:, 0] + prior[:, 2]) / 2
+    pcy = (prior[:, 1] + prior[:, 3]) / 2
+
+    def encode(gt):                                   # [M,4] -> [M,P,4]
+        gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-10)
+        gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-10)
+        gcx = (gt[:, 0] + gt[:, 2]) / 2
+        gcy = (gt[:, 1] + gt[:, 3]) / 2
+        tx = (gcx[:, None] - pcx[None]) / pw[None] / pvar[None, :, 0]
+        ty = (gcy[:, None] - pcy[None]) / ph_[None] / pvar[None, :, 1]
+        tw = jnp.log(gw[:, None] / pw[None]) / pvar[None, :, 2]
+        th = jnp.log(gh[:, None] / ph_[None]) / pvar[None, :, 3]
+        return jnp.stack([tx, ty, tw, th], axis=-1)
+
+    def iou_mp(gt):                                   # [M,4] -> [M,P]
+        lt = jnp.maximum(gt[:, None, :2], prior[None, :, :2])
+        rb = jnp.minimum(gt[:, None, 2:], prior[None, :, 2:])
+        wh = jnp.maximum(rb - lt, 0.0)
+        inter = wh[..., 0] * wh[..., 1]
+        ag = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
+        ap = pw * ph_
+        return inter / jnp.maximum(ag[:, None] + ap[None] - inter, 1e-10)
+
+    def one(loc_i, conf_i, gtb, gtl):
+        valid_gt = gtl >= 0                           # [M]
+        iou = jnp.where(valid_gt[:, None], iou_mp(gtb), -1.0)   # [M,P]
+        # per-prior best gt (per-prediction matching) ...
+        best_gt = jnp.argmax(iou, axis=0)             # [P]
+        best_iou = jnp.max(iou, axis=0)
+        # ... plus bipartite pass: each gt claims its single best prior
+        # (MultiBoxLoss.cpp matchBBox semantics)
+        best_prior = jnp.argmax(iou, axis=1)          # [M]
+        # scatter-max so padding gts (claim=-1/False) can't overwrite a
+        # real gt that claimed the same prior index
+        forced = jnp.zeros((P,), bool).at[best_prior].max(valid_gt)
+        forced_gt = jnp.full((P,), -1, jnp.int32).at[best_prior].max(
+            jnp.where(valid_gt, jnp.arange(M, dtype=jnp.int32), -1))
+        pos = forced | (best_iou >= overlap_t)
+        match = jnp.where(forced_gt >= 0, forced_gt,
+                          best_gt.astype(jnp.int32))
+        num_pos = jnp.sum(pos)
+
+        # localization: smooth-L1 between predicted and encoded target
+        targets = encode(gtb)                         # [M,P,4]
+        tgt = targets[match, jnp.arange(P)]           # [P,4]
+        d = loc_i - tgt
+        ad = jnp.abs(d)
+        smooth = jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5)
+        loc_loss = jnp.sum(jnp.where(pos[:, None], smooth, 0.0))
+
+        # confidence: softmax CE vs matched label (background for negs)
+        tgt_cls = jnp.where(pos, gtl[match], background)
+        logp = jax.nn.log_softmax(conf_i, axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt_cls[:, None], axis=1)[:, 0]
+        # hard negative mining: top (neg_ratio * num_pos) negatives by loss
+        neg_ce = jnp.where(pos, -jnp.inf, ce)
+        order = jnp.argsort(-neg_ce)                  # desc
+        rank = jnp.zeros((P,), jnp.int32).at[order].set(
+            jnp.arange(P, dtype=jnp.int32))
+        num_neg = jnp.minimum((neg_ratio * num_pos).astype(jnp.int32),
+                              P - num_pos)
+        neg = (~pos) & (rank < num_neg)
+        conf_loss = jnp.sum(jnp.where(pos | neg, ce, 0.0))
+
+        denom = jnp.maximum(num_pos.astype(loc_i.dtype), 1.0)
+        return (loc_w * loc_loss + conf_w * conf_loss) / denom
+
+    loss = jax.vmap(one)(loc, conf, gt_box, gt_label)
+    return {"Loss": loss[:, None]}
 
 
 @register_op("multiclass_nms", "detection_output")
