@@ -93,8 +93,11 @@ class CheckpointManager:
     # -- save --------------------------------------------------------------
     def save(self, step: int, scope: Optional[Scope] = None,
              var_names=None, blocking: bool = False):
+        import jax
+
         scope = global_scope() if scope is None else scope
         names = var_names or scope.keys()
+        self.wait()                    # never two writers for one manager
         # snapshot to host synchronously (per-shard copies, cheap vs a
         # training step and never a cross-device gather); write async
         snap = {}
@@ -106,22 +109,51 @@ class CheckpointManager:
             snap[n] = (shape, str(np.asarray(pieces[0][1]).dtype)
                        if pieces else str(getattr(arr, "dtype", "float32")),
                        pieces)
+        nonce = self._begin_attempt(step)
         if self.async_save and not blocking:
-            self.wait()
             self._thread = threading.Thread(
-                target=self._write, args=(step, snap), daemon=True)
+                target=self._write, args=(step, snap, nonce), daemon=True)
             self._thread.start()
         else:
-            self._write(step, snap)
+            self._write(step, snap, nonce)
 
-    def _write(self, step: int, snap):
+    def _begin_attempt(self, step: int) -> str:
+        """Synchronous (main-thread) attempt setup: clear stale artifacts of
+        a crashed prior save at this step and agree on a per-attempt nonce.
+
+        Collectives are only legal here — save() is called at the same
+        program point on every process, so the barrier order is globally
+        consistent; the async writer thread then coordinates purely through
+        nonce-matched files (a stale manifest can never satisfy a fresh
+        attempt's wait)."""
+        import jax
+
+        proc = jax.process_index()
+        nprocs = jax.process_count()
+        d = os.path.join(self.root, f"ckpt-{step}.tmp")
+        if nprocs == 1:
+            shutil.rmtree(d, ignore_errors=True)
+            os.makedirs(d)
+            return os.urandom(8).hex()
+        from jax.experimental import multihost_utils
+        # everyone is past any previous attempt's writes before cleanup
+        multihost_utils.sync_global_devices(f"ckpt-{step}-begin")
+        if proc == 0:
+            shutil.rmtree(d, ignore_errors=True)
+            os.makedirs(d)
+            with open(os.path.join(d, "attempt.json"), "w") as f:
+                json.dump({"nonce": os.urandom(8).hex()}, f)
+        multihost_utils.sync_global_devices(f"ckpt-{step}-attempt")
+        with open(os.path.join(d, "attempt.json")) as f:
+            return json.load(f)["nonce"]
+
+    def _write(self, step: int, snap, nonce: str):
         import jax
 
         proc = jax.process_index()
         nprocs = jax.process_count()
         d = os.path.join(self.root, f"ckpt-{step}.tmp")
         final = os.path.join(self.root, f"ckpt-{step}")
-        os.makedirs(d, exist_ok=True)
         manifest = {}
         for n, (shape, dtype, pieces) in snap.items():
             base = n.replace("/", "__")
@@ -136,22 +168,39 @@ class CheckpointManager:
             manifest[n] = {"shape": list(shape), "dtype": dtype,
                            "shards": shards}
         with open(os.path.join(d, f"shards-{proc}.json"), "w") as f:
-            json.dump(manifest, f)
-        if nprocs > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(f"ckpt-{step}-shards")
+            json.dump({"nonce": nonce, "vars": manifest}, f)
+        # Cross-process coordination in THIS thread uses nonce-matched FILE
+        # waits, not device collectives: enqueueing sync_global_devices from
+        # the async writer would interleave with the training thread's
+        # collectives in a host-dependent order — a cross-host collective-
+        # order mismatch hangs TPU programs.  The nonce (agreed on the main
+        # thread in _begin_attempt) makes stale files from a crashed prior
+        # attempt unable to satisfy the wait.
+        if nprocs > 1 and proc == 0:
+            def _all_manifests_fresh():
+                for p in range(nprocs):
+                    path = os.path.join(d, f"shards-{p}.json")
+                    try:
+                        with open(path) as f:
+                            if json.load(f).get("nonce") != nonce:
+                                return False
+                    except (OSError, json.JSONDecodeError):
+                        return False
+                return True
+            self._wait_for(_all_manifests_fresh,
+                           f"ckpt-{step} shard manifests")
         if proc == 0:
             merged = {}
             for p in range(nprocs):
                 with open(os.path.join(d, f"shards-{p}.json")) as f:
-                    part = json.load(f)
+                    part = json.load(f)["vars"]
                 for n, info in part.items():
                     if n not in merged:
                         merged[n] = {"shape": info["shape"],
                                      "dtype": info["dtype"], "shards": []}
                     merged[n]["shards"].extend(info["shards"])
             meta = {"step": step, "timestamp": time.time(),
-                    "format": "sharded-v1", "vars": merged}
+                    "format": "sharded-v1", "nonce": nonce, "vars": merged}
             # meta written last = commit point (service.go checkpoint
             # protocol: the etcd record there, a JSON file here)
             with open(os.path.join(d, "meta.json"), "w") as f:
@@ -160,9 +209,25 @@ class CheckpointManager:
                 shutil.rmtree(final)
             os.rename(d, final)
             self._gc()
-        if nprocs > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(f"ckpt-{step}-commit")
+        elif nprocs > 1:
+            # non-zero processes return once THIS attempt's commit
+            # (meta.json carrying the attempt nonce) is visible
+            def _committed():
+                try:
+                    with open(os.path.join(final, "meta.json")) as f:
+                        return json.load(f).get("nonce") == nonce
+                except (OSError, json.JSONDecodeError):
+                    return False
+            self._wait_for(_committed, f"ckpt-{step} commit")
+
+    @staticmethod
+    def _wait_for(cond, what, timeout_s: float = 600.0,
+                  poll_s: float = 0.05):
+        deadline = time.time() + timeout_s
+        while not cond():
+            if time.time() > deadline:
+                raise TimeoutError(f"checkpoint barrier timed out: {what}")
+            time.sleep(poll_s)
 
     def wait(self):
         if self._thread is not None and self._thread.is_alive():
