@@ -1,16 +1,41 @@
-"""CIFAR reader (reference: v2/dataset/cifar.py; pickle-batch loader +
-synthetic fallback)."""
+"""CIFAR reader (reference: v2/dataset/cifar.py — tar-of-pickle-batches
+parser + md5-cached download).
+
+Real path: the official cifar-10/100-python.tar.gz is parsed straight from
+the tar (no extraction), samples normalized to [0,1] [3,32,32] floats.  The
+archive is used when already md5-cached under DATA_HOME (or fetched with
+``download=True``); otherwise the deterministic synthetic generator keeps
+offline CI hermetic."""
 from __future__ import annotations
 
 import os
 import pickle
+import tarfile
 
-import numpy as np
+from .common import cached_path, synthetic_classification
 
-from .common import synthetic_classification
+URL_PREFIX = "https://www.cs.toronto.edu/~kriz/"
+CIFAR10_URL = URL_PREFIX + "cifar-10-python.tar.gz"
+CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"
+CIFAR100_URL = URL_PREFIX + "cifar-100-python.tar.gz"
+CIFAR100_MD5 = "eb9058c3a382ffc7106e4002c42a8d85"
 
 
-def _batches_reader(paths, label_key):
+def _tar_reader(archive_path, sub_name, label_key):
+    """Yield (img, label) from every pickle batch whose member name contains
+    ``sub_name`` (cifar.py:47 reader_creator)."""
+    def reader():
+        with tarfile.open(archive_path, mode="r") as tf:
+            names = sorted(n for n in tf.getnames() if sub_name in n)
+            for name in names:
+                batch = pickle.load(tf.extractfile(name), encoding="latin1")
+                for x, y in zip(batch["data"], batch[label_key]):
+                    yield (x.astype("float32").reshape(3, 32, 32) / 255.0,
+                           int(y))
+    return reader
+
+
+def _files_reader(paths, label_key):
     def reader():
         for p in paths:
             with open(p, "rb") as f:
@@ -20,29 +45,38 @@ def _batches_reader(paths, label_key):
     return reader
 
 
-def train10(data_dir=None):
-    if data_dir:
-        paths = [os.path.join(data_dir, f"data_batch_{i}")
-                 for i in range(1, 6)]
+def _make(url, md5, sub_name, label_key, data_dir, do_download, synth_args):
+    if data_dir:                       # explicit pre-extracted batches
+        if sub_name == "data_batch":
+            paths = [os.path.join(data_dir, f"data_batch_{i}")
+                     for i in range(1, 6)]
+        else:                          # test_batch / cifar-100 train / test
+            paths = [os.path.join(data_dir, sub_name)]
         if all(os.path.exists(p) for p in paths):
-            return _batches_reader(paths, "labels")
-    return synthetic_classification(4000, (3, 32, 32), 10, seed=10,
-                                    proto_seed=9)
+            return _files_reader(paths, label_key)
+    archive = cached_path(url, "cifar", md5, do_download)
+    if archive:
+        return _tar_reader(archive, sub_name, label_key)
+    n, classes, seed, proto = synth_args
+    return synthetic_classification(n, (3, 32, 32), classes, seed=seed,
+                                    proto_seed=proto)
 
 
-def test10(data_dir=None):
-    if data_dir and os.path.exists(os.path.join(data_dir, "test_batch")):
-        return _batches_reader([os.path.join(data_dir, "test_batch")],
-                               "labels")
-    return synthetic_classification(800, (3, 32, 32), 10, seed=11,
-                                    proto_seed=9)
+def train10(data_dir=None, download=False):
+    return _make(CIFAR10_URL, CIFAR10_MD5, "data_batch", "labels",
+                 data_dir, download, (4000, 10, 10, 9))
 
 
-def train100(data_dir=None):
-    return synthetic_classification(4000, (3, 32, 32), 100, seed=100,
-                                    proto_seed=99)
+def test10(data_dir=None, download=False):
+    return _make(CIFAR10_URL, CIFAR10_MD5, "test_batch", "labels",
+                 data_dir, download, (800, 10, 11, 9))
 
 
-def test100(data_dir=None):
-    return synthetic_classification(800, (3, 32, 32), 100, seed=101,
-                                    proto_seed=99)
+def train100(data_dir=None, download=False):
+    return _make(CIFAR100_URL, CIFAR100_MD5, "train", "fine_labels",
+                 data_dir, download, (4000, 100, 100, 99))
+
+
+def test100(data_dir=None, download=False):
+    return _make(CIFAR100_URL, CIFAR100_MD5, "test", "fine_labels",
+                 data_dir, download, (800, 100, 101, 99))

@@ -1,7 +1,118 @@
-"""Shared helpers for dataset modules."""
+"""Shared helpers for dataset modules: md5-cached download, file split
+utilities (reference: v2/dataset/common.py — DATA_HOME:34, download:63
+retry + md5 verify, split:110, cluster_files_reader:140), plus the
+deterministic synthetic generators that keep CI hermetic when the real
+archives are absent."""
 from __future__ import annotations
 
+import errno
+import glob
+import hashlib
+import os
+import pickle
+
 import numpy as np
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+
+def must_mkdirs(path):
+    """mkdir -p that tolerates concurrent creators (common.py:41)."""
+    try:
+        os.makedirs(path)
+    except OSError as exc:
+        if exc.errno != errno.EEXIST:
+            raise
+
+
+def md5file(fname, chunk=1 << 20):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for c in iter(lambda: f.read(chunk), b""):
+            h.update(c)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, retry_limit=3):
+    """Fetch ``url`` into DATA_HOME/module_name with md5 verification and
+    retries; return the cached path (common.py:63).  A file already present
+    with the right md5 is never re-fetched, so offline runs that have the
+    cache (or that pre-populate it from local media / file:// URLs) work
+    without network."""
+    import urllib.request
+
+    dirname = os.path.join(DATA_HOME, module_name)
+    must_mkdirs(dirname)
+    filename = os.path.join(dirname, url.split("/")[-1])
+    retry = 0
+    last_err = None
+    while not (os.path.exists(filename) and md5file(filename) == md5sum):
+        if retry >= retry_limit:
+            raise RuntimeError(
+                f"cannot download {url} within {retry_limit} retries "
+                f"(md5 mismatch or unreachable; last error: {last_err})")
+        retry += 1
+        tmp = filename + ".part"
+        try:
+            with urllib.request.urlopen(url) as r, open(tmp, "wb") as out:
+                for chunk in iter(lambda: r.read(1 << 20), b""):
+                    out.write(chunk)
+            os.replace(tmp, filename)
+        except OSError as e:          # URLError subclasses OSError
+            last_err = e
+            if os.path.exists(tmp):
+                os.remove(tmp)
+    return filename
+
+
+def cached_path(url, module_name, md5sum, do_download=False):
+    """The one cache probe every dataset module shares: the md5-verified
+    cached file if present; else fetch it when ``do_download``; else None
+    (callers fall back to their synthetic generators).  Real data is only
+    ever used on EXPLICIT request — a populated cache must not silently
+    change what a default reader yields."""
+    if not do_download:
+        return None
+    filename = os.path.join(DATA_HOME, module_name, url.split("/")[-1])
+    if os.path.exists(filename) and md5file(filename) == md5sum:
+        return filename
+    return download(url, module_name, md5sum)
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Split a reader's samples into pickle files of ``line_count`` samples
+    (common.py:110 — the cluster-job data prep step)."""
+    dumper = dumper or (lambda data, f: pickle.dump(data, f))
+    indx_f = 0
+    buf = []
+    for sample in reader():
+        buf.append(sample)
+        if len(buf) == line_count:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(buf, f)
+            buf = []
+            indx_f += 1
+    if buf:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(buf, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Read this trainer's round-robin share of split files
+    (common.py:140)."""
+    loader = loader or (lambda f: pickle.load(f))
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        for idx, fn in enumerate(flist):
+            if idx % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    for sample in loader(f):
+                        yield sample
+    return reader
 
 
 def synthetic_classification(n, feat_shape, num_classes, seed,

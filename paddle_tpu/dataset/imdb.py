@@ -1,21 +1,106 @@
-"""IMDB sentiment reader (reference: v2/dataset/imdb.py + benchmark
-rnn/imdb.py; synthetic fallback)."""
+"""IMDB sentiment reader (reference: v2/dataset/imdb.py — aclImdb tar
+tokenizer, frequency-cutoff dictionary, shuffled pos/neg reader; synthetic
+fallback for offline CI)."""
 from __future__ import annotations
 
-from .common import synthetic_sequences
+import collections
+import os
+import re
+import string
+import tarfile
 
+import numpy as np
+
+from .common import cached_path, synthetic_sequences
+
+URL = "http://ai.stanford.edu/%7Eamaas/data/sentiment/aclImdb_v1.tar.gz"
+MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
 VOCAB_SIZE = 5000
 
+TRAIN_POS = re.compile(r"aclImdb/train/pos/.*\.txt$")
+TRAIN_NEG = re.compile(r"aclImdb/train/neg/.*\.txt$")
+TEST_POS = re.compile(r"aclImdb/test/pos/.*\.txt$")
+TEST_NEG = re.compile(r"aclImdb/test/neg/.*\.txt$")
 
-def word_dict():
-    return {f"w{i}": i for i in range(VOCAB_SIZE)}
-
-
-def train(word_idx=None):
-    v = len(word_idx) if word_idx else VOCAB_SIZE
-    return synthetic_sequences(2000, v, 2, seed=20, min_len=8, max_len=60)
+_PUNCT = str.maketrans("", "", string.punctuation)
 
 
-def test(word_idx=None):
-    v = len(word_idx) if word_idx else VOCAB_SIZE
-    return synthetic_sequences(400, v, 2, seed=21, min_len=8, max_len=60)
+_DICT_MEMO = {}
+
+
+def _archive(do_download=False):
+    return cached_path(URL, "imdb", MD5, do_download)
+
+
+def tokenize(pattern, archive_path):
+    """Sequential tar walk (imdb.py:35 — tarfile.next, not random access),
+    yielding the lowercase punctuation-stripped token list per document."""
+    with tarfile.open(archive_path) as tarf:
+        tf = tarf.next()
+        while tf is not None:
+            if pattern.match(tf.name):
+                text = tarf.extractfile(tf).read().decode(
+                    "utf-8", errors="ignore")
+                yield text.rstrip("\n\r").translate(_PUNCT).lower().split()
+            tf = tarf.next()
+
+
+def build_dict(pattern=None, cutoff=150, download=False):
+    """Frequency-cutoff word dict (imdb.py:56): ids ordered by (-freq,
+    word), '<unk>' last.  Falls back to the synthetic vocab offline."""
+    archive = _archive(download)
+    if archive is None:
+        return {f"w{i}": i for i in range(VOCAB_SIZE)}
+    memo_key = (archive, cutoff, pattern.pattern if pattern else None)
+    if memo_key in _DICT_MEMO:
+        return _DICT_MEMO[memo_key]
+    if pattern is None:
+        pattern = re.compile(
+            r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+    word_freq = collections.defaultdict(int)
+    for doc in tokenize(pattern, archive):
+        for w in doc:
+            word_freq[w] += 1
+    items = [(w, f) for w, f in word_freq.items() if f > cutoff]
+    items.sort(key=lambda x: (-x[1], x[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(items)}
+    word_idx["<unk>"] = len(word_idx)
+    _DICT_MEMO[memo_key] = word_idx
+    return word_idx
+
+
+word_dict = build_dict
+
+
+def _reader_creator(pos_pattern, neg_pattern, word_idx, archive, seed):
+    UNK = word_idx.get("<unk>", len(word_idx) - 1)
+
+    def reader():
+        ins = []
+        for doc in tokenize(pos_pattern, archive):
+            ins.append(([word_idx.get(w, UNK) for w in doc], 0))
+        for doc in tokenize(neg_pattern, archive):
+            ins.append(([word_idx.get(w, UNK) for w in doc], 1))
+        np.random.RandomState(seed).shuffle(ins)
+        yield from ins
+    return reader
+
+
+def train(word_idx=None, download=False):
+    archive = _archive(download)
+    if archive is None:
+        v = len(word_idx) if word_idx else VOCAB_SIZE
+        return synthetic_sequences(2000, v, 2, seed=20, min_len=8,
+                                   max_len=60)
+    word_idx = word_idx or build_dict(download=download)
+    return _reader_creator(TRAIN_POS, TRAIN_NEG, word_idx, archive, 0)
+
+
+def test(word_idx=None, download=False):
+    archive = _archive(download)
+    if archive is None:
+        v = len(word_idx) if word_idx else VOCAB_SIZE
+        return synthetic_sequences(400, v, 2, seed=21, min_len=8,
+                                   max_len=60)
+    word_idx = word_idx or build_dict(download=download)
+    return _reader_creator(TEST_POS, TEST_NEG, word_idx, archive, 1)

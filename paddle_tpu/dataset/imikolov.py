@@ -1,32 +1,106 @@
-"""PTB n-gram LM reader (reference: v2/dataset/imikolov.py; synthetic)."""
+"""PTB (Mikolov) LM reader (reference: v2/dataset/imikolov.py —
+simple-examples.tgz parser, min-frequency dictionary, NGRAM/SEQ reader
+modes; synthetic fallback for offline CI)."""
 from __future__ import annotations
+
+import collections
+import os
+import tarfile
 
 import numpy as np
 
+from .common import cached_path
+
+URL = "http://www.fit.vutbr.cz/~imikolov/rnnlm/simple-examples.tgz"
+MD5 = "30177ea32e27c525793142b6bf2c8e2d"
+TRAIN_FILE = "./simple-examples/data/ptb.train.txt"
+VALID_FILE = "./simple-examples/data/ptb.valid.txt"
 VOCAB = 2000
 
 
-def build_dict(min_word_freq=50):
-    return {f"w{i}": i for i in range(VOCAB)}
+class DataType:
+    NGRAM = 1
+    SEQ = 2
 
 
-def train(word_idx=None, n=5):
-    v = len(word_idx) if word_idx else VOCAB
+def _archive(do_download=False):
+    return cached_path(URL, "imikolov", MD5, do_download)
 
+
+def word_count(f, word_freq=None):
+    """Line word counts with <s>/<e> sentence markers (imikolov.py:36)."""
+    word_freq = word_freq if word_freq is not None else \
+        collections.defaultdict(int)
+    for line in f:
+        if isinstance(line, bytes):
+            line = line.decode("utf-8", errors="ignore")
+        for w in line.strip().split():
+            word_freq[w] += 1
+        word_freq["<s>"] += 1
+        word_freq["<e>"] += 1
+    return word_freq
+
+
+def build_dict(min_word_freq=50, download=False):
+    archive = _archive(download)
+    if archive is None:
+        return {f"w{i}": i for i in range(VOCAB)}
+    with tarfile.open(archive) as tf:
+        freq = word_count(tf.extractfile(VALID_FILE),
+                          word_count(tf.extractfile(TRAIN_FILE)))
+    freq.pop("<unk>", None)
+    items = [(w, f) for w, f in freq.items() if f > min_word_freq]
+    items.sort(key=lambda x: (-x[1], x[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(items)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def _real_reader(filename, word_idx, n, data_type, archive):
     def reader():
-        r = np.random.RandomState(30)
-        for _ in range(3000):
+        with tarfile.open(archive) as tf:
+            f = tf.extractfile(filename)
+            UNK = word_idx["<unk>"]
+            for line in f:
+                line = line.decode("utf-8", errors="ignore")
+                if DataType.NGRAM == data_type:
+                    assert n > -1, "Invalid gram length"
+                    toks = ["<s>"] + line.strip().split() + ["<e>"]
+                    if len(toks) >= n:
+                        ids = [word_idx.get(w, UNK) for w in toks]
+                        for i in range(n, len(ids) + 1):
+                            yield tuple(ids[i - n:i])
+                elif DataType.SEQ == data_type:
+                    toks = line.strip().split()
+                    ids = [word_idx.get(w, UNK) for w in toks]
+                    src = [word_idx["<s>"]] + ids
+                    tgt = ids + [word_idx["<e>"]]
+                    yield src, tgt
+    return reader
+
+
+def _synth_reader(seed, n_samples, v, n):
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n_samples):
             start = int(r.randint(0, v - n))
             yield tuple(range(start, start + n))   # learnable successor rule
     return reader
 
 
-def test(word_idx=None, n=5):
-    v = len(word_idx) if word_idx else VOCAB
+def train(word_idx=None, n=5, data_type=DataType.NGRAM, download=False):
+    archive = _archive(download)
+    if archive is None:
+        v = len(word_idx) if word_idx else VOCAB
+        return _synth_reader(30, 3000, v, n)
+    word_idx = word_idx or build_dict(download=download)
+    return _real_reader(TRAIN_FILE, word_idx, n, data_type, archive)
 
-    def reader():
-        r = np.random.RandomState(31)
-        for _ in range(500):
-            start = int(r.randint(0, v - n))
-            yield tuple(range(start, start + n))
-    return reader
+
+def test(word_idx=None, n=5, data_type=DataType.NGRAM, download=False):
+    archive = _archive(download)
+    if archive is None:
+        v = len(word_idx) if word_idx else VOCAB
+        return _synth_reader(31, 500, v, n)
+    word_idx = word_idx or build_dict(download=download)
+    return _real_reader(VALID_FILE, word_idx, n, data_type, archive)

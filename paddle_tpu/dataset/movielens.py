@@ -1,17 +1,109 @@
-"""MovieLens reader (reference: v2/dataset/movielens.py; synthetic)."""
+"""MovieLens 1-M reader (reference: v2/dataset/movielens.py — ml-1m.zip
+parser with MovieInfo/UserInfo metadata, title/category dictionaries, and
+the 90/10 rating split; synthetic fallback for offline CI)."""
 from __future__ import annotations
+
+import os
+import re
+import zipfile
 
 import numpy as np
 
-NUM_USERS, NUM_MOVIES = 944, 1683
+from .common import cached_path
+
+URL = "http://files.grouplens.org/datasets/movielens/ml-1m.zip"
+MD5 = "c4d9eecfca2ab87c1945afe126590906"
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+NUM_USERS, NUM_MOVIES = 944, 1683          # synthetic-mode id spaces
 
 
-def max_user_id():
-    return NUM_USERS - 1
+class MovieInfo:
+    """Movie id, title and categories (movielens.py:44)."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index,
+                [CATEGORIES_DICT[c] for c in self.categories],
+                [MOVIE_TITLE_DICT[w.lower()] for w in self.title.split()]]
 
 
-def max_movie_id():
-    return NUM_MOVIES - 1
+class UserInfo:
+    """User id, gender, age bucket, job (movielens.py:71)."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+
+MOVIE_INFO = None
+MOVIE_TITLE_DICT = None
+CATEGORIES_DICT = None
+USER_INFO = None
+_META_ARCHIVE = None      # which archive the globals were parsed from
+
+
+def _archive(do_download=False):
+    return cached_path(URL, "movielens", MD5, do_download)
+
+
+def _init_meta(fn):
+    global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO, \
+        _META_ARCHIVE
+    if MOVIE_INFO is not None and _META_ARCHIVE == fn:
+        return
+    _META_ARCHIVE = fn
+    MOVIE_INFO = None
+    pattern = re.compile(r"^(.*)\((\d+)\)$")
+    MOVIE_INFO, title_words, categories = {}, set(), set()
+    with zipfile.ZipFile(fn) as package:
+        with package.open("ml-1m/movies.dat") as f:
+            for line in f:
+                mid, title, cats = line.decode(
+                    "latin1").strip().split("::")
+                cats = cats.split("|")
+                categories.update(cats)
+                title = pattern.match(title).group(1)
+                MOVIE_INFO[int(mid)] = MovieInfo(mid, cats, title)
+                title_words.update(w.lower() for w in title.split())
+        MOVIE_TITLE_DICT = {w: i for i, w in enumerate(sorted(title_words))}
+        CATEGORIES_DICT = {c: i for i, c in enumerate(sorted(categories))}
+        USER_INFO = {}
+        with package.open("ml-1m/users.dat") as f:
+            for line in f:
+                uid, gender, age, job, _ = line.decode(
+                    "latin1").strip().split("::")
+                USER_INFO[int(uid)] = UserInfo(uid, gender, age, job)
+
+
+def _real_reader(archive, is_test, test_ratio=0.1, rand_seed=0):
+    """Rating rows -> user.value() + movie.value() + [score]
+    (movielens.py:141 __reader__); the split is a seeded per-row coin flip
+    like the reference."""
+    def reader():
+        _init_meta(archive)
+        rng = np.random.RandomState(rand_seed)
+        with zipfile.ZipFile(archive) as package:
+            with package.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (rng.rand() < test_ratio) != is_test:
+                        continue
+                    uid, mid, score, _ = line.decode(
+                        "latin1").strip().split("::")
+                    usr = USER_INFO[int(uid)]
+                    mov = MOVIE_INFO[int(mid)]
+                    yield usr.value() + mov.value() + [[float(score)]]
+    return reader
 
 
 def _ratings(seed, n):
@@ -25,9 +117,55 @@ def _ratings(seed, n):
     return reader
 
 
-def train():
-    return _ratings(40, 4000)
+def max_user_id(download=False):
+    archive = _archive(download)
+    if archive is None:
+        return NUM_USERS - 1
+    _init_meta(archive)
+    return max(USER_INFO)
 
 
-def test():
-    return _ratings(41, 800)
+def max_movie_id(download=False):
+    archive = _archive(download)
+    if archive is None:
+        return NUM_MOVIES - 1
+    _init_meta(archive)
+    return max(MOVIE_INFO)
+
+
+def max_job_id(download=False):
+    archive = _archive(download)
+    if archive is None:
+        return 20
+    _init_meta(archive)
+    return max(u.job_id for u in USER_INFO.values())
+
+
+def get_movie_title_dict(download=False):
+    archive = _archive(download)
+    if archive is None:
+        return {}
+    _init_meta(archive)
+    return MOVIE_TITLE_DICT
+
+
+def movie_categories(download=False):
+    archive = _archive(download)
+    if archive is None:
+        return {}
+    _init_meta(archive)
+    return CATEGORIES_DICT
+
+
+def train(download=False):
+    archive = _archive(download)
+    if archive is None:
+        return _ratings(40, 4000)
+    return _real_reader(archive, is_test=False)
+
+
+def test(download=False):
+    archive = _archive(download)
+    if archive is None:
+        return _ratings(41, 800)
+    return _real_reader(archive, is_test=True)

@@ -1,12 +1,48 @@
-"""UCI housing reader (reference: v2/dataset/uci_housing.py; synthetic
-linear data with fixed planted weights)."""
+"""UCI housing reader (reference: v2/dataset/uci_housing.py —
+whitespace-table parser with per-feature min/max/avg normalization and the
+80/20 train/test split; synthetic fallback for offline CI)."""
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
+from .common import cached_path
+
+URL = ("https://archive.ics.uci.edu/ml/machine-learning-databases/housing/"
+       "housing.data")
+MD5 = "d4accdce7a25600298819f8e28e8d593"
 FEATURES = 13
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS",
+                 "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
 _W = np.linspace(-2, 2, FEATURES).astype("float32")
 _B = 22.5
+
+
+def _data_file(do_download=False):
+    return cached_path(URL, "uci_housing", MD5, do_download)
+
+
+def load_data(filename, feature_num=14, ratio=0.8):
+    """Parse + normalize (uci_housing.py:61): x <- (x - avg) / (max - min),
+    then split 80/20."""
+    data = np.fromfile(filename, sep=" ").astype("float32")
+    data = data.reshape(-1, feature_num)
+    maximums = data.max(axis=0)
+    minimums = data.min(axis=0)
+    avgs = data.mean(axis=0)
+    for i in range(feature_num - 1):
+        data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+    offset = int(data.shape[0] * ratio)
+    return data[:offset], data[offset:]
+
+
+def _file_reader(rows):
+    def reader():
+        for row in rows:
+            yield row[:-1], float(row[-1])
+    return reader
 
 
 def _gen(seed, n):
@@ -19,9 +55,15 @@ def _gen(seed, n):
     return reader
 
 
-def train():
-    return _gen(50, 400)
+def train(download=False):
+    f = _data_file(download)
+    if f is None:
+        return _gen(50, 400)
+    return _file_reader(load_data(f)[0])
 
 
-def test():
-    return _gen(51, 100)
+def test(download=False):
+    f = _data_file(download)
+    if f is None:
+        return _gen(51, 100)
+    return _file_reader(load_data(f)[1])
