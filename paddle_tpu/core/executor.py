@@ -196,6 +196,16 @@ def run_op(op: Operator, env: Env, ctx: LoweringContext):
     ctx._op_uid += 1
     try:
         result = impl(ctx, ins, op.attrs)
+    except Exception as e:
+        # PADDLE_ENFORCE-style context (enforce.h): name the op and its
+        # operand shapes so a trace-time shape error points at the graph
+        # site, not just the jnp call inside the lowering
+        shapes = {slot: [getattr(v, "shape", None) for v in vals]
+                  for slot, vals in ins.items()}
+        e.add_note(
+            f"[paddle_tpu] while lowering op {op.type!r} "
+            f"(outputs {op.outputs}) with input shapes {shapes}")
+        raise
     finally:
         ctx.op, ctx.env = prev_op, prev_env
     outs = _normalize_outputs(op, result)
@@ -362,7 +372,9 @@ class Executor:
         sig = (id(program), program.version,
                tuple(sorted((n, a.shape, str(a.dtype))
                             for n, a in feed_arrays.items())),
-               tuple(fetch_names), tuple(sorted(state_keys)), is_test)
+               tuple(fetch_names), tuple(sorted(state_keys)), is_test,
+               self.check_nan_inf)   # the flag changes the compiled fn's
+        #                              output arity (finite-flags dict)
         entry = self._cache.get(sig)
         fn = None
         if entry is not None:
@@ -378,10 +390,17 @@ class Executor:
         self._step += 1
         fetches, new_state = fn(feed_arrays, state, step)
 
+        finite_map = None
+        if self.check_nan_inf and fetches and isinstance(fetches[-1], dict):
+            finite_map = fetches[-1]
+            fetches = fetches[:-1]
+
         for k, v in new_state.items():
             scope.set(k, v)
 
         if self.check_nan_inf:
+            if finite_map is not None:
+                self._nan_localize(program, finite_map)
             self._nan_check(fetch_names, fetches)
 
         if return_numpy:
@@ -452,6 +471,7 @@ class Executor:
              if v.persistable})
 
         amp = self.amp
+        check_nan = self.check_nan_inf
         has_backward = any(op.type == "backward"
                            for op in program.global_block().ops)
 
@@ -468,6 +488,17 @@ class Executor:
                                   amp=amp)
             interpret_block_with_backward(program.global_block(), env, ctx)
             fetches = [env.get(n) if env.has(n) else None for n in fetch_names]
+            if check_nan:
+                # per-VAR finite flags computed in-graph (one fused reduce
+                # per float var): the executor.cc:116-124 analog for the
+                # one-big-jit world — a NaN is localized to the op that
+                # produced it, not to the whole step (see _nan_localize)
+                finite = {
+                    k: jnp.all(jnp.isfinite(v))
+                    for k, v in env.local.items()
+                    if hasattr(v, "dtype") and
+                    jnp.issubdtype(v.dtype, jnp.floating)}
+                fetches = fetches + [finite]
             new_state = {k: env.get(k) for k in persistable_names
                          if env.has(k)}
             # AMP: persistable state keeps its incoming dtype (bn running
@@ -483,6 +514,31 @@ class Executor:
 
     def _nan_check(self, names, fetches):
         return _nan_check_impl(names, fetches)
+
+    @staticmethod
+    def _nan_localize(program: Program, finite_map):
+        """Raise naming the FIRST op (program order) whose output went
+        non-finite — the executor.cc:116-124 per-op check, recovered from
+        the in-graph flags without leaving the one-jit model."""
+        # ONE host transfer for all flags, not one blocking sync per var
+        finite_map = jax.device_get(finite_map)
+        bad = {n for n, flag in finite_map.items() if not bool(flag)}
+        if not bad:
+            return
+        for op in program.global_block().ops:
+            for slot, names in op.outputs.items():
+                for n in names:
+                    if n in bad:
+                        raise FloatingPointError(
+                            f"NaN/Inf first produced by op {op.type!r} in "
+                            f"var {n!r} (output slot {slot}; "
+                            f"check_nan_inf, executor.cc FLAGS_check_nan_inf"
+                            f" analog)")
+        # non-finite var with no producing op (e.g. a feed)
+        n = sorted(bad)[0]
+        raise FloatingPointError(
+            f"NaN/Inf detected in var {n!r} (not produced by any op — "
+            f"check the feed; check_nan_inf)")
 
     def close(self):
         self._cache.clear()

@@ -141,3 +141,42 @@ def test_program_roundtrip_keeps_parameters():
     prog = pt.default_main_program()
     restored = pt.Program.from_json(prog.to_json())
     assert len(restored.all_parameters()) == len(prog.all_parameters()) > 0
+
+
+def test_check_nan_inf_localizes_producing_op(rng):
+    """check_nan_inf names the op/var that FIRST produced the NaN (the
+    executor.cc:116-124 per-op check), not just a fetched output."""
+    import pytest
+    x = layers.data("x", shape=[4], dtype="float32")
+    h = layers.log(x)                  # NaN for negative input
+    out = layers.reduce_sum(layers.exp(h))
+    exe = pt.Executor(check_nan_inf=True)
+    # clean input passes
+    good = exe.run(pt.default_main_program(),
+                   feed={"x": np.ones((2, 4), "float32")},
+                   fetch_list=[out])
+    assert np.isfinite(good[0]).all()
+    with pytest.raises(FloatingPointError) as ei:
+        exe.run(pt.default_main_program(),
+                feed={"x": -np.ones((2, 4), "float32")},
+                fetch_list=[out])
+    msg = str(ei.value)
+    assert "log" in msg                # the producing op, not the fetch
+    assert "first produced" in msg
+
+
+def test_trace_error_names_offending_op():
+    """A trace-time shape error carries the op type and input shapes
+    (PADDLE_ENFORCE context, enforce.h analog)."""
+    import pytest
+    a = layers.data("a", shape=[4], dtype="float32")
+    b = layers.data("b", shape=[5], dtype="float32")
+    bad = layers.elementwise_add(a, b)      # 4 vs 5: trace-time error
+    exe = pt.Executor()
+    with pytest.raises(Exception) as ei:
+        exe.run(pt.default_main_program(),
+                feed={"a": np.ones((2, 4), "float32"),
+                      "b": np.ones((2, 5), "float32")},
+                fetch_list=[bad])
+    notes = getattr(ei.value, "__notes__", [])
+    assert any("elementwise_add" in n for n in notes), notes
