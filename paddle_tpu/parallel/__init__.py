@@ -24,6 +24,8 @@ from .embedding import sharded_lookup
 from . import pipeline
 from . import collective
 from . import embedding
+from . import moe
+from .moe import moe_ffn
 
 __all__ = [
     "MeshConfig", "get_mesh", "make_mesh", "mesh_guard",

@@ -1,0 +1,116 @@
+"""MoE layer tests (CPU mesh): top-k capacity routing semantics, and the
+expert-parallel all-to-all path (ep=4) matching the single-device MoE
+bit-for-bit."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import MeshConfig, make_mesh
+from paddle_tpu.parallel.moe import (load_balancing_loss, moe_dispatch,
+                                     moe_ffn)
+
+R = np.random.RandomState(3)
+
+
+def test_dispatch_topk_and_capacity():
+    """Hand-checkable routing: 3 tokens, 2 experts, capacity 1, top-1 —
+    the second token routed to a full expert is dropped."""
+    gates = jnp.asarray([[0.9, 0.1],
+                         [0.8, 0.2],
+                         [0.3, 0.7]], jnp.float32)
+    dispatch, combine = moe_dispatch(gates, capacity=1, top_k=1)
+    d = np.asarray(dispatch)
+    # token0 -> expert0 slot0; token1 dropped (expert0 full); token2 ->
+    # expert1 slot0
+    assert d[0, 0, 0] == 1 and d[2, 1, 0] == 1
+    assert d.sum() == 2 and d[1].sum() == 0
+    c = np.asarray(combine)
+    np.testing.assert_allclose(c[0, 0, 0], 0.9, rtol=1e-6)
+    np.testing.assert_allclose(c[2, 1, 0], 0.7, rtol=1e-6)
+
+
+def test_dispatch_top2_uses_two_experts():
+    gates = jnp.asarray([[0.6, 0.3, 0.1]], jnp.float32)
+    dispatch, combine = moe_dispatch(gates, capacity=2, top_k=2)
+    d = np.asarray(dispatch)
+    assert d[0, 0].sum() == 1 and d[0, 1].sum() == 1 and d[0, 2].sum() == 0
+    c = np.asarray(combine).sum(axis=2)[0]
+    np.testing.assert_allclose(c, [0.6, 0.3, 0.0], rtol=1e-6)
+
+
+def _params(E, D, H):
+    gate_w = jnp.asarray(R.randn(D, E).astype("float32") * 0.5)
+    w1 = jnp.asarray(R.randn(E, D, H).astype("float32") * 0.3)
+    w2 = jnp.asarray(R.randn(E, H, D).astype("float32") * 0.3)
+    return gate_w, w1, w2
+
+
+def test_expert_parallel_matches_single_device():
+    """ep=4 all-to-all MoE == single-device MoE on the same tokens: the
+    dispatch/FFN/combine pipeline survives the two device hops exactly."""
+    from jax.experimental.shard_map import shard_map
+
+    EP, E, D, H, T = 4, 4, 8, 16, 16
+    mesh = make_mesh(MeshConfig(ep=EP), devices=jax.devices()[:EP])
+    gate_w, w1, w2 = _params(E, D, H)
+    x = jnp.asarray(R.randn(T, D).astype("float32"))
+
+    ref_out, ref_aux = moe_ffn(x, gate_w, w1, w2, axis_name=None,
+                               top_k=2, capacity_factor=8.0)
+
+    def per_device(x, gate_w, w1, w2):
+        out, aux = moe_ffn(x, gate_w, w1, w2, axis_name="ep", top_k=2,
+                           capacity_factor=8.0)
+        return out, jax.lax.pmean(aux, "ep")
+
+    f = shard_map(per_device, mesh=mesh,
+                  in_specs=(P(), P(), P("ep"), P("ep")),
+                  out_specs=(P(), P()), check_rep=False)
+    out, aux = jax.jit(f)(x, gate_w, w1, w2)
+    # every device routed the SAME tokens (x replicated), so per-device
+    # output equals the single-device result
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-5)
+
+
+def test_moe_trains_and_balances():
+    """Gradients flow through dispatch/all_to_all/combine; training with the
+    aux loss reduces total loss on a learnable mixture task."""
+    from jax.experimental.shard_map import shard_map
+
+    EP, E, D, H, T = 4, 4, 8, 16, 32
+    mesh = make_mesh(MeshConfig(ep=EP), devices=jax.devices()[:EP])
+    gate_w, w1, w2 = _params(E, D, H)
+    x = jnp.asarray(R.randn(T, D).astype("float32"))
+    y = jnp.asarray(R.randn(T, D).astype("float32") * 0.1)
+
+    def loss_fn(params, x, y):
+        gate_w, w1, w2 = params
+
+        def per_device(x, y, gate_w, w1, w2):
+            out, aux = moe_ffn(x, gate_w, w1, w2, axis_name="ep",
+                               top_k=2, capacity_factor=4.0)
+            return (jnp.mean((out - y) ** 2) +
+                    0.01 * jax.lax.pmean(aux, "ep"))
+
+        f = shard_map(per_device, mesh=mesh,
+                      in_specs=(P(), P(), P(), P("ep"), P("ep")),
+                      out_specs=P(), check_rep=False)
+        return f(x, y, gate_w, w1, w2)
+
+    params = (gate_w, w1, w2)
+    step = jax.jit(jax.value_and_grad(loss_fn))
+    losses = []
+    for _ in range(25):
+        lv, g = step(params, x, y)
+        params = jax.tree.map(lambda p, gr: p - 0.1 * gr, params, g)
+        losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.9
+    # expert weights received non-zero gradients (all-to-all is in the
+    # gradient path)
+    _, gw1, _ = g
+    assert float(jnp.abs(gw1).sum()) > 0
