@@ -58,6 +58,8 @@ from . import metrics
 from . import io
 from .io import save_params, load_params, save_persistables, load_persistables, \
     save_inference_model, load_inference_model
+from . import export_model
+from .export_model import export_compiled_model, load_compiled_model
 from .data_feeder import DataFeeder
 from .param_attr import ParamAttr
 from . import profiler

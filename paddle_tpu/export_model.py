@@ -1,0 +1,131 @@
+"""AOT inference export: the C-deployment ABI analog.
+
+Reference capability: paddle/capi (gradient_machine.h:36-102) exposed
+trained models to C callers through a stable binary surface.  The TPU-native
+redesign exports the pruned inference program through ``jax.export`` as
+serialized **StableHLO** with the trained parameters baked in as constants:
+
+* one self-contained artifact (``model.stablehlo``) + a JSON manifest naming
+  inputs/outputs/shapes/dtypes — the calling convention a C/C++ host reads;
+* no Python framework needed at serve time beyond a StableHLO runner: the
+  artifact is what the PJRT C API (or IREE, or XLA's own loaded-executable
+  path) consumes, which is the modern equivalent of linking libpaddle_capi;
+* a leading batch dimension declared ``-1``/None exports SYMBOLIC ("b"), so
+  one artifact serves any batch size;
+* ``load_compiled_model`` gives the in-process Python binding to the same
+  artifact (deserialize + call), used here to round-trip-test the ABI.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .core.program import Program, Variable, default_main_program
+from .core.scope import global_scope
+
+__all__ = ["export_compiled_model", "load_compiled_model"]
+
+_ARTIFACT = "model.stablehlo"
+_MANIFEST = "manifest.json"
+
+
+def export_compiled_model(dirname: str,
+                          feed_specs: Dict[str, Tuple[Sequence[int], str]],
+                          target_vars,
+                          main_program: Optional[Program] = None,
+                          scope=None,
+                          platforms: Optional[List[str]] = None):
+    """Export the inference slice ending at ``target_vars`` as serialized
+    StableHLO with parameters embedded.
+
+    feed_specs: {feed_name: (shape, dtype)}; a None/-1 leading dim becomes
+    the symbolic batch "b".  platforms: lowering platforms (e.g. ["tpu",
+    "cpu"]); default is the current backend.
+    Returns the manifest dict.
+    """
+    import jax
+    from jax import export as jexport
+
+    from .core.executor import Executor
+
+    main_program = main_program or default_main_program()
+    scope = global_scope() if scope is None else scope
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    fetch_names = [t.name if isinstance(t, Variable) else str(t)
+                   for t in target_vars]
+    pruned = main_program.prune(target_vars).clone(for_test=True)
+
+    exe = Executor()
+    fn = exe._make_fn(pruned, fetch_names, is_test=True)
+    state_keys = exe._state_keys(pruned, scope)
+    state = {k: jax.numpy.asarray(scope.get(k)) for k in state_keys}
+
+    def infer(feeds):
+        fetches, _ = fn(feeds, state, np.int64(0))
+        return fetches
+
+    # argument specs: symbolic batch where the leading dim is dynamic —
+    # ONE scope shared by every input, so all the "b" dims are the same
+    # symbol (multi-input models would otherwise mix symbolic scopes)
+    args = {}
+    scopes = {}
+    sscope = jexport.SymbolicScope()
+    for name, (shape, dtype) in feed_specs.items():
+        shape = list(shape)
+        if shape and (shape[0] is None or shape[0] == -1):
+            dims = jexport.symbolic_shape(
+                "b, " + ", ".join(str(int(s)) for s in shape[1:])
+                if len(shape) > 1 else "b", scope=sscope)
+            args[name] = jax.ShapeDtypeStruct(dims, np.dtype(dtype))
+            scopes[name] = "b"
+        else:
+            args[name] = jax.ShapeDtypeStruct(
+                tuple(int(s) for s in shape), np.dtype(dtype))
+
+    kwargs = {}
+    if platforms:
+        kwargs["platforms"] = list(platforms)
+    exported = jexport.export(jax.jit(infer), **kwargs)(args)
+    blob = exported.serialize()
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, _ARTIFACT), "wb") as f:
+        f.write(blob)
+    manifest = {
+        "format": "jax.export/stablehlo",
+        "calling_convention_version":
+            int(exported.calling_convention_version),
+        "platforms": list(exported.platforms),
+        "inputs": {n: {"shape": [None if d in (None, -1) else int(d)
+                                 for d in feed_specs[n][0]],
+                       "dtype": str(np.dtype(feed_specs[n][1]))}
+                   for n in feed_specs},
+        "outputs": fetch_names,
+        "symbolic_batch": any(s == "b" for s in scopes.values()),
+    }
+    with open(os.path.join(dirname, _MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1)
+    return manifest
+
+
+def load_compiled_model(dirname: str):
+    """Load an exported artifact: returns (run, manifest) where
+    ``run({name: array}) -> [outputs]``.  This is the Python binding of the
+    ABI; a C host consumes the same ``model.stablehlo`` through PJRT."""
+    from jax import export as jexport
+
+    with open(os.path.join(dirname, _ARTIFACT), "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(os.path.join(dirname, _MANIFEST)) as f:
+        manifest = json.load(f)
+
+    def run(feeds: Dict[str, np.ndarray]):
+        import jax
+        feeds = {k: jax.numpy.asarray(v) for k, v in feeds.items()}
+        return exported.call(feeds)
+
+    return run, manifest

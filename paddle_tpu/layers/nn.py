@@ -393,9 +393,11 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
 
 
 def _prod(t):
+    # no int() cast: dims may be symbolic (jax.export shape polymorphism),
+    # same as ops/math_ops._prod
     p = 1
     for x in t:
-        p *= int(x)
+        p *= x
     return p
 
 

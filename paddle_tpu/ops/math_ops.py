@@ -66,9 +66,10 @@ def _mul(ctx, ins, attrs):
 
 
 def _prod(t):
+    # no int() cast: dims may be symbolic (jax.export shape polymorphism)
     p = 1
     for v in t:
-        p *= int(v)
+        p *= v
     return p
 
 
