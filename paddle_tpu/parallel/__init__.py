@@ -26,6 +26,8 @@ from . import collective
 from . import embedding
 from . import moe
 from .moe import moe_ffn
+from . import local_sgd
+from .local_sgd import make_local_sgd_step
 
 __all__ = [
     "MeshConfig", "get_mesh", "make_mesh", "mesh_guard",
