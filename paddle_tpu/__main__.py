@@ -1,0 +1,6 @@
+"""``python -m paddle_tpu`` — the ``paddle train`` CLI (see cli.py)."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,227 @@
+"""Trainer CLI: the ``paddle train`` analog (reference:
+trainer/TrainerMain.cpp — FLAGS_job one of train/test/checkgrad/time,
+trainer.init(config) + ParamUtil save/load).
+
+``python -m paddle_tpu --config=conf.py --job=train`` evaluates a v1 config
+file verbatim (trainer_config_helpers DSL), builds the optimizer from its
+settings(), and runs the requested job on the TPU runtime:
+
+  train      steps over feeds, prints per-pass loss, saves params
+  test       loads params, evaluates the config outputs on feeds
+  time       TrainerMain's timing job: warmup + timed steps, ms/batch
+  checkgrad  numeric-vs-autodiff gradient check on the config's cost
+
+Feeds come from ``--feed-npz`` (named arrays matching the config's data
+layers, with ``name@LEN`` companions for sequences); ``time`` and
+``checkgrad`` synthesize random feeds from the declared shapes when none
+are given (the reference's fake-data provider role).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _parse_config_args(s: Optional[str]) -> Dict[str, str]:
+    if not s:
+        return {}
+    out = {}
+    for kv in s.split(","):
+        k, _, v = kv.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def _load_feeds(path: Optional[str]):
+    if not path:
+        return None
+    data = np.load(path, allow_pickle=False)
+    return {k: data[k] for k in data.files}
+
+
+def _synth_feeds(cfg, batch: int, seed: int = 0):
+    """Random feeds shaped from the config's data layers (the fake-data
+    provider TrainerMain's time job leaned on)."""
+    rng = np.random.RandomState(seed)
+    feeds = {}
+    for name, v in cfg.data_layers.items():
+        if v.dtype == np.dtype("int64"):
+            vocab = getattr(v, "v1_size", None) or 2
+            if v.lod_level:
+                T = 12
+                feeds[name] = rng.randint(0, vocab, (batch, T))
+                feeds[name + "@LEN"] = np.full(batch, T)
+            else:
+                # label-style: v1 size is the number of classes
+                feeds[name] = rng.randint(0, max(vocab, 2), (batch, 1))
+        else:
+            dims = [int(d) for d in (v.shape or (1,))[1:] if d and d > 0]
+            feeds[name] = rng.rand(batch, *dims).astype("float32")
+    return feeds
+
+
+def _used_feed_names(cfg):
+    """Data layers actually consumed by ops (a config may declare inputs
+    the network never reads, e.g. rnn_crf's 'features')."""
+    used = set()
+    for op in cfg.main_program.global_block().ops:
+        for names in op.inputs.values():
+            used.update(names)
+    out = set()
+    for n in cfg.data_layers:
+        if n in used:
+            out.add(n)
+            out.add(n + "@LEN")
+    return out
+
+
+def job_train(cfg, exe, feeds, args):
+    import paddle_tpu as pt
+
+    loss = cfg.minimize_outputs()
+    exe.run(cfg.startup_program, feed={}, fetch_list=[])
+    if args.init_model_path:
+        pt.load_persistables(exe, args.init_model_path, cfg.main_program)
+    steps = args.steps_per_pass
+    for p in range(args.num_passes):
+        vals = [float(exe.run(cfg.main_program, feed=feeds,
+                              fetch_list=[loss])[0])
+                for _ in range(steps)]
+        print(json.dumps({"pass": p, "loss": vals[-1],
+                          "mean_loss": float(np.mean(vals))}), flush=True)
+        if args.save_dir:
+            d = os.path.join(args.save_dir, f"pass-{p:05d}")
+            os.makedirs(d, exist_ok=True)
+            pt.save_persistables(exe, d, cfg.main_program)
+    return 0
+
+
+def job_test(cfg, exe, feeds, args):
+    import paddle_tpu as pt
+
+    exe.run(cfg.startup_program, feed={}, fetch_list=[])
+    if args.init_model_path:
+        pt.load_persistables(exe, args.init_model_path, cfg.main_program)
+    outs = exe.run(cfg.main_program, feed=feeds, fetch_list=cfg.outputs,
+                   is_test=True)
+    for var, val in zip(cfg.outputs, outs):
+        name = getattr(var, "name", str(var))
+        print(json.dumps({"output": name,
+                          "mean": float(np.mean(val)),
+                          "shape": list(np.shape(val))}), flush=True)
+    return 0
+
+
+def job_time(cfg, exe, feeds, args):
+    cfg.minimize_outputs()
+    loss = cfg.outputs[0]
+    exe.run(cfg.startup_program, feed={}, fetch_list=[])
+    for _ in range(args.warmup):
+        exe.run(cfg.main_program, feed=feeds, fetch_list=[loss])
+    t0 = time.perf_counter()
+    for _ in range(args.iters - 1):
+        exe.run(cfg.main_program, feed=feeds, fetch_list=[],
+                return_numpy=False)
+    (lv,) = exe.run(cfg.main_program, feed=feeds, fetch_list=[loss])
+    assert np.isfinite(float(lv))
+    dt = (time.perf_counter() - t0) / args.iters
+    print(json.dumps({"ms_per_batch": round(dt * 1e3, 3),
+                      "batches_per_sec": round(1.0 / dt, 2)}), flush=True)
+    return 0
+
+
+def job_checkgrad(cfg, exe, feeds, args, eps=1e-3, rtol=5e-2):
+    """Central-difference vs autodiff on the config's cost (Trainer::
+    checkGradient): perturb a few elements of the first parameters.
+    Backward ONLY — no optimizer ops, so probe runs don't move the
+    weights they are probing."""
+    import paddle_tpu as pt
+    from paddle_tpu.backward import append_backward
+    from paddle_tpu.core.program import grad_var_name, program_guard
+
+    loss = cfg.outputs[0]
+    with program_guard(cfg.main_program, cfg.startup_program):
+        append_backward(loss)
+    exe.run(cfg.startup_program, feed={}, fetch_list=[])
+    scope = pt.global_scope()
+    params = [v.name for v in
+              cfg.main_program.global_block().vars.values()
+              if v.persistable and scope.has(v.name) and
+              np.asarray(scope.get(v.name)).dtype == np.float32][:3]
+    failures = 0
+    rng = np.random.RandomState(0)
+    for pname in params:
+        g, = exe.run(cfg.main_program, feed=feeds,
+                     fetch_list=[grad_var_name(pname)])
+        w0 = np.array(scope.get(pname))
+        flat = w0.ravel()
+        for idx in rng.choice(flat.size, size=min(3, flat.size),
+                              replace=False):
+            for sign, store in ((+1, "hi"), (-1, "lo")):
+                w = flat.copy()
+                w[idx] += sign * eps
+                scope.set(pname, w.reshape(w0.shape))
+                val = float(exe.run(cfg.main_program, feed=feeds,
+                                    fetch_list=[loss], is_test=False)[0])
+                if store == "hi":
+                    hi = val
+                else:
+                    lo = val
+            scope.set(pname, w0)
+            num = (hi - lo) / (2 * eps)
+            ana = float(np.asarray(g).ravel()[idx])
+            ok = abs(num - ana) <= rtol * max(1.0, abs(num), abs(ana))
+            if not ok:
+                failures += 1
+            print(json.dumps({"param": pname, "index": int(idx),
+                              "numeric": num, "autodiff": ana,
+                              "ok": bool(ok)}), flush=True)
+    print(json.dumps({"checkgrad": "PASS" if failures == 0 else "FAIL",
+                      "failures": failures}), flush=True)
+    return 0 if failures == 0 else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu",
+        description="TrainerMain analog: run a v1 config on the TPU runtime")
+    ap.add_argument("--config", required=True, help="v1 config file")
+    ap.add_argument("--job", default="train",
+                    choices=["train", "test", "time", "checkgrad"])
+    ap.add_argument("--config_args", default=None,
+                    help="k=v,... forwarded to get_config_arg")
+    ap.add_argument("--feed-npz", default=None,
+                    help="npz of named feed arrays (+ name@LEN)")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="synthetic-feed batch (default: settings batch)")
+    ap.add_argument("--num_passes", type=int, default=1)
+    ap.add_argument("--steps_per_pass", type=int, default=10)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--save_dir", default=None)
+    ap.add_argument("--init_model_path", default=None)
+    ap.add_argument("--use_amp", action="store_true")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as pt
+    from paddle_tpu.trainer_config_helpers import load_v1_config
+
+    cfg = load_v1_config(args.config, **_parse_config_args(args.config_args))
+    batch = args.batch or cfg.settings.get("batch_size") or 16
+    feeds = _load_feeds(args.feed_npz) or _synth_feeds(cfg, batch)
+    used = _used_feed_names(cfg)
+    feeds = {k: v for k, v in feeds.items() if k in used}
+    exe = pt.Executor(amp=args.use_amp)
+    job = {"train": job_train, "test": job_test, "time": job_time,
+           "checkgrad": job_checkgrad}[args.job]
+    return job(cfg, exe, feeds, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
