@@ -164,10 +164,13 @@ class MasterServer:
                         req = json.loads(line)
                         resp = {"result": outer._dispatch(
                             req.get("method"), req.get("params") or {})}
+                        payload = json.dumps(resp)
                     except Exception as e:  # noqa: BLE001 — report to client
-                        resp = {"error": f"{type(e).__name__}: {e}"}
-                    self.wfile.write(
-                        (json.dumps(resp) + "\n").encode())
+                        # includes result-serialization failures (chunks
+                        # must be JSON-encodable: paths/ids, not payloads)
+                        payload = json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"})
+                    self.wfile.write((payload + "\n").encode())
                     self.wfile.flush()
 
         class Server(socketserver.ThreadingTCPServer):

@@ -175,3 +175,91 @@ class _SGDShim(_trainer_mod.SGD):
 
 trainer = _types.SimpleNamespace(SGD=_SGDShim)
 event = events
+
+
+# -- paddle.v2.master (Go master client analog) ------------------------------
+class _MasterClientShim:
+    """v2 master.client(addr_or_etcd, buf_size): consume dataset task chunks
+    from the (TCP) task-queue master — reference python/paddle/v2/master/
+    client.py over the Go service; here over distributed.master's JSON-RPC
+    server."""
+
+    def __init__(self, addr, buf_size=100, etcd_endpoints=None, **kw):
+        from .distributed.master import MasterClient
+        self._c = MasterClient(addr)
+        self.buf_size = buf_size
+
+    def set_dataset(self, paths):
+        self._c.set_dataset(list(paths))
+
+    def next_record(self):
+        """Iterate records across master-handed chunks (a chunk is any
+        iterable of records; file paths are read line-wise).  An empty todo
+        queue with tasks still PENDING on other trainers is not the end:
+        a crashed peer's lease may lapse and requeue its task here."""
+        import time as _time
+        while True:
+            t = self._c.get_task()
+            if t is None:
+                st = self._c.stats()
+                if st["pending"] > 0:
+                    _time.sleep(0.2)   # a peer's lease may still lapse
+                    continue
+                return
+            try:
+                for chunk in t.chunks:
+                    if isinstance(chunk, str):
+                        with open(chunk, "rb") as f:
+                            yield from f
+                    elif isinstance(chunk, (list, tuple)):
+                        yield from chunk
+                    else:
+                        yield chunk
+            except Exception:
+                self._c.task_failed(t.task_id)
+                continue
+            self._c.task_finished(t.task_id)
+
+    def reader(self):
+        def _r():
+            yield from self.next_record()
+        return _r
+
+    def close(self):
+        self._c.close()
+
+
+master = _types.SimpleNamespace(client=_MasterClientShim)
+
+
+# -- paddle.v2.topology ------------------------------------------------------
+class Topology:
+    """v2 Topology(cost) facade: the serializable network description
+    (reference python/paddle/v2/topology.py wraps the TrainerConfig proto;
+    here the Program IR serializes as JSON)."""
+
+    def __init__(self, layers_or_cost, extra_layers=None):
+        from .core.program import default_main_program, default_startup_program
+        outs = layers_or_cost if isinstance(layers_or_cost, (list, tuple)) \
+            else [layers_or_cost]
+        self.outputs = list(outs)
+        self.main_program = outs[0].block.program if hasattr(
+            outs[0], "block") else default_main_program()
+        self.startup_program = default_startup_program()
+
+    def serialize(self):
+        import json as _json
+        return _json.dumps(self.main_program.to_dict())
+
+    def data_layers(self):
+        return {v.name: v for b in self.main_program.blocks
+                for v in b.vars.values() if getattr(v, "is_data", False)}
+
+    def get_layer(self, name):
+        for b in self.main_program.blocks:
+            if name in b.vars:
+                return b.vars[name]
+        return None
+
+
+topology = _types.SimpleNamespace(Topology=Topology)

@@ -193,3 +193,31 @@ def test_trainer_with_uci_housing(rng):
                   if isinstance(e, pt.trainer.events.EndIteration) else None,
                   feed_list=[x, y])
     assert costs[-1] < costs[0] * 0.1
+
+
+def test_v2_master_client_and_topology(tmp_path):
+    """v2 master.client consumes dataset chunks over the TCP master; v2
+    Topology serializes the network (reference: v2/master/client.py,
+    v2/topology.py)."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.distributed.master import Master, MasterServer
+
+    m = Master(chunks_per_task=1, timeout_s=30.0)
+    m.set_dataset([["r1", "r2"], ["r3"]])
+    srv = MasterServer(m).start()
+    try:
+        c = paddle.master.client(srv.address)
+        got = sorted(r for r in c.next_record()
+                     if not isinstance(r, (bytes,)))
+        assert got == ["r1", "r2", "r3"]
+        c.close()
+    finally:
+        srv.stop()
+
+    images = paddle.layer.data(name="px", size=16)
+    out = paddle.layer.fc(input=images, size=4,
+                          act=paddle.activation.Softmax())
+    topo = paddle.topology.Topology(out)
+    blob = topo.serialize()
+    assert "px" in blob and topo.get_layer("px") is not None
+    assert "px" in topo.data_layers()
