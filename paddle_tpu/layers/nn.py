@@ -481,10 +481,17 @@ def concat(input, axis=0, name=None):
                 break
             tot += v.shape[axis]
         shape[axis] = tot if ok else -1
+    # a feature-axis concat of sequences is still a sequence: keep the LoD
+    # metadata and thread the @LEN companion through
+    lod = max(getattr(v, "lod_level", 0) for v in input)
     out = helper.create_variable_for_type_inference(
-        input[0].dtype, tuple(shape) if shape else None)
+        input[0].dtype, tuple(shape) if shape else None,
+        lod_level=lod if axis != 0 else 0)
     helper.append_op(type="concat", inputs={"X": input},
                      outputs={"Out": [out]}, attrs={"axis": axis})
+    if out.lod_level:
+        src = next(v for v in input if getattr(v, "lod_level", 0))
+        _copy_len(helper, src, out)
     return out
 
 

@@ -12,7 +12,8 @@ from __future__ import annotations
 from . import layers
 
 __all__ = ["exponential_decay", "natural_exp_decay", "inverse_time_decay",
-           "polynomial_decay", "piecewise_decay", "noam_decay"]
+           "polynomial_decay", "piecewise_decay", "noam_decay",
+           "v1_poly_decay"]
 
 
 def _global_step_f32():
@@ -102,3 +103,16 @@ def noam_decay(d_model, warmup_steps, learning_rate=1.0):
     b = layers.scale(gs, scale=warmup_steps ** -1.5)
     return layers.scale(layers.elementwise_min(a, b),
                         scale=float(learning_rate) * d_model ** -0.5)
+
+
+def v1_poly_decay(learning_rate, decay_a, decay_b, batch_size=1):
+    """v1 default schedule (parameter/LearningRateScheduler.cpp:56):
+    lr * (1 + decay_a * num_samples)^-decay_b, with num_samples advancing
+    by batch_size per step (settings(learning_rate_decay_a/b))."""
+    gs = _global_step_f32()
+    samples = layers.scale(gs, scale=float(batch_size))
+    base = layers.scale(samples, scale=float(decay_a), bias=1.0)
+    # base^-b == exp(-b * log(base))
+    return layers.scale(
+        layers.exp(layers.scale(layers.log(base), scale=-float(decay_b))),
+        scale=float(learning_rate))

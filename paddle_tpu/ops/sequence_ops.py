@@ -49,6 +49,8 @@ def _sequence_pool(ctx, ins, attrs):
     x = ins["X"][0]                      # [B, T, ...]
     lens = _seq_lens_or_full(ctx, x)
     ptype = attrs.get("pooltype", attrs.get("pool_type", "AVERAGE")).upper()
+    if ptype == "AVG":                 # v1 AvgPooling spelling
+        ptype = "AVERAGE"
     T = x.shape[1]
     m = _mask(lens, T, x.dtype).reshape((x.shape[0], T) + (1,) * (x.ndim - 2))
     if ptype == "SUM":

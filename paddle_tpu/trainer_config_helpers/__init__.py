@@ -60,6 +60,8 @@ __all__ = [
     "crf_layer", "crf_decoding_layer",
     "sum_evaluator", "chunk_evaluator", "seqtext_printer_evaluator",
     "classification_error_evaluator",
+    "maxid_layer", "pooling_layer", "sequence_conv_pool",
+    "bidirectional_lstm",
 ]
 
 
@@ -508,7 +510,8 @@ from .sequence import (  # noqa: E402
     dotmul_projection, scaling_projection, recurrent_layer, lstmemory_group,
     grumemory, gru_group, simple_gru, beam_search, crf_layer,
     crf_decoding_layer, sum_evaluator, chunk_evaluator,
-    seqtext_printer_evaluator, classification_error_evaluator, track_layer)
+    seqtext_printer_evaluator, classification_error_evaluator, track_layer,
+    maxid_layer, pooling_layer, sequence_conv_pool, bidirectional_lstm)
 
 
 # ---------------------------------------------------------------------------
@@ -533,6 +536,14 @@ class V1Config:
     def make_optimizer(self):
         s = self.settings
         lr = s.get("learning_rate", 1e-3)
+        decay_a = s.get("learning_rate_decay_a") or 0.0
+        decay_b = s.get("learning_rate_decay_b") or 0.0
+        if decay_a and decay_b:
+            # v1 default LR schedule; builds on the step counter inside the
+            # current program (make_optimizer runs under program_guard)
+            from .. import lr_decay
+            lr = lr_decay.v1_poly_decay(lr, decay_a, decay_b,
+                                        s.get("batch_size") or 1)
         reg_obj = s.get("regularization")
         reg = reg_obj.make() if reg_obj is not None else None
         method = s.get("learning_method")

@@ -37,6 +37,8 @@ __all__ = [
     "crf_layer", "crf_decoding_layer",
     "sum_evaluator", "chunk_evaluator", "seqtext_printer_evaluator",
     "classification_error_evaluator",
+    "maxid_layer", "pooling_layer", "sequence_conv_pool",
+    "bidirectional_lstm",
 ]
 
 
@@ -513,3 +515,65 @@ def seqtext_printer_evaluator(input, result_file=None, id_input=None,
     _record_evaluator("seqtext_printer", name=name, input=input,
                       id_input=id_input, dict_file=dict_file,
                       result_file=result_file)
+
+
+# ---------------------------------------------------------------------------
+# quick_start-surface helpers (layers.py maxid/pooling; networks.py
+# sequence_conv_pool / bidirectional_lstm)
+# ---------------------------------------------------------------------------
+def maxid_layer(input, name=None, **kw):
+    """v1 maxid (layers.py:1537): per-row argmax id."""
+    out = L.argmax(input, axis=-1)
+    return track_layer(name, out)
+
+
+def pooling_layer(input, pooling_type=None, name=None, **kw):
+    """v1 pooling over a sequence (layers.py:1700); default max."""
+    ptype = pooling_type.ptype if pooling_type is not None else "max"
+    out = L.sequence_pool(input, ptype)
+    return track_layer(name, out)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, name=None,
+                       context_start=None, pool_type=None, fc_act=None,
+                       context_proj_param_attr=None, fc_param_attr=None,
+                       **kw):
+    """networks.py:312 text_conv_pool/sequence_conv_pool: context window
+    conv + max pool over time.  ``fc_act`` defaults to Tanh like the
+    reference's @wrap_act_default."""
+    from . import _act_name
+    from .. import nets
+    out = nets.sequence_conv_pool(
+        input, num_filters=hidden_size, filter_size=context_len,
+        act=_act_name(fc_act) or "tanh",
+        pool_type=(pool_type.ptype if pool_type is not None else "max"),
+        param_attr=fc_param_attr)
+    return track_layer(name, out)
+
+
+def bidirectional_lstm(input, size, name=None, return_seq=False,
+                       fwd_act=None, fwd_gate_act=None, fwd_state_act=None,
+                       bwd_act=None, bwd_gate_act=None, bwd_state_act=None,
+                       **kw):
+    """networks.py:1310: forward + backward LSTM over the sequence;
+    concat of last/first states (or the full sequences with
+    return_seq=True)."""
+    from . import _act_name
+    fwd_proj = L.fc(input, size=size * 4, num_flatten_dims=2)
+    fwd, _ = L.dynamic_lstm(
+        fwd_proj, size=size * 4,
+        gate_activation=_act_name(fwd_gate_act) or "sigmoid",
+        cell_activation=_act_name(fwd_state_act) or "tanh",
+        candidate_activation=_act_name(fwd_act) or "tanh")
+    bwd_proj = L.fc(input, size=size * 4, num_flatten_dims=2)
+    bwd, _ = L.dynamic_lstm(
+        bwd_proj, size=size * 4, is_reverse=True,
+        gate_activation=_act_name(bwd_gate_act) or "sigmoid",
+        cell_activation=_act_name(bwd_state_act) or "tanh",
+        candidate_activation=_act_name(bwd_act) or "tanh")
+    if return_seq:
+        out = L.concat([fwd, bwd], axis=-1)   # concat threads the @LEN
+    else:
+        out = L.concat([L.sequence_last_step(fwd),
+                        L.sequence_first_step(bwd)], axis=-1)
+    return track_layer(name, out)

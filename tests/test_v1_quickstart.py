@@ -1,0 +1,58 @@
+"""quick_start demo configs (v1_api_demo/quick_start/trainer_config.*.py)
+evaluated VERBATIM and trained: logistic regression, embedding+pooling,
+sequence-conv text CNN, LSTM, bidirectional LSTM — the sentiment pipeline
+the v1 tutorial shipped."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.trainer_config_helpers import load_v1_config
+
+QS = "/root/reference/v1_api_demo/quick_start"
+VOCAB = 200
+
+
+@pytest.fixture()
+def qs_cwd(tmp_path, monkeypatch):
+    """The configs hardcode ./data/dict.txt at evaluation time."""
+    (tmp_path / "data").mkdir()
+    with open(tmp_path / "data" / "dict.txt", "w") as f:
+        for i in range(VOCAB):
+            f.write(f"word{i}\t{i}\n")
+    monkeypatch.chdir(tmp_path)
+    return str(tmp_path / "data" / "dict.txt")
+
+
+def _train(cfg, feeds, n=6):
+    loss = cfg.minimize_outputs()
+    exe = pt.Executor()
+    exe.run(cfg.startup_program, feed={}, fetch_list=[])
+    vals = [float(exe.run(cfg.main_program, feed=feeds,
+                          fetch_list=[loss])[0]) for _ in range(n)]
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0]
+    return vals
+
+
+def _seq_feeds(rng):
+    return {"word": rng.randint(0, VOCAB, (8, 12)),
+            "word@LEN": np.full(8, 12),
+            "label": rng.randint(0, 2, (8, 1))}
+
+
+def test_quickstart_lr(qs_cwd, rng):
+    cfg = load_v1_config(os.path.join(QS, "trainer_config.lr.py"),
+                         dict_file=qs_cwd)
+    _train(cfg, {"word": rng.rand(8, VOCAB).astype("float32"),
+                 "label": rng.randint(0, 2, (8, 1))})
+
+
+@pytest.mark.parametrize("conf", ["trainer_config.emb.py",
+                                  "trainer_config.cnn.py",
+                                  "trainer_config.lstm.py",
+                                  "trainer_config.bidi-lstm.py"])
+def test_quickstart_sequence_configs(qs_cwd, rng, conf):
+    cfg = load_v1_config(os.path.join(QS, conf), dict_file=qs_cwd)
+    _train(cfg, _seq_feeds(rng))

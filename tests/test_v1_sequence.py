@@ -171,3 +171,19 @@ def test_recurrent_group_matches_manual_scan(rng):
     for t in range(T):
         h = np.tanh(xv[:, t] @ w1 + h @ w2 + b)
         np.testing.assert_allclose(ov[:, t], h, rtol=2e-5, atol=2e-5)
+
+
+def test_v1_lr_decay_schedule(rng):
+    """settings(learning_rate_decay_a/b) applies the v1 poly schedule:
+    lr_t = lr * (1 + a*batch*t)^-b (LearningRateScheduler.cpp:56)."""
+    from paddle_tpu import lr_decay
+    import paddle_tpu.layers as L
+
+    lr_var = lr_decay.v1_poly_decay(0.1, decay_a=0.5, decay_b=0.75,
+                                    batch_size=4)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    got = [float(exe.run(pt.default_main_program(), feed={},
+                         fetch_list=[lr_var])[0]) for _ in range(4)]
+    want = [0.1 * (1 + 0.5 * 4 * t) ** -0.75 for t in range(4)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
