@@ -316,3 +316,91 @@ Ftrl = FtrlOptimizer
 from .lr_decay import (exponential_decay, natural_exp_decay,        # noqa: E402,F401
                        inverse_time_decay, polynomial_decay,
                        piecewise_decay, noam_decay)
+
+
+class ModelAverage:
+    """Sliding-window parameter averaging (reference: fluid
+    optimizer.ModelAverage / v1 settings(model_average=ModelAverage(...)),
+    trainer/ParameterUpdater averaging mode).
+
+    Host-side accumulator over trainable fp32 parameters: call ``update()``
+    once per step after ``Executor.run``; evaluate under ``apply()`` to
+    swap the averaged weights in (restored on exit)::
+
+        ma = ModelAverage(average_window_rate=0.5)
+        for step in ...:
+            exe.run(...)
+            ma.update()
+        with ma.apply():
+            test_loss = exe.run(test_program, ...)
+
+    The window grows with training up to ``max_average_window`` steps
+    (v1's do_average_in_cpu path — averaging lives on host, off the MXU).
+    """
+
+    def __init__(self, average_window_rate=0.5, min_average_window=2,
+                 max_average_window=10000, scope=None, var_names=None):
+        from .core.scope import global_scope
+        self.rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        self._scope = scope or global_scope()
+        self._names = var_names
+        self._avg = {}
+        self._steps = 0
+        self._backup = None
+
+    def _tracked(self):
+        import numpy as np
+        if self._names is None:
+            # PARAMETERS only (not optimizer accumulators / LR vars), like
+            # the reference's updater; dtype read off the array metadata —
+            # no device-to-host transfer here (update() runs every step)
+            from .core.program import default_main_program
+            params = {p.name for p in
+                      default_main_program().global_block().all_parameters()}
+            self._names = [
+                n for n in self._scope.keys()
+                if n in params and
+                np.dtype(getattr(self._scope.get(n), "dtype", np.int32)) ==
+                np.float32]
+        return self._names
+
+    def update(self):
+        import numpy as np
+        self._steps += 1
+        window = min(self._steps,
+                     max(self.min_window,
+                         int(self.rate * min(self._steps,
+                                             self.max_window)) or 1))
+        for n in self._tracked():
+            v = np.asarray(self._scope.get(n), dtype=np.float32)
+            if n not in self._avg:
+                self._avg[n] = v.copy()
+            else:
+                self._avg[n] += (v - self._avg[n]) / window
+
+    def apply(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            import jax.numpy as jnp
+            import numpy as np
+            self._backup = {n: np.asarray(self._scope.get(n)).copy()
+                            for n in self._avg}
+            for n, v in self._avg.items():
+                self._scope.set(n, jnp.asarray(v))
+            try:
+                yield self
+            finally:
+                self.restore()
+        return _ctx()
+
+    def restore(self):
+        import jax.numpy as jnp
+        if self._backup is None:
+            return
+        for n, v in self._backup.items():
+            self._scope.set(n, jnp.asarray(v))
+        self._backup = None

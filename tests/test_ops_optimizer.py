@@ -142,3 +142,37 @@ def test_full_optimizer_builders_train():
                 for _ in range(4)]
         assert np.isfinite(vals).all(), name
         assert vals[-1] < vals[0], f"{name} did not reduce loss: {vals}"
+
+
+def test_model_average_apply_restore(rng):
+    """ModelAverage swaps averaged weights in for evaluation and restores
+    the live ones after (fluid optimizer.ModelAverage / v1 settings
+    model_average)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.optimizer import ModelAverage
+
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.fc(x, size=1, bias_attr=False,
+                  param_attr=pt.ParamAttr(name="w"))
+    loss = layers.mean(layers.square_error_cost(
+        y, layers.data("t", shape=[1], dtype="float32")))
+    pt.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    ma = ModelAverage(average_window_rate=1.0, var_names=["w"])
+    ws = []
+    feeds = {"x": rng.rand(8, 4).astype("float32"),
+             "t": rng.rand(8, 1).astype("float32")}
+    for _ in range(5):
+        exe.run(pt.default_main_program(), feed=feeds, fetch_list=[loss])
+        ws.append(np.asarray(pt.global_scope().get("w")).copy())
+        ma.update()
+    live = np.asarray(pt.global_scope().get("w")).copy()
+    with ma.apply():
+        inside = np.asarray(pt.global_scope().get("w")).copy()
+        assert not np.allclose(inside, live)      # averaged, not live
+        # running mean with growing window tracks the weight trajectory
+        assert np.isfinite(inside).all()
+    after = np.asarray(pt.global_scope().get("w"))
+    np.testing.assert_array_equal(after, live)    # restored
