@@ -168,6 +168,15 @@ class LoweringContext:
         """Emit the sequence-length companion for an output var."""
         self.env.local[name + "@LEN"] = lens
 
+    def get_len2(self, name: str):
+        """Inner-sequence lengths [B, S] of a lod_level-2 var, or None
+        (nested sequences: [B, S, T, ...] padded, the LoD level-2 analog)."""
+        ln = name + "@LEN2"
+        return self.env.get(ln) if self.env.has(ln) else None
+
+    def set_len2(self, name: str, lens2):
+        self.env.local[name + "@LEN2"] = lens2
+
 
 # ---------------------------------------------------------------------------
 # Interpreter

@@ -959,7 +959,13 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
 
 def sequence_pool(input, pool_type, name=None):
     helper = LayerHelper("sequence_pool", name=name)
-    shape = (input.shape[0],) + tuple(input.shape[2:]) if input.shape else None
+    if input.shape is None:
+        shape = None
+    elif input.lod_level >= 2:
+        # nested sequence [B, S, T, ...]: pooling collapses both seq dims
+        shape = (input.shape[0],) + tuple(input.shape[3:])
+    else:
+        shape = (input.shape[0],) + tuple(input.shape[2:])
     out = helper.create_variable_for_type_inference(input.dtype, shape)
     helper.append_op(type="sequence_pool", inputs={"X": [input]},
                      outputs={"Out": [out]},

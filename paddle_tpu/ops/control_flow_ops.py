@@ -213,14 +213,31 @@ def _rnn(ctx, ins, attrs):
     step_mask = (jnp.arange(T)[None, :] < lens[:, None]).astype(
         seqs[0].dtype).T                          # [T, B]
     xs = [jnp.swapaxes(s, 0, 1) for s in seqs]    # time-major
+    # NESTED sequences: an input [B, S, T', ...] with an @LEN2 companion
+    # [B, S] is a sequence OF sequences — each outer step's slice is itself
+    # a padded sequence, so the inner lengths scan along and land in the
+    # step env as the slice's @LEN (the LoD level-2 analog)
+    nested_names = []
+    nested_l2 = []                                       # [B, S] each
+    nested_scan = []
+    for step_nm, parent_nm in zip(step_in_names, seq_parent_names):
+        l2 = ctx.get_len2(parent_nm)
+        if l2 is not None:
+            nested_names.append(step_nm)
+            nested_l2.append(l2)
+            nested_scan.append(jnp.swapaxes(l2, 0, 1))   # [S, B]
 
     def step(carry, inp):
         mems = carry
         m_t = inp[0]
-        slices = inp[1:]
+        n_seq = len(step_in_names)
+        slices = inp[1:1 + n_seq]
+        l2_slices = inp[1 + n_seq:]
         benv = ctx.child_env(sub_idx, env)
         for nm, v in zip(step_in_names, slices):
             benv.local[nm] = v
+        for nm, l2 in zip(nested_names, l2_slices):
+            benv.local[nm + "@LEN"] = l2
         for nm, v in zip(mem_names, mems):
             benv.local[nm] = v
         ctx.interpret_block(sub_idx, benv)
@@ -233,10 +250,19 @@ def _rnn(ctx, ins, attrs):
         return new_mems, outs
 
     init_mems = tuple(inits)
-    _, outs = lax.scan(step, init_mems, tuple([step_mask] + xs))
+    _, outs = lax.scan(step, init_mems,
+                       tuple([step_mask] + xs + nested_scan))
     results = [jnp.swapaxes(o, 0, 1) for o in outs]
-    for nm in ctx.op.outputs.get("Outputs", []):
+    sub_vars = ctx.block(sub_idx).vars
+    for nm, step_nm in zip(ctx.op.outputs.get("Outputs", []),
+                           out_step_names):
         ctx.set_len(nm, lens)
+        # a stacked output is a sequence OF sequences only when the step
+        # output was itself a sequence (e.g. the inner group's output);
+        # per-step vectors stack to [B, S, H] and must NOT carry @LEN2
+        sv = sub_vars.get(step_nm)
+        if nested_l2 and sv is not None and sv.lod_level >= 1:
+            ctx.set_len2(nm, nested_l2[0])
     return {"Outputs": results}
 
 

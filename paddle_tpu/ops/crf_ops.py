@@ -272,9 +272,13 @@ def _chunk_eval(ctx, ins, attrs):
 
 @register_op("copy_len")
 def _copy_len(ctx, ins, attrs):
-    """Forward the @LEN companion from input to output (framework helper)."""
+    """Forward the @LEN (and nested @LEN2) companions from input to output
+    (framework helper)."""
     name_in = ctx.op.inputs["X"][0]
     lens = ctx.get_len(name_in)
     if lens is not None:
         ctx.set_len(ctx.op.outputs["Out"][0], lens)
+    lens2 = ctx.get_len2(name_in)
+    if lens2 is not None:
+        ctx.set_len2(ctx.op.outputs["Out"][0], lens2)
     return {}

@@ -45,9 +45,30 @@ def _seq_lens_or_full(ctx, x, slot="X"):
 
 @register_op("sequence_pool")
 def _sequence_pool(ctx, ins, attrs):
-    """sequence_pool_op: AVERAGE/SUM/SQRT/MAX/LAST/FIRST over time."""
+    """sequence_pool_op: AVERAGE/SUM/SQRT/MAX/LAST/FIRST over time.
+
+    Nested input ([B, S, T, ...] with an @LEN2 companion): LAST returns the
+    last valid token of the last valid subsequence; FIRST the first token of
+    the first subsequence — the level-0 aggregation of the reference's
+    nested LoD."""
     x = ins["X"][0]                      # [B, T, ...]
     lens = _seq_lens_or_full(ctx, x)
+    lens2 = ctx.get_len2(ctx.op.inputs["X"][0])
+    if lens2 is not None:
+        ptype_n = attrs.get("pooltype",
+                            attrs.get("pool_type", "AVERAGE")).upper()
+        B = x.shape[0]
+        b_idx = jnp.arange(B)
+        if ptype_n == "LAST":
+            last_s = jnp.maximum(lens - 1, 0)                # [B]
+            il = jnp.take_along_axis(lens2, last_s[:, None],
+                                     axis=1)[:, 0]           # [B]
+            return {"Out": x[b_idx, last_s, jnp.maximum(il - 1, 0)]}
+        if ptype_n == "FIRST":
+            return {"Out": x[:, 0, 0]}
+        raise NotImplementedError(
+            f"sequence_pool {ptype_n} over nested sequences: only "
+            f"LAST/FIRST are defined (matching last_seq/first_seq use)")
     ptype = attrs.get("pooltype", attrs.get("pool_type", "AVERAGE")).upper()
     if ptype == "AVG":                 # v1 AvgPooling spelling
         ptype = "AVERAGE"

@@ -461,15 +461,15 @@ def lstmemory(input, name=None, reverse=False, act=None, gate_act=None,
 
 
 def last_seq(input, name=None, **kw):
-    return L.sequence_last_step(input, name=name)
+    return track_layer(name, L.sequence_last_step(input, name=name))
 
 
 def first_seq(input, name=None, **kw):
-    return L.sequence_first_step(input, name=name)
+    return track_layer(name, L.sequence_first_step(input, name=name))
 
 
 def max_pooling_seq(input, name=None, **kw):
-    return L.sequence_pool(input, "max", name=name)
+    return track_layer(name, L.sequence_pool(input, "max", name=name))
 
 
 def _label_layer(label):

@@ -113,7 +113,15 @@ class StaticInput:
         self.is_seq = is_seq
 
 
-SubsequenceInput = StaticInput  # nested-sequence marker; level-2 unsupported
+class SubsequenceInput:
+    """Nested-sequence input marker (layers.py SubsequenceInput): the outer
+    recurrent_group iterates SUBSEQUENCES — each step receives one padded
+    inner sequence [B, T', ...] with its own lengths.  Declaring it here
+    promotes the wrapped var to lod_level 2 ([B, S, T', ...] + @LEN/@LEN2
+    companions), mirroring v1 where the data provider declared nesting."""
+
+    def __init__(self, input):
+        self.input = input
 
 
 class GeneratedInput:
@@ -134,8 +142,23 @@ def recurrent_group(step, input, name=None, reverse=False, **kw):
     returns the step output(s); memories declared inside link by name.
     """
     items = list(input) if isinstance(input, (list, tuple)) else [input]
+    if reverse and any(isinstance(it, SubsequenceInput) for it in items):
+        raise NotImplementedError(
+            "recurrent_group(reverse=True) over SubsequenceInput is not "
+            "supported: reversing nested sequences needs both subsequence "
+            "and token order flipped; no shipped reference config uses it")
+    for it in items:
+        if isinstance(it, SubsequenceInput):
+            # declare nesting on the underlying var: runtime arrays are
+            # [B, S, T', ...] with @LEN ([B] subseq counts) and @LEN2
+            # ([B, S] token counts) companions
+            v = it.input
+            if v.lod_level < 2:
+                v.lod_level = 2
+                if v.shape is not None:
+                    v.shape = (v.shape[0], -1) + tuple(v.shape[1:])
     if reverse:
-        items = [it if isinstance(it, StaticInput)
+        items = [it if isinstance(it, (StaticInput, SubsequenceInput))
                  else L.sequence_reverse(it) for it in items]
     rnn = L.StaticRNN(name=name)
     g = _GroupCtx(rnn, "rnn")
@@ -146,7 +169,11 @@ def recurrent_group(step, input, name=None, reverse=False, **kw):
             # sequence inputs must register first so memory() can size its
             # zero-init from the sequence's batch dim
             for it in items:
-                if not isinstance(it, StaticInput):
+                if isinstance(it, SubsequenceInput):
+                    ipt = rnn.step_input(it.input)
+                    ipt.lod_level = 1     # each step is itself a sequence
+                    args.append(ipt)
+                elif not isinstance(it, StaticInput):
                     args.append(rnn.step_input(it))
                 else:
                     args.append(None)
@@ -161,6 +188,10 @@ def recurrent_group(step, input, name=None, reverse=False, **kw):
         finally:
             _group_stack.pop()
     res = rnn.outputs
+    if any(isinstance(it, SubsequenceInput) for it in items):
+        for r in res:
+            # stacked per-subsequence outputs are sequences of sequences
+            r.lod_level = 2
     if reverse:
         res = [L.sequence_reverse(r) for r in res]
     return res[0] if len(res) == 1 else res

@@ -187,3 +187,64 @@ def test_v1_lr_decay_schedule(rng):
                          fetch_list=[lr_var])[0]) for _ in range(4)]
     want = [0.1 * (1 + 0.5 * 4 * t) ** -0.75 for t in range(4)]
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_reference_nested_rnn_conf_trains(rng):
+    """gserver/tests/sequence_nest_rnn.conf verbatim: recurrent_group over
+    SubsequenceInput with the inner group's memory booted from the outer
+    memory (nested LoD; RecurrentGradientMachine's sub-network mode)."""
+    cfg = load_v1_config(os.path.join(
+        PADDLE, "gserver/tests/sequence_nest_rnn.conf"))
+    B, S, T = 2, 3, 4
+    feeds = {"word": rng.randint(0, 10, (B, S, T)).astype("int64"),
+             "word@LEN": np.array([3, 2]),
+             "word@LEN2": np.array([[4, 3, 2], [4, 4, 1]]),
+             "label": rng.randint(0, 3, (B, 1)).astype("int64")}
+    vals = _train_steps(cfg, feeds, n=8)
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0]
+
+
+def test_nested_rnn_equals_flat_rnn(rng):
+    """The reference's RecurrentGradientMachine equivalence check
+    (test_RecurrentGradientMachine.cpp): sequence_nest_rnn.conf on
+    subsequence-split data == sequence_rnn.conf on the concatenated flat
+    data, because the inner memory boots from the outer memory (the
+    recurrence is continuous across subsequence boundaries)."""
+    B, S, T = 2, 3, 4
+    tokens = rng.randint(0, 10, (B, S * T)).astype("int64")
+
+    flat = load_v1_config(os.path.join(PADDLE,
+                                       "gserver/tests/sequence_rnn.conf"))
+    flat_loss = flat.outputs[0]
+    exe = pt.Executor()
+    exe.run(flat.startup_program, feed={}, fetch_list=[])
+    label = rng.randint(0, 3, (B, 1)).astype("int64")
+    lf, = exe.run(flat.main_program,
+                  feed={"word": tokens, "word@LEN": np.full(B, S * T),
+                        "label": label},
+                  fetch_list=[flat_loss], is_test=True)
+    flat_params = [np.asarray(pt.global_scope().get(p.name))
+                   for p in flat.main_program.global_block()
+                   .all_parameters()]
+
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    pt.unique_name.reset()
+    nest = load_v1_config(os.path.join(
+        PADDLE, "gserver/tests/sequence_nest_rnn.conf"))
+    nest_loss = nest.outputs[0]
+    exe2 = pt.Executor()
+    exe2.run(nest.startup_program, feed={}, fetch_list=[])
+    nest_ps = nest.main_program.global_block().all_parameters()
+    assert len(nest_ps) == len(flat_params)
+    for p, val in zip(nest_ps, flat_params):
+        assert tuple(np.shape(val)) == tuple(p.shape), (p.name, p.shape)
+        pt.global_scope().set(p.name, __import__("jax").numpy.asarray(val))
+    ln, = exe2.run(nest.main_program,
+                   feed={"word": tokens.reshape(B, S, T),
+                         "word@LEN": np.full(B, S),
+                         "word@LEN2": np.full((B, S), T),
+                         "label": label},
+                   fetch_list=[nest_loss], is_test=True)
+    np.testing.assert_allclose(float(ln), float(lf), rtol=1e-5)
