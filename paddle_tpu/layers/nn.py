@@ -17,6 +17,8 @@ __all__ = [
     "dropout", "softmax", "cross_entropy", "softmax_with_cross_entropy",
     "sequence_conv", "sequence_pool", "sequence_softmax", "sequence_expand",
     "sequence_first_step", "sequence_last_step", "sequence_concat",
+    "conv_shift", "interpolation", "outer_prod", "kmax_sequence_score",
+    "factorization_machine", "scale_sub_region",
     "sequence_reshape", "sequence_slice", "sequence_reverse", "lod_reset",
     "topk", "lrn", "maxout", "row_conv", "im2sequence", "one_hot", "reshape",
     "expand",
@@ -1197,4 +1199,78 @@ def flash_attention(q, k, v, causal=False, block_q=512, block_k=512,
                      outputs={"Out": [out]},
                      attrs={"causal": causal, "block_q": block_q,
                             "block_k": block_k})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# v1 attention-support / CTR layers (ConvShiftLayer, InterpolationLayer,
+# OuterProdLayer, KmaxSeqScoreLayer, FactorizationMachineLayer,
+# ScaleSubRegionLayer — gserver layers with no fluid successor)
+# ---------------------------------------------------------------------------
+def conv_shift(x, y, name=None):
+    """Circular correlation (NTM attention shift): X [B,M], Y [B,N odd]."""
+    helper = LayerHelper("conv_shift", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="conv_shift", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def interpolation(w, x, y, name=None):
+    """out = w*x + (1-w)*y with per-row weight w [B,1]."""
+    helper = LayerHelper("interpolation", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="interpolation",
+                     inputs={"W": [w], "X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def outer_prod(x, y, name=None):
+    """Per-row outer product flattened to [B, M*N]."""
+    helper = LayerHelper("outer_prod", name=name)
+    shape = None
+    if x.shape and y.shape and x.shape[1] > 0 and y.shape[1] > 0:
+        shape = (x.shape[0], x.shape[1] * y.shape[1])
+    out = helper.create_variable_for_type_inference(x.dtype, shape)
+    helper.append_op(type="outer_prod", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def kmax_sequence_score(input, beam_size=1, name=None):
+    """Top-k score indices per sequence, -1 padded (KmaxSeqScoreLayer)."""
+    helper = LayerHelper("kmax_seq_score", name=name)
+    out = helper.create_variable_for_type_inference(
+        "int64", (input.shape[0], beam_size) if input.shape else None)
+    helper.append_op(type="kmax_seq_score", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"beam_size": beam_size})
+    return out
+
+
+def factorization_machine(input, factor_size, param_attr=None, name=None):
+    """FM second-order interaction term -> [B, 1]
+    (FactorizationMachineLayer.cpp; the CTR workhorse)."""
+    helper = LayerHelper("factorization_machine", param_attr=param_attr,
+                         name=name)
+    D = input.shape[-1]
+    v = helper.create_parameter(param_attr, shape=[D, factor_size],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], 1))
+    helper.append_op(type="factorization_machine",
+                     inputs={"X": [input], "V": [v]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def scale_sub_region(x, indices, value=1.0, name=None):
+    """Scale the sub-region of [B,C,H,W] selected by per-sample 1-based
+    inclusive boxes [B,6]=(c1,c2,h1,h2,w1,w2) by ``value``."""
+    helper = LayerHelper("scale_sub_region", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="scale_sub_region",
+                     inputs={"X": [x], "Indices": [indices]},
+                     outputs={"Out": [out]}, attrs={"value": value})
     return out

