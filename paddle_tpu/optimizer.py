@@ -404,3 +404,67 @@ class ModelAverage:
         for n, v in self._backup.items():
             self._scope.set(n, jnp.asarray(v))
         self._backup = None
+
+
+class StaticPruningHook:
+    """Magnitude pruning mask re-applied every step (reference:
+    ParameterUpdaterHook.cpp:39 StaticPruningHook, ParamAttr
+    update_hooks).
+
+    TPU-native: the mask lives in the scope as a persistable buffer and the
+    re-masking is an in-graph elementwise multiply appended AFTER the
+    optimizer update — it compiles into the same fused step, no host sync::
+
+        pt.optimizer.Momentum(...).minimize(loss)
+        hook = StaticPruningHook(sparsity_ratio=0.8)
+        hook.attach(["fc_0.w_0"])          # graph ops, before startup run
+        exe.run(startup, ...)
+        hook.initialize()                  # masks from initial |w| magnitude
+    """
+
+    def __init__(self, sparsity_ratio=0.8):
+        self.sparsity_ratio = sparsity_ratio
+        self._masked = []       # (param name, mask name)
+
+    def attach(self, param_names, main_program=None, startup_program=None):
+        from .core.program import default_main_program
+        from .layer_helper import LayerHelper
+
+        prog = main_program or default_main_program()
+        block = prog.global_block()
+        for pname in param_names:
+            mname = f"{pname}@PRUNE_MASK"
+            p = block.var(pname)
+            block.create_var(name=mname, shape=p.shape, dtype=p.dtype,
+                             persistable=True, stop_gradient=True)
+            block.append_op(
+                "elementwise_mul",
+                inputs={"X": [pname], "Y": [mname]},
+                outputs={"Out": [pname]}, attrs={"axis": -1})
+            self._masked.append((pname, mname))
+        return self
+
+    def initialize(self, scope=None):
+        """Compute masks from the CURRENT weight magnitudes (call once,
+        after the startup program ran): the smallest ``sparsity_ratio``
+        fraction by |w| is pinned to zero."""
+        import jax.numpy as jnp
+        import numpy as np
+        from .core.scope import global_scope
+
+        scope = scope or global_scope()
+        for pname, mname in self._masked:
+            w = np.asarray(scope.get(pname))
+            k = int(self.sparsity_ratio * w.size)
+            mask = np.ones(w.size, w.dtype)
+            if k > 0:
+                idx = np.argsort(np.abs(w).ravel())[:k]
+                mask[idx] = 0.0
+            scope.set(mname, jnp.asarray(mask.reshape(w.shape)))
+
+    def sparsity(self, pname, scope=None):
+        import numpy as np
+        from .core.scope import global_scope
+        scope = scope or global_scope()
+        w = np.asarray(scope.get(pname))
+        return float((w == 0).mean())

@@ -176,3 +176,32 @@ def test_model_average_apply_restore(rng):
         assert np.isfinite(inside).all()
     after = np.asarray(pt.global_scope().get("w"))
     np.testing.assert_array_equal(after, live)    # restored
+
+
+def test_static_pruning_hook(rng):
+    """StaticPruningHook (ParameterUpdaterHook.cpp:39): the smallest 80%
+    of |w| are pinned to zero through training — the mask re-applies
+    in-graph after every optimizer update."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.optimizer import StaticPruningHook
+
+    x = layers.data("x", shape=[16], dtype="float32")
+    t = layers.data("t", shape=[1], dtype="float32")
+    y = layers.fc(x, size=1, bias_attr=False,
+                  param_attr=pt.ParamAttr(name="w"))
+    loss = layers.mean(layers.square_error_cost(y, t))
+    pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+    hook = StaticPruningHook(sparsity_ratio=0.75).attach(["w"])
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    hook.initialize()
+    feeds = {"x": rng.rand(8, 16).astype("float32"),
+             "t": rng.rand(8, 1).astype("float32")}
+    mask = np.asarray(pt.global_scope().get("w@PRUNE_MASK"))
+    assert mask.sum() == 4                      # 12 of 16 pruned
+    for _ in range(5):
+        exe.run(pt.default_main_program(), feed=feeds, fetch_list=[loss])
+        w = np.asarray(pt.global_scope().get("w"))
+        assert (w[mask == 0] == 0).all()        # pruned entries stay zero
+    assert (w[mask == 1] != 0).any()            # survivors keep training
