@@ -989,7 +989,16 @@ def sequence_softmax(input, name=None):
 
 def sequence_expand(x, y, name=None):
     helper = LayerHelper("sequence_expand", name=name)
-    out = helper.create_variable_for_type_inference(x.dtype, lod_level=1)
+    shape = None
+    # the op only broadcasts a [B, D] x along y's time dim when y is
+    # time-major ([B, T, ...] rank >= 3); same-rank inputs pass through
+    if x.shape is not None and y.shape is not None:
+        if len(x.shape) == 2 and len(y.shape) >= 3:
+            shape = (x.shape[0], y.shape[1]) + tuple(x.shape[1:])
+        else:
+            shape = tuple(x.shape)
+    out = helper.create_variable_for_type_inference(x.dtype, shape,
+                                                    lod_level=1)
     helper.append_op(type="sequence_expand", inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [out]})
     return out

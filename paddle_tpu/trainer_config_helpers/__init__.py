@@ -61,7 +61,8 @@ __all__ = [
     "sum_evaluator", "chunk_evaluator", "seqtext_printer_evaluator",
     "classification_error_evaluator",
     "maxid_layer", "pooling_layer", "sequence_conv_pool",
-    "bidirectional_lstm",
+    "bidirectional_lstm", "expand_layer", "scaling_layer",
+    "simple_attention", "gru_step_layer",
 ]
 
 
@@ -511,7 +512,8 @@ from .sequence import (  # noqa: E402
     grumemory, gru_group, simple_gru, beam_search, crf_layer,
     crf_decoding_layer, sum_evaluator, chunk_evaluator,
     seqtext_printer_evaluator, classification_error_evaluator, track_layer,
-    maxid_layer, pooling_layer, sequence_conv_pool, bidirectional_lstm)
+    maxid_layer, pooling_layer, sequence_conv_pool, bidirectional_lstm,
+    expand_layer, scaling_layer, simple_attention, gru_step_layer)
 
 
 # ---------------------------------------------------------------------------

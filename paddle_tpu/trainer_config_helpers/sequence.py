@@ -38,7 +38,8 @@ __all__ = [
     "sum_evaluator", "chunk_evaluator", "seqtext_printer_evaluator",
     "classification_error_evaluator",
     "maxid_layer", "pooling_layer", "sequence_conv_pool",
-    "bidirectional_lstm",
+    "bidirectional_lstm", "expand_layer", "scaling_layer",
+    "simple_attention", "gru_step_layer",
 ]
 
 
@@ -608,3 +609,54 @@ def bidirectional_lstm(input, size, name=None, return_seq=False,
         out = L.concat([L.sequence_last_step(fwd),
                         L.sequence_first_step(bwd)], axis=-1)
     return track_layer(name, out)
+
+
+def expand_layer(input, expand_as, name=None, **kw):
+    """v1 expand_layer (layers.py:1571): broadcast per-sequence rows along
+    another sequence's time dim."""
+    return track_layer(name, L.sequence_expand(input, expand_as))
+
+
+def scaling_layer(input, weight, name=None, **kw):
+    """v1 scaling_layer (layers.py:2103): per-position scalar weight times
+    the sequence's feature vectors."""
+    return track_layer(name, L.elementwise_mul(input, weight))
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     weight_act=None, name=None, **kw):
+    """networks.py:1400 simple_attention (Bahdanau): project the decoder
+    state, add to the per-position encoder projections, score with a
+    sequence-softmaxed fc, and sum-pool the weighted encoder outputs into
+    a context vector."""
+    from . import _act_name
+    name = name or unique_name.generate("attention")
+    proj_size = encoded_proj.shape[-1]
+    m = L.fc(decoder_state, size=proj_size, bias_attr=False,
+             param_attr=transform_param_attr)
+    expanded = L.sequence_expand(m, encoded_proj)
+    combined = L.elementwise_add(expanded, encoded_proj)
+    a = _act_name(weight_act)
+    if a:
+        combined = getattr(L, a)(combined)
+    att = L.fc(combined, size=1, num_flatten_dims=2, bias_attr=False,
+               param_attr=softmax_param_attr)
+    weight = L.sequence_softmax(att)              # masked over true length
+    scaled = L.elementwise_mul(encoded_sequence, weight)
+    return track_layer(name, L.sequence_pool(scaled, "sum"))
+
+
+def gru_step_layer(input, output_mem, size=None, act=None, gate_act=None,
+                   name=None, param_attr=None, bias_attr=None, **kw):
+    """v1 gru_step_layer (layers.py:3364): ONE GRU step inside a
+    recurrent_group — input is the [B, 3H] projection, output_mem the
+    previous hidden."""
+    from . import _act_name
+    size = size or input.shape[-1] // 3
+    hidden, _, _ = L.gru_unit(
+        input, output_mem, size * 3, param_attr=param_attr,
+        bias_attr=bias_attr,
+        activation=_act_name(act) or "tanh",
+        gate_activation=_act_name(gate_act) or "sigmoid")
+    return track_layer(name, hidden)

@@ -63,6 +63,40 @@ layer = _types.SimpleNamespace(
     cross_entropy_cost=_dsl.cross_entropy_cost,
     square_error_cost=_dsl.regression_cost,
     regression_cost=_dsl.regression_cost,
+    # sequence / generation DSL surface (round-3 additions)
+    recurrent_group=_dsl.recurrent_group,
+    memory=_dsl.memory,
+    mixed=_dsl.mixed_layer,
+    full_matrix_projection=_dsl.full_matrix_projection,
+    table_projection=_dsl.table_projection,
+    identity_projection=_dsl.identity_projection,
+    dotmul_projection=_dsl.dotmul_projection,
+    trans_full_matrix_projection=_dsl.trans_full_matrix_projection,
+    recurrent=_dsl.recurrent_layer,
+    lstmemory_group=_dsl.lstmemory_group,
+    grumemory=_dsl.grumemory,
+    gru_group=_dsl.gru_group,
+    simple_gru=_dsl.simple_gru,
+    beam_search=_dsl.beam_search,
+    crf=_dsl.crf_layer,
+    crf_decoding=_dsl.crf_decoding_layer,
+    max_id=_dsl.maxid_layer,
+    pooling=_dsl.pooling_layer,
+    expand=_dsl.expand_layer,
+    scaling=_dsl.scaling_layer,
+    StaticInput=_dsl.StaticInput,
+    GeneratedInput=_dsl.GeneratedInput,
+    SubsequenceInput=_dsl.SubsequenceInput,
+)
+
+# paddle.networks (v2 networks namespace: the composite helpers)
+networks = _types.SimpleNamespace(
+    simple_lstm=_dsl.simple_lstm,
+    simple_gru=_dsl.simple_gru,
+    bidirectional_lstm=_dsl.bidirectional_lstm,
+    sequence_conv_pool=_dsl.sequence_conv_pool,
+    simple_attention=_dsl.simple_attention,
+    img_conv_group=_dsl.img_conv_group,
 )
 
 # -- paddle.activation / paddle.pooling --------------------------------------
