@@ -33,6 +33,18 @@ except Exception:  # pragma: no cover
 NEG_INF = -1e30
 
 
+def _sds(x, shape, dtype):
+    """ShapeDtypeStruct inheriting ``x``'s varying-manual-axes type, so the
+    kernels compose with the new shard_map's vma checker (ring attention
+    calls them per device hop)."""
+    aval = jax.typeof(x) if hasattr(jax, "typeof") else \
+        jax.core.get_aval(x)
+    vma = getattr(aval, "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
@@ -104,8 +116,8 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
                           causal=causal, sm_scale=sm_scale,
                           block_q=block_q),
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Tq, Dv), q.dtype),
-            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+            _sds(q, (BH, Tq, Dv), q.dtype),
+            _sds(q, (BH, Tq, 1), jnp.float32),
         ],
         grid=grid,
         in_specs=[
@@ -228,16 +240,20 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
-               interpret):
+               interpret, g_lse=None):
     BH, Tq, D = q.shape
     Tk = k.shape[1]
     Dv = v.shape[2]
     nq = Tq // block_q
     nk = Tk // block_k
     # delta_i = sum_d dO_i · O_i  (rescaling term of dsoftmax); O(T·Dv) work,
-    # fused by XLA — not worth a kernel
+    # fused by XLA — not worth a kernel.  A cotangent on lse folds in here:
+    # dL/ds_ij = p_ij (dp_ij - delta_i + g_lse_i), so delta_eff = delta -
+    # g_lse and the kernels run unchanged.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                # [BH, Tq, 1]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32).reshape(delta.shape)
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
@@ -247,7 +263,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, num_k_blocks=nk, causal=causal,
                           sm_scale=sm_scale),
-        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+        out_shape=_sds(q, (BH, Tq, D), q.dtype),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda i, j, kb: (i, j, 0)),
@@ -268,8 +284,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
                           block_k=block_k, num_q_blocks=nq, causal=causal,
                           sm_scale=sm_scale),
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, Tk, Dv), v.dtype),
+            _sds(k, (BH, Tk, D), k.dtype),
+            _sds(v, (BH, Tk, Dv), v.dtype),
         ],
         grid=(BH, nk, nq),
         in_specs=[
@@ -324,6 +340,48 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                      interpret)
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                       interpret):
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res,
+                       g):
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    return _flash_bwd(q, k, v, out, lse, g_out, causal, sm_scale, block_q,
+                      block_k, interpret, g_lse=g_lse)
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, sm_scale=None,
+                             block_q=128, block_k=128, interpret=False):
+    """Fused attention returning (out, lse [BH, Tq, 1]) — the streaming-
+    softmax residual blockwise consumers (ring attention) merge across
+    device hops.  q,k,v: [BH, T, D], block-divisible lengths.  Fully
+    differentiable: an lse cotangent folds into the backward's delta."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    bq = min(block_q, q.shape[1])
+    bk = min(block_k, k.shape[1])
+    if q.shape[1] % bq or k.shape[1] % bk or (causal and
+                                             q.shape[1] != k.shape[1]):
+        raise ValueError(
+            "flash_attention_with_lse needs block-divisible lengths "
+            f"(got Tq={q.shape[1]}, Tk={k.shape[1]})")
+    return _flash_lse(q, k, v, causal, sm_scale, bq, bk, interpret)
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,

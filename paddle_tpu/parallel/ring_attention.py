@@ -32,13 +32,88 @@ def _block_attn(q, k, v, bias=None):
 
 
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
-                   scale: float = None):
+                   scale: float = None, use_flash=None, block_q: int = 256,
+                   block_k: int = 256, interpret: bool = False):
     """Exact attention with K/V circulated around the sp ring.
 
     q,k,v: [B, T_local, H, D] (local sequence shard).  Returns [B,T_local,H,D].
     With ``causal``, blocks wholly in the future are skipped via masking
     (shapes stay static; the mask zeroes their contribution).
+
+    ``use_flash`` (default: auto on TPU when block-divisible) computes each
+    ring hop with the fused Pallas flash kernel via its (out, lse)
+    residuals and merges hops by streaming-softmax — O(T_local) memory per
+    hop instead of the [T_local, T_local] score matrix, composing the two
+    long-context mechanisms (ring over ICI x flash in VMEM).
     """
+    if use_flash is None:
+        import jax as _jax
+        from ..ops.pallas_kernels import _HAVE_PALLAS
+        T_loc = q.shape[1]
+        use_flash = (_HAVE_PALLAS and _jax.default_backend() == "tpu"
+                     and T_loc % min(block_q, T_loc) == 0
+                     and T_loc % min(block_k, T_loc) == 0)
+    if use_flash or interpret:
+        return _ring_attention_flash(q, k, v, axis_name, causal, scale,
+                                     block_q, block_k, interpret)
+    return _ring_attention_jnp(q, k, v, axis_name, causal, scale)
+
+
+def _ring_attention_flash(q, k, v, axis_name, causal, scale, block_q,
+                          block_k, interpret):
+    from ..ops.pallas_kernels import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+
+    def flat(x):
+        return jnp.moveaxis(x, 2, 1).reshape(B * H, T, x.shape[-1])
+
+    q3, k3, v3 = flat(q), flat(k), flat(v)
+    in_dtype = q.dtype
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # hop 0 is ALWAYS this device's own K/V block (the causal diagonal), so
+    # the kernel's static causal flag is exact here; later hops are whole
+    # past/future blocks — full kernel plus a merge-level mask
+    out, lse = flash_attention_with_lse(q3, k3, v3, causal=causal,
+                                        sm_scale=scale, block_q=block_q,
+                                        block_k=block_k, interpret=interpret)
+    # the streaming merge runs in f32 (lse is f32); cast back after the ring
+    out = out.astype(jnp.float32)
+    kc = lax.ppermute(k3, axis_name, perm)
+    vc = lax.ppermute(v3, axis_name, perm)
+
+    def step(carry, i):
+        kc, vc, out, lse = carry
+        src = (my - i) % n
+        o_b, lse_b = flash_attention_with_lse(
+            q3, kc, vc, causal=False, sm_scale=scale, block_q=block_q,
+            block_k=block_k, interpret=interpret)
+        if causal:
+            # future blocks (src > my) contribute nothing: -inf lse zeroes
+            # their merge weight while shapes stay static
+            lse_b = jnp.where(src < my, lse_b, -jnp.inf)
+        m = jnp.maximum(lse, lse_b)
+        a = jnp.exp(lse - m)
+        b = jnp.exp(lse_b - m)
+        denom = jnp.maximum(a + b, 1e-38)
+        out = (out * a + o_b.astype(jnp.float32) * b) / denom
+        lse = m + jnp.log(denom)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, out, lse), None
+
+    if n > 1:
+        (_, _, out, _), _ = lax.scan(step, (kc, vc, out, lse),
+                                     jnp.arange(1, n))
+    out = out.astype(in_dtype)
+    return jnp.moveaxis(out.reshape(B, H, T, v.shape[-1]), 1, 2)
+
+
+def _ring_attention_jnp(q, k, v, axis_name, causal, scale):
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     d = q.shape[-1]
