@@ -49,11 +49,43 @@ class DataFeeder:
                     arr = arr[..., None]
                 out[var.name] = arr
                 out[var.name + "@LEN"] = lens
+            elif var.lod_level == 2:
+                arr, lens, lens2 = self._pad_nested(col, var)
+                out[var.name] = arr
+                out[var.name + "@LEN"] = lens
+                out[var.name + "@LEN2"] = lens2
             else:
                 raise NotImplementedError(
-                    "lod_level>=2 (nested sequences): feed pre-padded arrays "
-                    "with explicit @LEN companions")
+                    "lod_level>2 nested sequences are not a reference "
+                    "capability (max LoD depth 2)")
         return out
+
+    def _pad_nested(self, col, var):
+        """Nested rows (list of subsequences of tokens/vectors) ->
+        [B, S, T, ...] + @LEN [B] + @LEN2 [B, S] (LoD level-2 padding)."""
+        B = len(col)
+        lens = np.asarray([len(r) for r in col], np.int32)
+        S = _round_up(int(lens.max()) if B else 1, 1)
+        inner = [[len(sub) for sub in row] for row in col]
+        T = max((max(l) if l else 1 for l in inner), default=1)
+        T = _round_up(T, self.seq_bucket_multiple)
+        first = None
+        for row in col:
+            for sub in row:
+                if len(sub):
+                    first = np.asarray(sub[0])
+                    break
+            if first is not None:
+                break
+        feat_shape = first.shape if first is not None and first.ndim else ()
+        arr = np.zeros((B, S, T) + feat_shape, dtype=var.dtype)
+        lens2 = np.zeros((B, S), np.int32)
+        for b, row in enumerate(col):
+            for s, sub in enumerate(row):
+                lens2[b, s] = len(sub)
+                if len(sub):
+                    arr[b, s, :len(sub)] = np.asarray(sub, dtype=var.dtype)
+        return arr, lens, lens2
 
     def _pad_rows(self, col, var):
         """Pad variable-length rows; C++ fast path (native feeder_module,

@@ -158,6 +158,24 @@ def recurrent_group(step, input, name=None, reverse=False, **kw):
                 v.lod_level = 2
                 if v.shape is not None:
                     v.shape = (v.shape[0], -1) + tuple(v.shape[1:])
+                # an embedding is per-token, so nesting originates at its id
+                # DATA layer — promote it too so the DataFeeder pads nested
+                # rows (the provider-declares-nesting role in v1).  The
+                # var's last writer is the @LEN copy op, whose X is the ids.
+                op = getattr(v, "op", None)
+                src_name = None
+                if op is not None and op.type == "lookup_table":
+                    src_name = op.inputs["Ids"][0]
+                elif op is not None and op.type == "copy_len":
+                    src_name = op.inputs["X"][0]
+                blk = v.block
+                if src_name and src_name in blk.vars:
+                    ids = blk.vars[src_name]
+                    if getattr(ids, "is_data", False) and ids.lod_level < 2:
+                        ids.lod_level = 2
+                        if ids.shape is not None:
+                            ids.shape = (ids.shape[0], -1) + \
+                                tuple(ids.shape[1:])
     if reverse:
         items = [it if isinstance(it, (StaticInput, SubsequenceInput))
                  else L.sequence_reverse(it) for it in items]

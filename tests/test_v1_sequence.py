@@ -342,3 +342,31 @@ def test_seq2seq_attention_decoder_config(tmp_path, rng):
                           fetch_list=[loss])[0]) for _ in range(12)]
     assert np.isfinite(vals).all()
     assert vals[-1] < vals[0] * 0.95
+
+
+def test_data_feeder_nested_sequences(rng):
+    """DataFeeder pads nested rows (list of subsequences) to [B,S,T] with
+    @LEN/@LEN2 companions, and the nested reference config trains from
+    feeder-produced feeds (the process_subseq provider path)."""
+    import paddle_tpu.layers as L
+
+    cfg = load_v1_config(os.path.join(
+        PADDLE, "gserver/tests/sequence_nest_rnn.conf"))
+    word = cfg.data_layers["word"]
+    label = cfg.data_layers["label"]
+    feeder = pt.DataFeeder([word, label], seq_bucket_multiple=1)
+    rows = [([[1, 2, 3], [4, 5]], 0),
+            ([[6], [7, 8], [9, 1, 2]], 2)]
+    feeds = feeder.feed(rows)
+    assert feeds["word"].shape == (2, 3, 3)
+    np.testing.assert_array_equal(feeds["word@LEN"], [2, 3])
+    np.testing.assert_array_equal(feeds["word@LEN2"],
+                                  [[3, 2, 0], [1, 2, 3]])
+    assert feeds["word"][0, 1, 1] == 5 and feeds["word"][1, 2, 2] == 2
+
+    loss = cfg.minimize_outputs()
+    exe = pt.Executor()
+    exe.run(cfg.startup_program, feed={}, fetch_list=[])
+    vals = [float(exe.run(cfg.main_program, feed=feeds,
+                          fetch_list=[loss])[0]) for _ in range(6)]
+    assert np.isfinite(vals).all() and vals[-1] < vals[0]
