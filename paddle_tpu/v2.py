@@ -297,3 +297,41 @@ class Topology:
 
 
 topology = _types.SimpleNamespace(Topology=Topology)
+
+
+# -- paddle.v2.plot ----------------------------------------------------------
+class Ploter:
+    """v2 plot.Ploter (python/paddle/v2/plot/plot.py): accumulate named
+    curves during training and render/save them (Agg backend, so it works
+    headless like the reference's notebook fallback)."""
+
+    def __init__(self, *titles):
+        self.titles = list(titles)
+        self.data = {t: ([], []) for t in titles}
+
+    def append(self, title, step, value):
+        xs, ys = self.data[title]
+        xs.append(step)
+        ys.append(float(value))
+
+    def plot(self, path=None):
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        fig, ax = plt.subplots()
+        for t in self.titles:
+            xs, ys = self.data[t]
+            ax.plot(xs, ys, label=t)
+        ax.legend()
+        ax.set_xlabel("step")
+        if path:
+            fig.savefig(path)
+        plt.close(fig)
+        return fig
+
+    def reset(self):
+        for t in self.titles:
+            self.data[t] = ([], [])
+
+
+plot = _types.SimpleNamespace(Ploter=Ploter)

@@ -221,3 +221,16 @@ def test_v2_master_client_and_topology(tmp_path):
     blob = topo.serialize()
     assert "px" in blob and topo.get_layer("px") is not None
     assert "px" in topo.data_layers()
+
+
+def test_v2_ploter(tmp_path):
+    import paddle_tpu.v2 as paddle
+    p = paddle.plot.Ploter("train", "test")
+    for i in range(5):
+        p.append("train", i, 1.0 / (i + 1))
+        p.append("test", i, 1.2 / (i + 1))
+    out = tmp_path / "cost.png"
+    p.plot(str(out))
+    assert out.exists() and out.stat().st_size > 0
+    p.reset()
+    assert p.data["train"] == ([], [])
