@@ -83,12 +83,36 @@ def _shard_snapshot(name, arr):
 
 
 class CheckpointManager:
-    def __init__(self, root: str, max_to_keep: int = 3, async_save: bool = True):
+    def __init__(self, root: str, max_to_keep: int = 3, async_save: bool = True,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None, barrier=None):
         self.root = root
         self.max_to_keep = max_to_keep
         self.async_save = async_save
+        # process identity/barrier are injectable so the multi-process
+        # protocol (manifest merge, nonce fencing, commit wait) is testable
+        # in one process; defaults come from jax.distributed
+        if (process_index is None) != (process_count is None):
+            raise ValueError(
+                "process_index and process_count must be injected together")
+        self._process_index = process_index
+        self._process_count = process_count
+        self._barrier = barrier
         self._thread: Optional[threading.Thread] = None
         os.makedirs(root, exist_ok=True)
+
+    def _proc(self):
+        import jax
+        if self._process_index is not None:
+            return self._process_index, self._process_count
+        return jax.process_index(), jax.process_count()
+
+    def _sync(self, tag: str):
+        if self._barrier is not None:
+            self._barrier(tag)
+            return
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
 
     # -- save --------------------------------------------------------------
     def save(self, step: int, scope: Optional[Scope] = None,
@@ -126,32 +150,25 @@ class CheckpointManager:
         consistent; the async writer thread then coordinates purely through
         nonce-matched files (a stale manifest can never satisfy a fresh
         attempt's wait)."""
-        import jax
-
-        proc = jax.process_index()
-        nprocs = jax.process_count()
+        proc, nprocs = self._proc()
         d = os.path.join(self.root, f"ckpt-{step}.tmp")
         if nprocs == 1:
             shutil.rmtree(d, ignore_errors=True)
             os.makedirs(d)
             return os.urandom(8).hex()
-        from jax.experimental import multihost_utils
         # everyone is past any previous attempt's writes before cleanup
-        multihost_utils.sync_global_devices(f"ckpt-{step}-begin")
+        self._sync(f"ckpt-{step}-begin")
         if proc == 0:
             shutil.rmtree(d, ignore_errors=True)
             os.makedirs(d)
             with open(os.path.join(d, "attempt.json"), "w") as f:
                 json.dump({"nonce": os.urandom(8).hex()}, f)
-        multihost_utils.sync_global_devices(f"ckpt-{step}-attempt")
+        self._sync(f"ckpt-{step}-attempt")
         with open(os.path.join(d, "attempt.json")) as f:
             return json.load(f)["nonce"]
 
     def _write(self, step: int, snap, nonce: str):
-        import jax
-
-        proc = jax.process_index()
-        nprocs = jax.process_count()
+        proc, nprocs = self._proc()
         d = os.path.join(self.root, f"ckpt-{step}.tmp")
         final = os.path.join(self.root, f"ckpt-{step}")
         manifest = {}

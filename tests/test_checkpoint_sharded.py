@@ -146,3 +146,65 @@ def test_mid_training_resume_bitwise(tmp_path):
     _train_steps(exe2, main, resumed, xs, ys, loss, 3, 6)
     np.testing.assert_array_equal(np.asarray(resumed.get("w")), w_full)
     np.testing.assert_array_equal(np.asarray(resumed.get("b")), b_full)
+
+
+def test_multiprocess_protocol_simulated(tmp_path, rng):
+    """Two 'processes' (threads with injected identity + a shared barrier)
+    run the full save protocol: per-proc shard manifests, nonce fencing,
+    proc-0 merge + atomic commit, non-zero commit wait — and a STALE
+    manifest from a crashed prior attempt cannot satisfy the fresh wait."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+    root = str(tmp_path / "ckpt")
+    n = 2
+    bar = threading.Barrier(n)
+
+    def barrier(tag):
+        bar.wait(timeout=30)
+
+    # plant stale artifacts from a "crashed" earlier attempt at step 7
+    stale = os.path.join(root, "ckpt-7.tmp")
+    os.makedirs(stale)
+    with open(os.path.join(stale, "attempt.json"), "w") as f:
+        json.dump({"nonce": "deadbeef"}, f)
+    with open(os.path.join(stale, "shards-1.json"), "w") as f:
+        json.dump({"nonce": "deadbeef", "vars": {}}, f)
+
+    vals = {0: np.arange(8, dtype="float32"),
+            1: np.arange(8, 16, dtype="float32")}
+    errs = []
+
+    def run(proc):
+        try:
+            scope = Scope()
+            scope.set(f"w_{proc}", jnp.asarray(vals[proc]))
+            cm = CheckpointManager(root, async_save=False,
+                                   process_index=proc, process_count=n,
+                                   barrier=barrier)
+            cm.save(7, scope=scope)
+        except Exception as e:  # noqa: BLE001
+            errs.append((proc, e))
+
+    threads = [threading.Thread(target=run, args=(p,)) for p in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    final = os.path.join(root, "ckpt-7")
+    meta = json.load(open(os.path.join(final, "meta.json")))
+    assert meta["nonce"] != "deadbeef"          # fresh attempt won
+    assert set(meta["vars"]) == {"w_0", "w_1"}  # manifests merged
+    # both procs' shard files landed and restore reassembles each var
+    restored = Scope()
+    restored.set("w_0", jnp.zeros(8))
+    restored.set("w_1", jnp.zeros(8))
+    cm0 = CheckpointManager(root, process_index=0, process_count=1)
+    cm0.restore(scope=restored)
+    np.testing.assert_array_equal(np.asarray(restored.get("w_0")), vals[0])
+    np.testing.assert_array_equal(np.asarray(restored.get("w_1")), vals[1])
