@@ -5,7 +5,7 @@ from .nn import *            # noqa: F401,F403
 from .tensor import (        # noqa: F401
     create_tensor, create_global_var, sums, assign, fill_constant,
     fill_constant_batch_size_like, ones, zeros, zeros_like, reverse,
-    argmax, argsort, gather, scatter, shape, range,
+    argmax, argsort, gather, scatter, shape, range, slice,
 )
 from .control_flow import *  # noqa: F401,F403
 from .io import data         # noqa: F401

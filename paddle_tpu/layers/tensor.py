@@ -8,6 +8,7 @@ __all__ = [
     "create_tensor", "create_global_var", "cast", "concat", "sums", "assign",
     "fill_constant", "fill_constant_batch_size_like", "ones", "zeros",
     "zeros_like", "reverse", "argmax", "argsort", "gather", "scatter",
+    "slice",
     "shape", "range",
 ]
 
@@ -162,4 +163,23 @@ def range(start, end, step, dtype="int64", name=None):
     helper.append_op(type="range", outputs={"Out": [out]},
                      attrs={"start": start, "end": end, "step": step,
                             "dtype": convert_dtype(dtype).name})
+    return out
+
+
+def slice(input, axes, starts, ends, name=None):
+    """slice_op: static ranges along the given axes."""
+    helper = LayerHelper("slice", name=name)
+    shape = list(input.shape) if input.shape else None
+    if shape is not None:
+        for ax, st, en in zip(axes, starts, ends):
+            if shape[ax] is not None and shape[ax] > 0:
+                shape[ax] = max(0, min(en, shape[ax]) - st)
+            else:
+                shape[ax] = en - st
+    out = helper.create_variable_for_type_inference(
+        input.dtype, tuple(shape) if shape else None)
+    helper.append_op(type="slice", inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends)})
     return out

@@ -182,7 +182,8 @@ def _tile(ctx, ins, attrs):
 
 @register_op("slice")
 def _slice(ctx, ins, attrs):
-    x = ins["X"][0]
+    # fluid's slice_op names its input slot "Input"; accept both spellings
+    x = ins.get("Input", ins.get("X"))[0]
     axes = attrs["axes"]
     starts = attrs["starts"]
     ends = attrs["ends"]

@@ -46,6 +46,7 @@ __all__ = [
     "STanhActivation", "ExpActivation", "AbsActivation",
     "SquareActivation", "BReluActivation", "SoftReluActivation",
     "MaxPooling", "AvgPooling", "SumPooling",
+    "CudnnMaxPooling", "CudnnAvgPooling",
     "MomentumOptimizer", "AdamOptimizer", "AdaGradOptimizer",
     "RMSPropOptimizer", "AdaDeltaOptimizer",
     "L1Regularization", "L2Regularization", "ModelAverage",
@@ -55,7 +56,8 @@ __all__ = [
     "SubsequenceInput", "mixed_layer", "MixedLayerType",
     "full_matrix_projection", "trans_full_matrix_projection",
     "table_projection", "identity_projection", "dotmul_projection",
-    "scaling_projection", "recurrent_layer", "lstmemory_group",
+    "scaling_projection", "slice_projection", "recurrent_layer",
+    "lstmemory_group",
     "grumemory", "gru_group", "simple_gru", "beam_search",
     "crf_layer", "crf_decoding_layer",
     "sum_evaluator", "chunk_evaluator", "seqtext_printer_evaluator",
@@ -120,7 +122,13 @@ def define_py_data_sources2(train_list, test_list, module=None, obj=None,
 
 
 def outputs(*vars_):
-    _state.outputs = [v for v in vars_]
+    flat = []
+    for v in vars_:
+        if isinstance(v, (list, tuple)):
+            flat.extend(v)          # v1 allowed outputs([a, b])
+        else:
+            flat.append(v)
+    _state.outputs = flat
 
 
 def inputs(*layers):
@@ -228,6 +236,10 @@ class AvgPooling:
 
 class SumPooling:
     ptype = "sum"
+
+
+CudnnMaxPooling = MaxPooling     # cudnn variants are layout hints on TPU
+CudnnAvgPooling = AvgPooling
 
 
 class MomentumOptimizer:
@@ -353,12 +365,18 @@ def img_conv_layer(input, filter_size, num_filters, name=None,
 
 
 def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
-                   name=None, num_channels=None, ceil_mode=True, **kw):
+                   name=None, num_channels=None, ceil_mode=True,
+                   pool_size_y=None, stride_y=None, padding_y=None, **kw):
     if num_channels is not None:
         input = _as_image(input, num_channels)
     ptype = pool_type.ptype if pool_type is not None else "max"
-    return L.pool2d(input, pool_size=pool_size, pool_type=ptype,
-                    pool_stride=stride, pool_padding=padding,
+    ps = (pool_size, pool_size_y) if pool_size_y is not None else pool_size
+    st = (stride, stride_y) if stride_y is not None else stride
+    pd = (padding, padding_y) if padding_y is not None else padding
+    return L.pool2d(input, pool_size=list(ps) if isinstance(ps, tuple)
+                    else ps, pool_type=ptype,
+                    pool_stride=list(st) if isinstance(st, tuple) else st,
+                    pool_padding=list(pd) if isinstance(pd, tuple) else pd,
                     ceil_mode=ceil_mode, name=name)
 
 
@@ -429,8 +447,30 @@ def embedding_layer(input, size, name=None, param_attr=None, **kw):
                        name=name)
 
 
-def concat_layer(input, act=None, name=None, **kw):
-    return L.concat(list(input), axis=1, name=name)
+def concat_layer(input, act=None, name=None, bias_attr=None, **kw):
+    """v1 concat (axis 1 = features/channels).  Items may be layer outputs
+    OR projections (ConcatenateLayer2 accepted projections directly)."""
+    from .sequence import _Projection, track_layer
+    items = [it.build(0) if isinstance(it, _Projection) else it
+             for it in input]
+    out = L.concat(items, axis=1, name=name)
+    if bias_attr not in (None, False):
+        from ..layer_helper import LayerHelper
+        helper = LayerHelper("concat_bias")
+        if out.shape and out.shape[1] and out.shape[1] > 0:
+            csize = out.shape[1]
+        else:
+            # infer the concat width: sum of the inputs' concat-axis dims
+            csize = sum(it.shape[1] for it in items)
+        b = helper.create_parameter(
+            bias_attr if isinstance(bias_attr, ParamAttr) else ParamAttr(),
+            shape=[csize], dtype=out.dtype, is_bias=True)
+        axis = 1 if (out.shape is not None and len(out.shape) == 4) else -1
+        out = L.elementwise_add(out, b, axis=axis)
+    a = _act_name(act)
+    if a:
+        out = getattr(L, a)(out)
+    return track_layer(name, out)
 
 
 def addto_layer(input, act=None, name=None, bias_attr=None, **kw):
@@ -512,6 +552,7 @@ from .sequence import (  # noqa: E402
     grumemory, gru_group, simple_gru, beam_search, crf_layer,
     crf_decoding_layer, sum_evaluator, chunk_evaluator,
     seqtext_printer_evaluator, classification_error_evaluator, track_layer,
+    slice_projection,
     maxid_layer, pooling_layer, sequence_conv_pool, bidirectional_lstm,
     expand_layer, scaling_layer, simple_attention, gru_step_layer)
 

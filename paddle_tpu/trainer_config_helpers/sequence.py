@@ -37,6 +37,7 @@ __all__ = [
     "crf_layer", "crf_decoding_layer",
     "sum_evaluator", "chunk_evaluator", "seqtext_printer_evaluator",
     "classification_error_evaluator",
+    "slice_projection",
     "maxid_layer", "pooling_layer", "sequence_conv_pool",
     "bidirectional_lstm", "expand_layer", "scaling_layer",
     "simple_attention", "gru_step_layer",
@@ -280,11 +281,30 @@ class identity_projection(_Projection):
                        starts=[self.offset], ends=[self.offset + size])
 
 
+class slice_projection(_Projection):
+    """Concat of index ranges from the input (SliceProjection.cpp): for a
+    conv output the slices select CHANNEL ranges, else feature ranges."""
+
+    def __init__(self, input, slices):
+        super().__init__(input)
+        self.slices = list(slices)
+
+    def build(self, size=0):
+        x = self.input
+        axis = 1 if (x.shape is not None and len(x.shape) == 4) else \
+            (len(x.shape) - 1 if x.shape else -1)
+        parts = [L.slice(x, axes=[axis], starts=[s], ends=[e])
+                 for s, e in self.slices]
+        return parts[0] if len(parts) == 1 else L.concat(parts, axis=axis)
+
+
 class dotmul_projection(_Projection):
     """y = x . w (per-feature scale, layers.py:722)."""
 
     def build(self, size):
         x = self.input
+        if not size:
+            size = x.shape[-1]      # projection-inferred mixed/concat
         helper = LayerHelper("dotmul_proj", param_attr=self.param_attr)
         w = helper.create_parameter(self.param_attr, shape=[size],
                                     dtype=x.dtype)
@@ -348,9 +368,16 @@ class MixedLayerType:
             helper = LayerHelper("mixed_bias")
             battr = self.bias_attr if isinstance(self.bias_attr, ParamAttr) \
                 else ParamAttr()
-            b = helper.create_parameter(battr, shape=[self.size],
+            # size=0 (projection-inferred mixed, e.g. conv projections):
+            # bias per channel for 4-D outputs, per feature otherwise
+            if out.shape is not None and len(out.shape) == 4:
+                bsize, axis = out.shape[1], 1
+            else:
+                bsize = self.size or (out.shape[-1] if out.shape else 1)
+                axis = -1
+            b = helper.create_parameter(battr, shape=[bsize],
                                         dtype=out.dtype, is_bias=True)
-            out = L.elementwise_add(out, b, axis=-1)
+            out = L.elementwise_add(out, b, axis=axis)
         a = _act_name(self.act)
         if a:
             out = getattr(L, a)(out)
