@@ -443,5 +443,5 @@ def _flash_attention_op(ctx, ins, attrs):
     return {"Out": flash_attention(
         q, k, v,
         causal=attrs.get("causal", False),
-        block_q=attrs.get("block_q", 512),
-        block_k=attrs.get("block_k", 512))}
+        block_q=attrs.get("block_q", 1024),   # swept best at 16k, D=64
+        block_k=attrs.get("block_k", 1024))}
