@@ -65,6 +65,8 @@ __all__ = [
     "maxid_layer", "pooling_layer", "sequence_conv_pool",
     "bidirectional_lstm", "expand_layer", "scaling_layer",
     "simple_attention", "gru_step_layer",
+    "power_layer", "slope_intercept_layer", "sum_to_one_norm_layer",
+    "cos_sim", "trans_layer", "repeat_layer", "seq_reshape_layer",
 ]
 
 
@@ -554,7 +556,9 @@ from .sequence import (  # noqa: E402
     seqtext_printer_evaluator, classification_error_evaluator, track_layer,
     slice_projection,
     maxid_layer, pooling_layer, sequence_conv_pool, bidirectional_lstm,
-    expand_layer, scaling_layer, simple_attention, gru_step_layer)
+    expand_layer, scaling_layer, simple_attention, gru_step_layer,
+    power_layer, slope_intercept_layer, sum_to_one_norm_layer, cos_sim,
+    trans_layer, repeat_layer, seq_reshape_layer)
 
 
 # ---------------------------------------------------------------------------

@@ -41,6 +41,8 @@ __all__ = [
     "maxid_layer", "pooling_layer", "sequence_conv_pool",
     "bidirectional_lstm", "expand_layer", "scaling_layer",
     "simple_attention", "gru_step_layer",
+    "power_layer", "slope_intercept_layer", "sum_to_one_norm_layer",
+    "cos_sim", "trans_layer", "repeat_layer", "seq_reshape_layer",
 ]
 
 
@@ -705,3 +707,44 @@ def gru_step_layer(input, output_mem, size=None, act=None, gate_act=None,
         activation=_act_name(act) or "tanh",
         gate_activation=_act_name(gate_act) or "sigmoid")
     return track_layer(name, hidden)
+
+
+# ---------------------------------------------------------------------------
+# thin v1 layer wrappers over existing ops (layers.py: power:2142,
+# slope_intercept:5237, sum_to_one_norm:3288, cos_sim:2315, trans:2230,
+# repeat:1914, seq_reshape:1980)
+# ---------------------------------------------------------------------------
+def power_layer(input, weight, name=None, **kw):
+    """out = x ^ w with per-row scalar weight."""
+    return track_layer(name, L.elementwise_pow(input, weight))
+
+
+def slope_intercept_layer(input, slope=1.0, intercept=0.0, name=None, **kw):
+    return track_layer(name, L.scale(input, scale=float(slope),
+                                     bias=float(intercept)))
+
+
+def sum_to_one_norm_layer(input, name=None, **kw):
+    s = L.reduce_sum(input, dim=[-1], keep_dim=True)
+    return track_layer(name, L.elementwise_div(input, s))
+
+
+def cos_sim(a, b, scale=1, name=None, **kw):
+    out = L.cos_sim(a, b)
+    if scale != 1:
+        out = L.scale(out, scale=float(scale))
+    return track_layer(name, out)
+
+
+def trans_layer(input, name=None, **kw):
+    return track_layer(name, L.transpose(input, [1, 0]))
+
+
+def repeat_layer(input, num_repeats, name=None, **kw):
+    """Repeat each feature column num_repeats times ([B, D] -> [B, D*n])."""
+    reps = [input] * num_repeats
+    return track_layer(name, L.concat(reps, axis=1))
+
+
+def seq_reshape_layer(input, reshape_size, name=None, **kw):
+    return track_layer(name, L.sequence_reshape(input, reshape_size))

@@ -370,3 +370,32 @@ def test_data_feeder_nested_sequences(rng):
     vals = [float(exe.run(cfg.main_program, feed=feeds,
                           fetch_list=[loss])[0]) for _ in range(6)]
     assert np.isfinite(vals).all() and vals[-1] < vals[0]
+
+
+def test_thin_v1_layer_wrappers(rng):
+    """Smoke + numeric checks for the thin v1 wrappers (power,
+    slope_intercept, sum_to_one_norm, cos_sim, trans, repeat)."""
+    from paddle_tpu import trainer_config_helpers as dsl
+    import paddle_tpu.layers as L
+
+    a = L.data("a", shape=[4], dtype="float32")
+    b = L.data("b", shape=[4], dtype="float32")
+    si = dsl.slope_intercept_layer(a, slope=2.0, intercept=1.0)
+    norm = dsl.sum_to_one_norm_layer(a)
+    cs = dsl.cos_sim(a, b, scale=3)
+    tr = dsl.trans_layer(a)
+    rep = dsl.repeat_layer(a, 3)
+    exe = pt.Executor()
+    av = rng.rand(2, 4).astype("float32") + 0.1
+    bv = rng.rand(2, 4).astype("float32") + 0.1
+    si_v, n_v, c_v, t_v, r_v = exe.run(
+        pt.default_main_program(), feed={"a": av, "b": bv},
+        fetch_list=[si, norm, cs, tr, rep])
+    np.testing.assert_allclose(si_v, 2 * av + 1, rtol=1e-6)
+    np.testing.assert_allclose(n_v, av / av.sum(1, keepdims=True),
+                               rtol=1e-5)
+    want_cs = 3 * (av * bv).sum(1) / (np.linalg.norm(av, axis=1) *
+                                      np.linalg.norm(bv, axis=1))
+    np.testing.assert_allclose(np.ravel(c_v), want_cs, rtol=1e-5)
+    assert t_v.shape == (4, 2)
+    assert r_v.shape == (2, 12)
