@@ -37,6 +37,10 @@ class LayerHelper:
         attr = ParamAttr._to_attr(attr)
         if attr is None:
             return None
+        from .param_attr import WeightNormParamAttr
+        if isinstance(attr, WeightNormParamAttr) and not is_bias:
+            return self._create_weight_normed(attr, shape, dtype,
+                                              default_initializer)
         suffix = "b" if is_bias else "w"
         name = attr.name or unique_name.generate(f"{self.name}.{suffix}")
         init = attr.initializer or default_initializer or (
@@ -55,6 +59,27 @@ class LayerHelper:
                            persistable=True)
         init(sv, sb)
         return p
+
+    def _create_weight_normed(self, attr, shape, dtype,
+                              default_initializer):
+        """WeightNormParamAttr: trainable direction v and magnitude g with
+        w = g * v/||v|| recomputed in-graph every step (fluid
+        param_attr.py WeightNormParamAttr semantics)."""
+        from .param_attr import ParamAttr as _PA
+        base = _PA(name=attr.name, initializer=attr.initializer,
+                   learning_rate=attr.learning_rate,
+                   regularizer=attr.regularizer, trainable=attr.trainable,
+                   gradient_clip=attr.gradient_clip, sharding=attr.sharding)
+        v = self.create_parameter(base, shape, dtype,
+                                  default_initializer=default_initializer)
+        dim = attr.dim
+        g_shape = [shape[dim]] if dim is not None else [1]
+        g_attr = _PA(name=(attr.name + ".g") if attr.name else None,
+                     initializer=ConstantInitializer(1.0),
+                     learning_rate=attr.learning_rate,
+                     trainable=attr.trainable)
+        g = self.create_parameter(g_attr, g_shape, dtype)
+        return _append_weight_norm_ops(self, v, g, dim, shape, dtype)
 
     def create_variable_for_type_inference(self, dtype, shape=None,
                                            lod_level=0) -> Variable:
@@ -122,3 +147,30 @@ class LayerHelper:
         if isinstance(v, (list, tuple)):
             v = v[0]
         return v.dtype
+
+
+def _append_weight_norm_ops(helper, v, g, dim, shape, dtype):
+    """Emit w = g * v / ||v|| (norm over all dims except ``dim``) into the
+    main program; grads flow to v and g via autodiff (fluid emulated this
+    with a chain of norm/elementwise ops too, param_attr.py WeightNormParamAttr)."""
+    sq = helper.create_variable_for_type_inference(dtype, tuple(shape))
+    helper.append_op(type="square", inputs={"X": [v]},
+                     outputs={"Out": [sq]}, attrs={})
+    reduce_dims = [i for i in range(len(shape)) if i != (dim or 0)] \
+        if dim is not None else list(range(len(shape)))
+    norm_shape = [shape[dim]] if dim is not None else [1]
+    ssum = helper.create_variable_for_type_inference(dtype, tuple(norm_shape))
+    helper.append_op(type="reduce_sum", inputs={"X": [sq]},
+                     outputs={"Out": [ssum]},
+                     attrs={"dim": reduce_dims, "keep_dim": False})
+    norm = helper.create_variable_for_type_inference(dtype, tuple(norm_shape))
+    helper.append_op(type="sqrt", inputs={"X": [ssum]},
+                     outputs={"Out": [norm]}, attrs={})
+    scale = helper.create_variable_for_type_inference(dtype, tuple(norm_shape))
+    helper.append_op(type="elementwise_div", inputs={"X": [g], "Y": [norm]},
+                     outputs={"Out": [scale]}, attrs={"axis": -1})
+    w = helper.create_variable_for_type_inference(dtype, tuple(shape))
+    helper.append_op(type="elementwise_mul", inputs={"X": [v], "Y": [scale]},
+                     outputs={"Out": [w]},
+                     attrs={"axis": dim if dim is not None else 0})
+    return w

@@ -76,4 +76,12 @@ class ParamAttr:
         return kw
 
 
-WeightNormParamAttr = ParamAttr  # parity alias (weight-norm TODO)
+class WeightNormParamAttr(ParamAttr):
+    """Weight normalization (fluid param_attr.py WeightNormParamAttr):
+    the layer's weight is reparameterized as w = g * v/||v|| with the
+    direction v and per-``dim`` magnitude g trained independently; the
+    normalize runs in-graph every step (LayerHelper emits the ops)."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim

@@ -180,3 +180,34 @@ def test_trace_error_names_offending_op():
                 fetch_list=[bad])
     notes = getattr(ei.value, "__notes__", [])
     assert any("elementwise_add" in n for n in notes), notes
+
+
+def test_weight_norm_param_attr(rng):
+    """WeightNormParamAttr reparameterizes w = g * v/||v|| (per output
+    column) and trains both pieces — the direction stays unit-norm in
+    effect because g carries the magnitude."""
+    import pytest
+    from paddle_tpu.param_attr import WeightNormParamAttr
+
+    x = layers.data("x", shape=[6], dtype="float32")
+    t = layers.data("t", shape=[1], dtype="float32")
+    y = layers.fc(x, size=1, bias_attr=False,
+                  param_attr=WeightNormParamAttr(dim=1, name="wn"))
+    loss = layers.mean(layers.square_error_cost(y, t))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    assert pt.global_scope().has("wn") and pt.global_scope().has("wn.g")
+    feeds = {"x": rng.rand(8, 6).astype("float32"),
+             "t": rng.rand(8, 1).astype("float32")}
+    vals = [float(exe.run(pt.default_main_program(), feed=feeds,
+                          fetch_list=[loss])[0]) for _ in range(10)]
+    assert np.isfinite(vals).all() and vals[-1] < vals[0]
+    # the effective weight equals g * v/||v||
+    v = np.asarray(pt.global_scope().get("wn"))
+    g = np.asarray(pt.global_scope().get("wn.g"))
+    yv, = exe.run(pt.default_main_program(), feed=feeds, fetch_list=[y],
+                  is_test=True)
+    w_eff = g * v / np.linalg.norm(v, axis=0, keepdims=True)
+    np.testing.assert_allclose(yv, feeds["x"] @ w_eff, rtol=1e-4,
+                               atol=1e-5)
