@@ -46,7 +46,7 @@ __all__ = [
     "STanhActivation", "ExpActivation", "AbsActivation",
     "SquareActivation", "BReluActivation", "SoftReluActivation",
     "MaxPooling", "AvgPooling", "SumPooling",
-    "CudnnMaxPooling", "CudnnAvgPooling",
+    "CudnnMaxPooling", "CudnnAvgPooling", "ExpandLevel", "AggregateLevel",
     "MomentumOptimizer", "AdamOptimizer", "AdaGradOptimizer",
     "RMSPropOptimizer", "AdaDeltaOptimizer",
     "L1Regularization", "L2Regularization", "ModelAverage",
@@ -67,6 +67,7 @@ __all__ = [
     "simple_attention", "gru_step_layer",
     "power_layer", "slope_intercept_layer", "sum_to_one_norm_layer",
     "cos_sim", "trans_layer", "repeat_layer", "seq_reshape_layer",
+    "print_layer",
 ]
 
 
@@ -242,6 +243,21 @@ class SumPooling:
 
 CudnnMaxPooling = MaxPooling     # cudnn variants are layout hints on TPU
 CudnnAvgPooling = AvgPooling
+
+
+class ExpandLevel:
+    """v1 expand_layer levels (layers.py ExpandLevel)."""
+    FROM_NO_SEQUENCE = 0
+    FROM_SEQUENCE = 1
+    FROM_TIMESTEP = FROM_NO_SEQUENCE
+
+
+class AggregateLevel:
+    """v1 pooling/agg levels (layers.py AggregateLevel)."""
+    TO_NO_SEQUENCE = 0
+    TO_SEQUENCE = 1
+    EACH_TIMESTEP = TO_NO_SEQUENCE
+    EACH_SEQUENCE = TO_SEQUENCE
 
 
 class MomentumOptimizer:
@@ -558,7 +574,7 @@ from .sequence import (  # noqa: E402
     maxid_layer, pooling_layer, sequence_conv_pool, bidirectional_lstm,
     expand_layer, scaling_layer, simple_attention, gru_step_layer,
     power_layer, slope_intercept_layer, sum_to_one_norm_layer, cos_sim,
-    trans_layer, repeat_layer, seq_reshape_layer)
+    trans_layer, repeat_layer, seq_reshape_layer, print_layer)
 
 
 # ---------------------------------------------------------------------------

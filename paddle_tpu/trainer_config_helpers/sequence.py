@@ -43,6 +43,7 @@ __all__ = [
     "simple_attention", "gru_step_layer",
     "power_layer", "slope_intercept_layer", "sum_to_one_norm_layer",
     "cos_sim", "trans_layer", "repeat_layer", "seq_reshape_layer",
+    "print_layer",
 ]
 
 
@@ -196,7 +197,11 @@ def recurrent_group(step, input, name=None, reverse=False, **kw):
                     ipt.lod_level = 1     # each step is itself a sequence
                     args.append(ipt)
                 elif not isinstance(it, StaticInput):
-                    args.append(rnn.step_input(it))
+                    ipt = rnn.step_input(it)
+                    if hasattr(it, "v1_size"):
+                        ipt.v1_size = it.v1_size   # id inputs keep their
+                        #                            vocab for embeddings
+                    args.append(ipt)
                 else:
                     args.append(None)
             for i, it in enumerate(items):
@@ -748,3 +753,13 @@ def repeat_layer(input, num_repeats, name=None, **kw):
 
 def seq_reshape_layer(input, reshape_size, name=None, **kw):
     return track_layer(name, L.sequence_reshape(input, reshape_size))
+
+
+def print_layer(input, name=None, format=None, **kw):
+    """v1 PrintLayer diagnostic: logs values at run time (print op)."""
+    items = input if isinstance(input, (list, tuple)) else [input]
+    helper = LayerHelper("print", name=name)
+    for v in items:
+        helper.append_op(type="print", inputs={"In": [v]}, outputs={},
+                         attrs={"message": format or f"{v.name}:"})
+    return items[0] if len(items) == 1 else items

@@ -399,3 +399,37 @@ def test_thin_v1_layer_wrappers(rng):
     np.testing.assert_allclose(np.ravel(c_v), want_cs, rtol=1e-5)
     assert t_v.shape == (4, 2)
     assert r_v.shape == (2, 12)
+
+
+@pytest.mark.parametrize("conf", ["sequence_lstm.conf",
+                                  "sequence_recurrent.py",
+                                  "sequence_recurrent_group.py",
+                                  "sequence_rnn_multi_input.conf"])
+def test_more_gserver_sequence_configs_train(conf, rng):
+    """Additional gserver sequence configs VERBATIM: lstmemory forms, the
+    recurrent layer vs group equivalence pair, and a multi-input
+    recurrent_group whose step embeds the raw ids (step vars keep their
+    vocab metadata)."""
+    cwd = os.getcwd()
+    os.chdir(PADDLE)   # configs read dict files relative to paddle/
+    try:
+        cfg = load_v1_config(os.path.join(PADDLE, "gserver/tests", conf))
+    finally:
+        os.chdir(cwd)
+    B, T = 3, 5
+    feeds = {}
+    for nm, v in cfg.data_layers.items():
+        if v.dtype == np.dtype("int64"):
+            if v.lod_level:
+                vocab = getattr(v, "v1_size", 10) or 10
+                feeds[nm] = rng.randint(0, min(vocab, 100),
+                                        (B, T)).astype("int64")
+                feeds[nm + "@LEN"] = np.full(B, T)
+            else:
+                feeds[nm] = rng.randint(0, 3, (B, 1)).astype("int64")
+        else:
+            dims = [int(d) for d in (v.shape or (1,))[1:] if d and d > 0]
+            feeds[nm] = rng.rand(B, *dims).astype("float32")
+    vals = _train_steps(cfg, feeds, n=6)
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0]
