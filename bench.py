@@ -5,6 +5,20 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} for the
 headline metric, with the seq2seq number carried in "extra_metrics" on the
 same line (the driver records the whole object).
 
+Methodology (pinned, round 4 — see benchmark/RESULTS.md "Methodology"):
+- ONE compiled step variant per model: every call uses the same fetch_list
+  ([loss], return_numpy=False).  With auto_layout the [] and [loss]
+  variants pick different parameter layouts, so mixing them corrupts the
+  donated state (measured: InvalidArgument on the 3rd step).
+- Long timing windows: each timed window enqueues >=80 steps and ends in
+  one loss-scalar readback (the only reliable barrier over the axon
+  tunnel).  Short windows under-report by 5-10%: the queue drain/refill
+  around each barrier costs a fixed ~200 ms, and 30-step windows eat it
+  as ~2 ms/step.
+- Median of N windows: the tunnel occasionally delivers a 1.7x-slow
+  window (external contention); the median is stable to ~1-2% where
+  single windows swing 15%.
+
 Baselines: the reference's best published ResNet-50 *training* number is
 82.35 img/s (batch 128) on a 2x20-core Skylake with MKL-DNN
 (benchmark/IntelOptimizedPaddle.md:39-45 — no GPU ResNet-50 number exists
@@ -16,6 +30,7 @@ baseline going forward.
 from __future__ import annotations
 
 import json
+import statistics
 import sys
 import time
 
@@ -23,8 +38,28 @@ import numpy as np
 
 BASELINE_IMG_S = 82.35
 BATCH = 128
-WARMUP = 5
-ITERS = 30
+
+
+def _median_window_throughput(exe, prog, feeds, loss, units_per_step,
+                              warmup, iters, reps):
+    """Pinned timing core: warm up, then `reps` windows of `iters` steps
+    each (single compiled variant, one readback barrier per window);
+    returns (median_throughput, spread) where spread = (max-min)/median
+    across windows."""
+    for _ in range(warmup):
+        (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
+                        return_numpy=False)
+    assert np.isfinite(float(lv))   # block: warmup fully executed
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
+                            return_numpy=False)
+        assert np.isfinite(float(lv))
+        rates.append(units_per_step * iters / (time.perf_counter() - t0))
+    med = statistics.median(rates)
+    return med, (max(rates) - min(rates)) / med
 
 
 def main():
@@ -53,31 +88,14 @@ def main():
         rng.rand(BATCH, 3, 224, 224).astype("float32")),
         "label": jax.device_put(rng.randint(0, 1000, (BATCH, 1)))}
 
-    # ONE compiled step variant (same fetch_list every call): fetch the loss
-    # but keep it on device (return_numpy=False) — no per-step readback, and
-    # auto_layout's pinned parameter layouts hold for the whole run
     prog = pt.default_main_program()
-    for _ in range(WARMUP):
-        (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
-                        return_numpy=False)
-    assert np.isfinite(float(lv))   # block: warmup fully executed
+    img_s, spread = _median_window_throughput(
+        exe, prog, feeds, loss, units_per_step=BATCH,
+        warmup=5, iters=80, reps=3)
 
-    # enqueue all steps (the device serializes them through the donated
-    # state dependency), then read ONE loss scalar: a single host readback
-    # is a true execution barrier — block_until_ready is unreliable over the
-    # tunnel, and a per-step readback would add ~70ms tunnel latency/step
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
-                        return_numpy=False)
-    assert np.isfinite(float(lv))
-    elapsed = time.perf_counter() - t0
-
-    img_s = BATCH * ITERS / elapsed
-
-    tok_s = None
+    tok_s = tok_spread = None
     try:
-        tok_s = _seq2seq_tokens_per_sec()
+        tok_s, tok_spread = _seq2seq_tokens_per_sec()
     except Exception:
         pass                       # headline metric still reports
 
@@ -86,6 +104,7 @@ def main():
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "window_spread": round(spread, 4),
     }
     if tok_s is not None:
         line["extra_metrics"] = [{
@@ -93,14 +112,15 @@ def main():
             "value": round(tok_s, 1),
             "unit": "tokens/s",
             "vs_baseline": None,   # reference unpublished (BASELINE.md)
+            "window_spread": round(tok_spread, 4),
         }]
     print(json.dumps(line))
 
 
-def _seq2seq_tokens_per_sec(batch=64, warmup=3, iters=15):
+def _seq2seq_tokens_per_sec(batch=64):
     """seq2seq+attention training tokens/s (benchmark/run.py seq2seq
-    config; same enqueue-then-single-readback methodology as the headline
-    metric)."""
+    config; same pinned single-variant median-of-windows methodology as
+    the headline metric)."""
     import jax
 
     import paddle_tpu as pt
@@ -133,17 +153,10 @@ def _seq2seq_tokens_per_sec(batch=64, warmup=3, iters=15):
     exe = pt.Executor(amp=True)
     exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
     prog = pt.default_main_program()
-    for _ in range(warmup):
-        (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
-                        return_numpy=False)
-    assert np.isfinite(float(lv))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
-                        return_numpy=False)
-    assert np.isfinite(float(lv))
-    elapsed = time.perf_counter() - t0
-    return batch * (src_len + tgt_len) * iters / elapsed
+    return _median_window_throughput(
+        exe, prog, feeds, loss,
+        units_per_step=batch * (src_len + tgt_len),
+        warmup=6, iters=150, reps=5)
 
 
 if __name__ == "__main__":

@@ -111,7 +111,9 @@ def _build_seq2seq(batch, src_len=30, tgt_len=30, vocab=30000, dim=512):
     return loss, feeds, batch * (src_len + tgt_len)
 
 
-def run_config(name, batch, amp=True, warmup=3, iters=10):
+def run_config(name, batch, amp=True, warmup=5, iters=None, reps=3):
+    import statistics
+
     import jax
     import paddle_tpu as pt
 
@@ -133,19 +135,42 @@ def run_config(name, batch, amp=True, warmup=3, iters=10):
     exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
     feeds = {k: jax.device_put(v) for k, v in feeds.items()}
     prog = pt.default_main_program()
+    # Pinned methodology (see RESULTS.md): ONE compiled variant throughout
+    # (same fetch_list every call, loss kept on device), long windows ending
+    # in a single scalar readback (the only reliable tunnel barrier), median
+    # of `reps` windows.  Short windows under-report: the drain/refill
+    # around each barrier costs a fixed ~200 ms.
     for _ in range(warmup):
-        exe.run(prog, feed=feeds, fetch_list=[loss])
-        exe.run(prog, feed=feeds, fetch_list=[], return_numpy=False)
-    # enqueue all steps (device serializes them via the state dependency),
-    # then fetch ONE loss: a single host readback instead of per-step tunnel
-    # round-trips — the per-step sync would otherwise dominate small models
-    t0 = time.perf_counter()
-    for _ in range(iters - 1):
-        exe.run(prog, feed=feeds, fetch_list=[], return_numpy=False)
-    (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss])
+        (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
+                        return_numpy=False)
     assert np.isfinite(float(lv))
-    dt = (time.perf_counter() - t0) / iters
-    thr = units / dt
+    if iters is None:
+        # size the window to ~2s of device time: difference two probe
+        # windows (1 step vs 21 steps, each ending in a barrier) so the
+        # fixed ~200ms barrier cost cancels out of the per-step estimate
+        t0 = time.perf_counter()
+        (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
+                        return_numpy=False)
+        float(lv)
+        dt1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(21):
+            (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
+                            return_numpy=False)
+        float(lv)
+        per_step = max((time.perf_counter() - t0 - dt1) / 20, 1e-4)
+        iters = max(60, int(2.0 / per_step))
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
+                            return_numpy=False)
+        assert np.isfinite(float(lv))
+        rates.append(units * iters / (time.perf_counter() - t0))
+    thr = statistics.median(rates)
+    spread = (max(rates) - min(rates)) / thr
+    dt = units / thr
     ref_ms = REF_MS.get((name, batch))
     ref_thr = REF_IMG_S.get((name, batch))
     if ref_thr is None and ref_ms is not None:
@@ -154,7 +179,8 @@ def run_config(name, batch, amp=True, warmup=3, iters=10):
            "ms_per_batch": round(dt * 1e3, 2),
            "throughput": round(thr, 1), "unit": unit,
            "ref": ref_thr, "amp": amp,
-           "speedup": round(thr / ref_thr, 2) if ref_thr else None}
+           "speedup": round(thr / ref_thr, 2) if ref_thr else None,
+           "window_spread": round(spread, 4)}
     print(json.dumps(out), flush=True)
     return out
 
@@ -168,7 +194,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None)
     ap.add_argument("--batch", type=int, default=64)
-    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--iters", type=int, default=None,
+                    help="steps per timed window (default: auto-size to "
+                         "~2s of device time)")
     ap.add_argument("--amp", action="store_true", default=True)
     ap.add_argument("--no-amp", dest="amp", action="store_false")
     ap.add_argument("--all", action="store_true")

@@ -36,6 +36,7 @@ from .core import (
     default_main_program,
     default_startup_program,
     program_guard,
+    pipeline_stage,
     unique_name,
     Executor,
     Scope,

@@ -6,6 +6,7 @@ from .program import (
     Program, Block, Operator, Variable, Parameter,
     default_main_program, default_startup_program, program_guard,
     reset_default_programs, grad_var_name, GRAD_SUFFIX, LEN_SUFFIX,
+    pipeline_stage,
 )
 from .registry import register_op, get_op_impl, has_op, registered_ops
 from .scope import Scope, global_scope, scope_guard, reset_global_scope
@@ -19,6 +20,7 @@ __all__ = [
     "Program", "Block", "Operator", "Variable", "Parameter",
     "default_main_program", "default_startup_program", "program_guard",
     "reset_default_programs", "grad_var_name", "GRAD_SUFFIX", "LEN_SUFFIX",
+    "pipeline_stage",
     "register_op", "get_op_impl", "has_op", "registered_ops",
     "Scope", "global_scope", "scope_guard", "reset_global_scope",
     "Executor", "Place", "CPUPlace", "TPUPlace", "CUDAPlace",

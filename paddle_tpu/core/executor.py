@@ -130,14 +130,28 @@ class Env:
 # ---------------------------------------------------------------------------
 class LoweringContext:
     def __init__(self, program: Program, base_key, is_test: bool = False,
-                 amp: bool = False):
+                 amp: bool = False, mesh=None,
+                 pipeline_microbatches: Optional[int] = None):
         self.program = program
         self.base_key = base_key      # traced PRNG key folding in the step
         self.is_test = is_test
         self.amp = amp
+        # mesh set by ShardedExecutor: op lowerings may consult it to place
+        # sharding constraints (moe) or lower staged regions (pipeline)
+        self.mesh = mesh
+        self.pipeline_microbatches = pipeline_microbatches
         self.op: Optional[Operator] = None
         self.env: Optional[Env] = None
         self._op_uid = 0
+
+    @property
+    def pp_size(self) -> int:
+        return self.mesh_axis_size("pp")
+
+    def mesh_axis_size(self, axis: str) -> int:
+        if self.mesh is None or axis not in self.mesh.axis_names:
+            return 1
+        return self.mesh.shape[axis]
 
     def rng(self, offset: int = 0):
         """Per-op-instance PRNG key: stable across steps in structure, varied
@@ -234,8 +248,30 @@ def run_op(op: Operator, env: Env, ctx: LoweringContext):
 
 
 def interpret_ops(ops: Sequence[Operator], env: Env, ctx: LoweringContext):
+    if ctx.pp_size > 1 and any("pipeline_stage" in op.attrs for op in ops):
+        _interpret_ops_pipelined(ops, env, ctx)
+        return
     for op in ops:
         run_op(op, env, ctx)
+
+
+def _interpret_ops_pipelined(ops: Sequence[Operator], env: Env,
+                             ctx: LoweringContext):
+    """Interpret a block whose ops carry ``pipeline_stage`` attrs: the
+    contiguous staged region lowers as a GPipe shard_map over the 'pp' mesh
+    axis; everything around it interprets normally (GSPMD-sharded)."""
+    from ..parallel.pipeline_program import lower_pipeline_region
+    i = 0
+    while i < len(ops):
+        if "pipeline_stage" in ops[i].attrs:
+            j = i
+            while j < len(ops) and "pipeline_stage" in ops[j].attrs:
+                j += 1
+            lower_pipeline_region(ops[i:j], env, ctx)
+            i = j
+        else:
+            run_op(ops[i], env, ctx)
+            i += 1
 
 
 def interpret_block_with_backward(block: Block, env: Env, ctx: LoweringContext):
@@ -481,6 +517,10 @@ class Executor:
 
         amp = self.amp
         check_nan = self.check_nan_inf
+        # ShardedExecutor sets these: the mesh reaches op lowerings through
+        # the LoweringContext (moe sharding constraints, pipeline regions)
+        lowering_mesh = getattr(self, "mesh", None)
+        microbatches = getattr(self, "num_microbatches", None)
         has_backward = any(op.type == "backward"
                            for op in program.global_block().ops)
 
@@ -494,7 +534,8 @@ class Executor:
                 # pure-inference AMP: whole net computes in bf16
                 env.local = {k: _to_bf16(v) for k, v in env.local.items()}
             ctx = LoweringContext(program, base_key, is_test=is_test,
-                                  amp=amp)
+                                  amp=amp, mesh=lowering_mesh,
+                                  pipeline_microbatches=microbatches)
             interpret_block_with_backward(program.global_block(), env, ctx)
             fetches = [env.get(n) if env.has(n) else None for n in fetch_names]
             if check_nan:

@@ -210,6 +210,34 @@ def _to_name_list(v) -> List[str]:
     return [v.name if isinstance(v, Variable) else str(v)]
 
 
+_pipeline_stage_stack: List[int] = []
+
+
+def _current_pipeline_stage():
+    return _pipeline_stage_stack[-1] if _pipeline_stage_stack else None
+
+
+@contextlib.contextmanager
+def pipeline_stage(stage: int):
+    """Declare that ops appended inside this context belong to pipeline
+    stage ``stage`` (attr ``pipeline_stage`` on each op).
+
+    The Program-level analog of the reference's per-layer device placement
+    (ParallelNeuralNetwork.cpp whole-layer device pinning, v1 ``deviceId_``)
+    — but instead of pinning to a physical device, the stage index maps onto
+    the 'pp' mesh axis: a ShardedExecutor whose mesh has pp>1 lowers the
+    contiguous staged region as a GPipe pipeline under shard_map
+    (parallel/pipeline_program.py); any other executor ignores the attr and
+    runs the ops in program order, which is numerically identical for
+    per-sample stages.
+    """
+    _pipeline_stage_stack.append(int(stage))
+    try:
+        yield
+    finally:
+        _pipeline_stage_stack.pop()
+
+
 class Block:
     """vars + ops, with a parent for nested control flow
     (reference: framework.py:595, block_desc.h).  Sub-blocks hold the bodies
@@ -270,6 +298,12 @@ class Block:
     # -- ops ---------------------------------------------------------------
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type, inputs, outputs, attrs)
+        # input-less ops are excluded: parameter initializers emitted into
+        # the STARTUP program by layers built inside a pipeline_stage
+        # context must not carry the attr (the startup run has no pipeline)
+        if _current_pipeline_stage() is not None and \
+                "pipeline_stage" not in op.attrs and op.inputs:
+            op.attrs["pipeline_stage"] = _current_pipeline_stage()
         self.ops.append(op)
         for ns in op.outputs.values():
             for n in ns:

@@ -7,7 +7,8 @@ reference formats when a path is given and (b) deterministic synthetic
 generators with the same reader protocol and shapes, so every demo/benchmark
 script runs unchanged.  Swap in real data by pointing the loader at files.
 """
-from . import mnist, cifar, imdb, imikolov, movielens, uci_housing, conll05
+from . import (mnist, cifar, imdb, imikolov, movielens, uci_housing,
+               conll05, wmt14)
 
 __all__ = ["mnist", "cifar", "imdb", "imikolov", "movielens", "uci_housing",
-           "conll05"]
+           "conll05", "wmt14"]

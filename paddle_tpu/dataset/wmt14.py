@@ -1,0 +1,145 @@
+"""WMT14 EN→FR machine-translation reader (reference:
+v2/dataset/wmt14.py — shrunk wmt14.tgz with src.dict/trg.dict + tab-
+separated parallel train/test/gen files; samples are (src_ids, trg_ids,
+trg_ids_next) with <s>/<e> framing and UNK_IDX=2, sequences >80 tokens
+dropped).
+
+Offline CI uses a deterministic synthetic parallel corpus whose target is
+a learnable function of the source (reversal in a shifted vocab), so the
+book-style NMT test trains and beam-decodes hermetically; the real archive
+parses when the cache holds it (``download=True``)."""
+from __future__ import annotations
+
+import tarfile
+
+import numpy as np
+
+from .common import cached_path
+
+__all__ = ["train", "test", "gen", "build_dict", "get_dict"]
+
+URL_TRAIN = ("http://paddlepaddle.cdn.bcebos.com/demo/wmt_shrinked_data/"
+             "wmt14.tgz")
+MD5_TRAIN = "0791583d57d5beb693b9414c5b36798c"
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+MAX_LEN = 80
+
+_DICT_MEMO = {}
+
+
+def _archive(do_download=False):
+    return cached_path(URL_TRAIN, "wmt14", MD5_TRAIN, do_download)
+
+
+def _read_dicts(tar_path, dict_size):
+    """First ``dict_size`` lines of src.dict / trg.dict (wmt14.py:45)."""
+    key = (tar_path, dict_size)
+    if key in _DICT_MEMO:
+        return _DICT_MEMO[key]
+
+    def to_dict(fd, size):
+        out = {}
+        for i, line in enumerate(fd):
+            if i >= size:
+                break
+            out[line.strip().decode("utf-8", errors="ignore")] = i
+        return out
+
+    with tarfile.open(tar_path, mode="r") as f:
+        src_name = [m.name for m in f if m.name.endswith("src.dict")]
+        trg_name = [m.name for m in f if m.name.endswith("trg.dict")]
+        assert len(src_name) == 1 and len(trg_name) == 1
+        src = to_dict(f.extractfile(src_name[0]), dict_size)
+        trg = to_dict(f.extractfile(trg_name[0]), dict_size)
+    _DICT_MEMO[key] = (src, trg)
+    return src, trg
+
+
+def _tar_reader(tar_path, file_name, dict_size):
+    """Yield (src_ids, trg_ids, trg_ids_next) from the tab-separated
+    parallel file (wmt14.py:71): source framed <s>...<e>, target input
+    <s>-prefixed, target label <e>-suffixed, >80-token pairs dropped."""
+    def reader():
+        src_dict, trg_dict = _read_dicts(tar_path, dict_size)
+        with tarfile.open(tar_path, mode="r") as f:
+            names = [m.name for m in f if m.name.endswith(file_name)]
+            for name in names:
+                for line in f.extractfile(name):
+                    parts = line.decode(
+                        "utf-8", errors="ignore").strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_ids = [src_dict.get(w, UNK_IDX) for w in
+                               [START] + parts[0].split() + [END]]
+                    trg_words = parts[1].split()
+                    trg_ids = [trg_dict.get(w, UNK_IDX) for w in trg_words]
+                    if len(src_ids) > MAX_LEN or len(trg_ids) > MAX_LEN:
+                        continue
+                    trg_next = trg_ids + [trg_dict[END]]
+                    trg_ids = [trg_dict[START]] + trg_ids
+                    yield src_ids, trg_ids, trg_next
+    return reader
+
+
+def _synthetic_parallel(n, dict_size, seed):
+    """Deterministic offline corpus: target = reversed source shifted by
+    +3 in the shared id space — a real (if easy) translation function, so
+    training cost falls and beam decode can be scored against the known
+    mapping."""
+    def reader():
+        r = np.random.RandomState(seed)
+        start, end = 0, 1
+        for _ in range(n):
+            L = int(r.randint(3, 9))
+            body = r.randint(3, dict_size - 3, L).tolist()
+            src = [start] + body + [end]
+            trg_body = [(t + 3) % (dict_size - 3) + 3
+                        for t in reversed(body)]
+            yield src, [start] + trg_body, trg_body + [end]
+    return reader
+
+
+def train(dict_size, download=False):
+    """Training reader: (src_ids, trg_ids, trg_ids_next) (wmt14.py:105)."""
+    path = _archive(download)
+    if path is None:
+        return _synthetic_parallel(2000, dict_size, seed=140)
+    return _tar_reader(path, "train/train", dict_size)
+
+
+def test(dict_size, download=False):
+    path = _archive(download)
+    if path is None:
+        return _synthetic_parallel(200, dict_size, seed=141)
+    return _tar_reader(path, "test/test", dict_size)
+
+
+def gen(dict_size, download=False):
+    """Generation split (wmt14.py:136)."""
+    path = _archive(download)
+    if path is None:
+        return _synthetic_parallel(50, dict_size, seed=142)
+    return _tar_reader(path, "gen/gen", dict_size)
+
+
+def build_dict(dict_size, download=False):
+    """(src_dict, trg_dict) word→id (first dict_size entries)."""
+    path = _archive(download)
+    if path is None:
+        d = {START: 0, END: 1, UNK: 2}
+        d.update({f"w{i}": i for i in range(3, dict_size)})
+        return dict(d), dict(d)
+    return _read_dicts(path, dict_size)
+
+
+def get_dict(dict_size, reverse=True, download=False):
+    """id→word (or word→id with reverse=False) pair (wmt14.py:149)."""
+    src, trg = build_dict(dict_size, download)
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

@@ -31,7 +31,7 @@ __all__ = [
     "elementwise_pow", "pad", "roi_pool", "smooth_l1", "bilinear_interp",
     "warpctc", "linear_chain_crf", "crf_decoding", "label_smooth",
     "autoincreased_step_counter",
-    "flash_attention",
+    "flash_attention", "moe",
     "log_loss", "hinge_loss", "huber_loss", "square_error_cost", "rank_loss",
     "margin_rank_loss", "squared_l2_distance", "squared_l2_norm",
     "kldiv_loss", "modified_huber_loss", "bilinear_tensor_product",
@@ -1209,6 +1209,47 @@ def flash_attention(q, k, v, causal=False, block_q=512, block_k=512,
                      attrs={"causal": causal, "block_q": block_q,
                             "block_k": block_k})
     return out
+
+
+def moe(input, num_experts, expert_hidden, top_k=2, capacity_factor=1.25,
+        act="relu", gate_attr=None, param_attr=None, name=None):
+    """Mixture-of-Experts FFN (GShard/Switch style) — the Program-level
+    expert-parallel layer (ops/moe_ops.py).
+
+    input: [B, D] or [B, T, D].  Expert weights are created stacked
+    [E, D, H]/[E, H, D] with ``sharding=('ep', None, None)``, so a
+    ShardedExecutor over a mesh with an 'ep' axis physically distributes
+    the experts and GSPMD inserts the token all-to-all; a plain Executor
+    runs the identical math on one device.  Returns (out, aux_loss) —
+    add ``aux_weight * aux_loss`` to the training loss to keep experts
+    load-balanced.
+    """
+    helper = LayerHelper("moe", param_attr=param_attr, name=name)
+    D = input.shape[-1]
+    gate_w = helper.create_parameter(
+        gate_attr, shape=[D, num_experts], dtype=input.dtype)
+    import copy as _copy
+    pa = _copy.copy(param_attr) if param_attr is not None else ParamAttr()
+    if getattr(pa, "sharding", None) is None:
+        pa.sharding = ("ep", None, None)
+    w1 = helper.create_parameter(
+        pa, shape=[num_experts, D, expert_hidden], dtype=input.dtype)
+    pa2 = ParamAttr(sharding=pa.sharding)
+    w2 = helper.create_parameter(
+        pa2, shape=[num_experts, expert_hidden, D], dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, input.shape, lod_level=input.lod_level)
+    aux = helper.create_variable_for_type_inference("float32", ())
+    helper.append_op(type="moe",
+                     inputs={"X": [input], "GateW": [gate_w],
+                             "W1": [w1], "W2": [w2]},
+                     outputs={"Out": [out], "AuxLoss": [aux]},
+                     attrs={"top_k": top_k,
+                            "capacity_factor": capacity_factor,
+                            "activation": act})
+    if input.lod_level:
+        _copy_len(helper, input, out)
+    return out, aux
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,233 @@
+"""Lower a ``pipeline_stage``-annotated Program region onto the 'pp' mesh
+axis as a GPipe pipeline — the first-class framework path to pipeline
+parallelism.
+
+Users declare stages in the Paddle-style API::
+
+    with pt.pipeline_stage(0):
+        h = layers.fc(x, 256, act='relu')
+    with pt.pipeline_stage(1):
+        h = layers.fc(h, 256, act='relu')
+
+Every op appended inside the context carries a ``pipeline_stage`` attr
+(core/program.py).  A plain ``Executor`` ignores the attr and runs the ops
+in program order — numerically identical for per-sample stages, which is
+exactly what the equivalence test asserts.  A ``ShardedExecutor`` whose
+mesh has pp>1 routes the contiguous staged region here
+(core/executor.py ``interpret_ops``) and lowers it as:
+
+* one ``jax.shard_map`` manual over ONLY the 'pp' axis (``axis_names=
+  {'pp'}``) — dp/tp/sp/ep stay GSPMD-managed, so dp x pp composes without
+  hand-sharding the batch;
+* inside, a lax.scan over (microbatches + stages - 1) ticks; each device
+  runs its own stage via ``lax.switch`` on ``axis_index('pp')`` and
+  activations hop stages with ``ppermute`` — differentiable end to end, so
+  ``jax.value_and_grad`` through the region yields correct per-stage
+  parameter gradients (the psum from the shard_map transpose of the
+  replicated-in params zeroes out the stages a device didn't run);
+* stage bodies are the op lowerings themselves, interpreted per stage —
+  the same code path as single-device execution.
+
+Reference capability frame: ParallelNeuralNetwork.cpp pins whole layers to
+devices and pipelines activations through queues (SURVEY §2.6 "Model
+parallelism (v1)"; trainer/Flags.cpp:30 --parallel_nn); here the schedule
+is a compiled scan and the backward falls out of autodiff instead of
+hand-managed backward queues.
+
+Constraints (validated with actionable errors): the staged region must be
+contiguous, stage ids 0..S-1 in non-decreasing program order with S equal
+to the mesh 'pp' size; exactly one non-persistable activation enters the
+region; every inter-stage activation (and the region output) must share
+one shape/dtype (the ppermute ring buffer is a single static-shape
+tensor); the global batch must divide the microbatch count.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["lower_pipeline_region"]
+
+
+def _persistable(ctx, name: str) -> bool:
+    for b in ctx.program.blocks:
+        if name in b.vars:
+            return bool(b.vars[name].persistable)
+    return False
+
+
+def _group_stages(ops: Sequence) -> List[List]:
+    """Split region ops into per-stage lists; stage ids must be
+    non-decreasing 0..S-1 in program order."""
+    stages: List[List] = []
+    last = -1
+    for op in ops:
+        s = int(op.attrs["pipeline_stage"])
+        if s < last:
+            raise ValueError(
+                f"pipeline_stage ids must be non-decreasing in program "
+                f"order; op {op.type!r} has stage {s} after stage {last}")
+        if s == last:
+            stages[-1].append(op)
+        else:
+            if s != last + 1:
+                raise ValueError(
+                    f"pipeline_stage ids must be consecutive from 0; "
+                    f"found stage {s} after {last}")
+            stages.append([op])
+            last = s
+    return stages
+
+
+def lower_pipeline_region(ops: Sequence, env, ctx) -> None:
+    """Lower one contiguous staged region (see module docstring).  Binds
+    the region's output var in ``env``; region-internal intermediates are
+    not materialized outside the pipeline."""
+    from ..core.executor import Env, run_op
+
+    mesh = ctx.mesh
+    S = ctx.pp_size
+    stages = _group_stages(ops)
+    if len(stages) != S:
+        raise ValueError(
+            f"program declares {len(stages)} pipeline stages but the mesh "
+            f"'pp' axis has size {S}; they must match (declare stages with "
+            f"pt.pipeline_stage(i) for i in range({S}))")
+
+    produced = {n for op in ops for n in op.output_names}
+    # region inputs in first-use order
+    ext_inputs: List[str] = []
+    for op in ops:
+        for n in op.input_names:
+            if n not in produced and n not in ext_inputs:
+                ext_inputs.append(n)
+    acts = [n for n in ext_inputs if not _persistable(ctx, n)]
+    if len(acts) != 1:
+        raise ValueError(
+            f"a pipeline region must consume exactly one non-persistable "
+            f"activation; found {acts or 'none'} (persistable parameters "
+            f"are captured per stage automatically)")
+    act_in = acts[0]
+
+    # per-stage: captured external inputs + the inter-stage boundary vars
+    stage_caps: List[List[str]] = []
+    stage_in: List[str] = [act_in]
+    for i, sops in enumerate(stages):
+        sprod = {n for op in sops for n in op.output_names}
+        sins = []
+        for op in sops:
+            for n in op.input_names:
+                if n not in sprod and n != stage_in[i] and n not in sins:
+                    sins.append(n)
+        bad = [n for n in sins if not _persistable(ctx, n)
+               and n not in ext_inputs]
+        # vars produced by EARLIER stages (not the immediate boundary) would
+        # skip a pipeline hop — unsupported by the single ring buffer
+        if bad:
+            raise ValueError(
+                f"stage {i} consumes {bad}, produced by a non-adjacent "
+                f"stage; pipeline stages must form a chain (each stage "
+                f"reads only the previous stage's output)")
+        stage_caps.append(sins)
+        if i < len(stages) - 1:
+            cons_next = {n for op in stages[i + 1]
+                         for n in op.input_names}
+            boundary = [n for n in sprod if n in cons_next]
+            if len(boundary) != 1:
+                raise ValueError(
+                    f"exactly one activation must flow from stage {i} to "
+                    f"stage {i + 1}; found {boundary or 'none'}")
+            stage_in.append(boundary[0])
+    # region output: last stage's product that isn't consumed inside it
+    last_prod = [n for op in stages[-1] for n in op.output_names]
+    last_cons = {n for op in stages[-1] for n in op.input_names}
+    tail = [n for n in last_prod if n not in last_cons]
+    out_name = tail[-1] if tail else last_prod[-1]
+    stage_out = stage_in[1:] + [out_name]
+
+    block = ops[0].block
+
+    def make_stage_fn(i):
+        sops = stages[i]
+        in_name, o_name = stage_in[i], stage_out[i]
+
+        def f(caps: Dict[str, object], x):
+            senv = Env(block)
+            senv.local.update(caps)
+            senv.local[in_name] = x
+            for op in sops:
+                run_op(op, senv, ctx)
+            return senv.get(o_name)
+
+        return f
+
+    stage_fns = [make_stage_fn(i) for i in range(S)]
+    caps_tuple = tuple({n: env.get(n) for n in stage_caps[i]}
+                       for i in range(S))
+    x_val = env.get(act_in)
+
+    M = int(ctx.pipeline_microbatches or S)
+    B = x_val.shape[0]
+    if B % M != 0:
+        raise ValueError(
+            f"num_microbatches={M} must divide the global batch {B} "
+            f"(ShardedExecutor(num_microbatches=...))")
+    mb = B // M
+
+    # validate: every inter-stage activation and the output share one
+    # shape/dtype — the ppermute ring buffer is one static tensor
+    aval = jax.ShapeDtypeStruct((mb,) + tuple(x_val.shape[1:]), x_val.dtype)
+    outs_avals = []
+    for i in range(S):
+        aval = jax.eval_shape(stage_fns[i], caps_tuple[i], aval)
+        outs_avals.append(aval)
+    uniform = {(a.shape, str(a.dtype)) for a in outs_avals}
+    if len(uniform) != 1:
+        raise ValueError(
+            f"pipeline stages must produce one common activation "
+            f"shape/dtype (the inter-stage ring buffer is static); got "
+            f"{[(stage_out[i], outs_avals[i].shape, str(outs_avals[i].dtype)) for i in range(S)]}")
+    y_aval = outs_avals[-1]
+
+    perm = [(d, (d + 1) % S) for d in range(S)]
+
+    def region_fn(caps, x):
+        idx = lax.axis_index("pp")
+        xs = x.reshape((M, mb) + tuple(x.shape[1:]))
+
+        def tick(carry, t):
+            buf, outs = carry
+            x0 = xs[jnp.clip(t, 0, M - 1)]
+
+            def branch(i):
+                # stage 0 reads the injected microbatch, others the ring
+                return lambda args: stage_fns[i](
+                    caps[i], args[0] if i == 0 else args[1])
+
+            y = lax.switch(idx, [branch(i) for i in range(S)], (x0, buf))
+            slot = t - (S - 1)
+            valid = (idx == S - 1) & (slot >= 0)
+            slot_c = jnp.clip(slot, 0, M - 1)
+            cur = lax.dynamic_index_in_dim(outs, slot_c, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, y, cur), slot_c, 0)
+            return (lax.ppermute(y, "pp", perm), outs), None
+
+        buf0 = jnp.zeros(y_aval.shape, y_aval.dtype)
+        outs0 = jnp.zeros((M,) + y_aval.shape, y_aval.dtype)
+        (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(M + S - 1))
+        # only the last stage holds real results; psum broadcasts them so
+        # the region output is replicated over pp
+        outs = lax.psum(
+            jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)), "pp")
+        return outs.reshape((B,) + tuple(y_aval.shape[1:]))
+
+    caps_specs = jax.tree.map(lambda _: P(), caps_tuple)
+    y = jax.shard_map(
+        region_fn, mesh=mesh, in_specs=(caps_specs, P()), out_specs=P(),
+        axis_names=frozenset({"pp"}), check_vma=False)(caps_tuple, x_val)
+    env.set(out_name, y)

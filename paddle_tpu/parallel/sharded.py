@@ -40,12 +40,16 @@ class ShardedExecutor(Executor):
 
     def __init__(self, mesh: Optional[Mesh] = None, batch_axis: str = "dp",
                  feed_specs: Optional[Dict[str, P]] = None,
-                 param_specs: Optional[Dict[str, P]] = None, **kw):
+                 param_specs: Optional[Dict[str, P]] = None,
+                 num_microbatches: Optional[int] = None, **kw):
         super().__init__(**kw)
         self.mesh = mesh or get_mesh()
         self.batch_axis = batch_axis
         self.feed_specs = dict(feed_specs or {})
         self.param_specs = dict(param_specs or {})
+        # GPipe microbatch count for pipeline_stage-annotated programs
+        # (parallel/pipeline_program.py); default = the 'pp' axis size
+        self.num_microbatches = num_microbatches
 
     # -- sharding selection -------------------------------------------------
     def _find_var(self, program: Program, name: str):
