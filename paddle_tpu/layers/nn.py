@@ -31,7 +31,8 @@ __all__ = [
     "elementwise_pow", "pad", "roi_pool", "smooth_l1", "bilinear_interp",
     "warpctc", "linear_chain_crf", "crf_decoding", "label_smooth",
     "autoincreased_step_counter",
-    "flash_attention", "moe",
+    "flash_attention", "moe", "conv3d", "pool3d", "multiplex", "crop",
+    "spp", "prelu", "sampling_id",
     "log_loss", "hinge_loss", "huber_loss", "square_error_cost", "rank_loss",
     "margin_rank_loss", "squared_l2_distance", "squared_l2_norm",
     "kldiv_loss", "modified_huber_loss", "bilinear_tensor_product",
@@ -262,8 +263,12 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 
 
 def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
-                     padding=0, stride=1, dilation=1, param_attr=None,
-                     bias_attr=None, act=None, name=None):
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    if groups not in (None, 1):
+        raise NotImplementedError(
+            "conv2d_transpose groups>1: no reference demo uses it; "
+            "split channels + concat as a workaround")
     helper = LayerHelper("conv2d_transpose", param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     dtype = input.dtype
@@ -1208,6 +1213,131 @@ def flash_attention(q, k, v, causal=False, block_q=512, block_k=512,
                      outputs={"Out": [out]},
                      attrs={"causal": causal, "block_q": block_q,
                             "block_k": block_k})
+    return out
+
+
+def _triple(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v, v, v]
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    """3-D convolution, NCDHW (reference conv3d path of conv_op.cc)."""
+    helper = LayerHelper("conv3d", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    fs, st, pd, dl = (_triple(filter_size), _triple(stride),
+                      _triple(padding), _triple(dilation))
+    n, c = input.shape[0], input.shape[1]
+    w = helper.create_parameter(
+        param_attr, shape=[num_filters, c // groups] + fs, dtype=dtype)
+    dims = [_conv_out(input.shape[2 + i], fs[i], pd[i], st[i], dl[i])
+            for i in range(3)]
+    out = helper.create_variable_for_type_inference(
+        dtype, (n, num_filters) + tuple(dims))
+    helper.append_op(type="conv3d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": st, "paddings": pd, "dilations": dl,
+                            "groups": groups})
+    if helper.kwargs.get("bias_attr") is not False:
+        b = helper.create_parameter(
+            ParamAttr._to_attr(bias_attr) or ParamAttr(),
+            shape=[num_filters], dtype=dtype, is_bias=True)
+        out2 = helper.create_variable_for_type_inference(dtype, out.shape)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [out2]}, attrs={"axis": 1})
+        out = out2
+    return helper.append_activation(out)
+
+
+def pool3d(input, pool_size=2, pool_type="max", pool_stride=None,
+           pool_padding=0, global_pooling=False, name=None):
+    """3-D pooling, NCDHW (reference pool3d path of pool_op.cc)."""
+    helper = LayerHelper("pool3d", name=name)
+    ks = _triple(pool_size)
+    st = _triple(pool_stride if pool_stride is not None else pool_size)
+    pd = _triple(pool_padding)
+    n, c = input.shape[0], input.shape[1]
+    if global_pooling:
+        dims = (1, 1, 1)
+    else:
+        dims = tuple(_conv_out(input.shape[2 + i], ks[i], pd[i], st[i])
+                     for i in range(3))
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (n, c) + dims)
+    helper.append_op(type="pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": ks,
+                            "strides": st, "paddings": pd,
+                            "global_pooling": global_pooling})
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    """fluid multiplex: per-row select among candidate tensors by index."""
+    helper = LayerHelper("multiplex", name=name)
+    out = helper.create_variable_for_type_inference(
+        inputs[0].dtype, inputs[0].shape)
+    helper.append_op(type="multiplex",
+                     inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def crop(x, shape, offsets=None, name=None):
+    """fluid crop: static-offset window (crop_op.cc)."""
+    helper = LayerHelper("crop", name=name)
+    offsets = offsets or [0] * len(shape)
+    out = helper.create_variable_for_type_inference(x.dtype, tuple(shape))
+    helper.append_op(type="crop", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "offsets": list(offsets)})
+    return out
+
+
+def spp(input, pyramid_height=3, pool_type="max", name=None):
+    """Spatial pyramid pooling (spp_op.cc): concat of 4**level bins."""
+    helper = LayerHelper("spp", name=name)
+    n, c = input.shape[0], input.shape[1]
+    bins = sum(4 ** lv for lv in range(pyramid_height))
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (n, c * bins))
+    helper.append_op(type="spp", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pyramid_height": pyramid_height,
+                            "pooling_type": pool_type})
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    """Learned negative slope (prelu_op.cc): mode all/channel/element."""
+    from .. import initializer
+    helper = LayerHelper("prelu", param_attr=param_attr, name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = list(x.shape[1:])
+    alpha = helper.create_parameter(
+        param_attr if param_attr is not None else
+        ParamAttr(initializer=initializer.Constant(0.25)),
+        shape=alpha_shape, dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    return out
+
+
+def sampling_id(x, name=None):
+    """Sample one id per row from row probabilities (sampling_id_op)."""
+    helper = LayerHelper("sampling_id", name=name)
+    out = helper.create_variable_for_type_inference(
+        "int64", (x.shape[0],))
+    helper.append_op(type="sampling_id", inputs={"X": [x]},
+                     outputs={"Out": [out]})
     return out
 
 

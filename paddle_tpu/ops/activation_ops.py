@@ -104,10 +104,13 @@ def _hard_sigmoid(ctx, ins, attrs):
 
 @register_op("prelu")
 def _prelu(ctx, ins, attrs):
-    """prelu_op: per-channel (or shared) learned negative slope."""
+    """prelu_op: learned negative slope — mode all (scalar), channel
+    (alpha [C], x [N,C,...]) or element (alpha = x.shape[1:])."""
     x = ins["X"][0]
     alpha = ins["Alpha"][0]
-    if alpha.size > 1 and x.ndim >= 2:
-        # channel mode: alpha shaped [C], x [N, C, ...]
-        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    if alpha.size > 1:
+        if alpha.ndim == x.ndim - 1:            # element mode
+            alpha = alpha.reshape((1,) + alpha.shape)
+        else:                                   # channel mode
+            alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
     return {"Out": jnp.where(x >= 0, x, alpha * x)}

@@ -152,6 +152,33 @@ def _pool2d(ctx, ins, attrs):
     return {"Out": out}
 
 
+@register_op("pool3d")
+def _pool3d(ctx, ins, attrs):
+    """pool3d_op (pool_op.cc 3-D branch): NCDHW max/avg pooling."""
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        ks = list(x.shape[2:])
+        strides, pads = ks, [0, 0, 0]
+    else:
+        ks = list(attrs.get("ksize", [2, 2, 2]))
+        strides = list(attrs.get("strides", ks))
+        pads = list(attrs.get("paddings", [0, 0, 0]))
+    window = (1, 1) + tuple(ks)
+    stride = (1, 1) + tuple(strides)
+    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, stride, pad)
+    else:
+        s = lax.reduce_window(x, 0.0, lax.add, window, stride, pad)
+        ones = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                 stride, pad)
+        out = s / ones
+    return {"Out": out}
+
+
 @register_op("max_pool2d_with_index", "pool2d_with_index")
 def _max_pool2d_with_index(ctx, ins, attrs):
     """pool_with_index_op: returns flat H*W indices of maxima (for unpool).

@@ -169,6 +169,106 @@ def _sequence_concat(ctx, ins, attrs):
     return {"Out": out}
 
 
+@register_op("sequence_context")
+def _sequence_context(ctx, ins, attrs):
+    """v1 ContextProjection without the matmul: [B,T,D] -> [B,T,ctx_len*D]
+    concat of shifted timesteps (function/ContextProjectionOp.cpp).  With
+    a PadW input ([begin_pad+end_pad, D], trainable), out-of-range
+    positions read the learned boundary rows instead of zeros — the
+    reference's trainable_padding path."""
+    x = ins["X"][0]
+    pad_w = ins.get("PadW", [None])[0]
+    lens = _seq_lens_or_full(ctx, x)
+    ctx_len = attrs.get("contextLength", 3)
+    start = attrs.get("contextStart", -(ctx_len // 2))
+    begin_pad = max(0, -start)
+    B, T, D = x.shape
+    m = _mask(lens, T, x.dtype)[..., None]
+    xm = x * m
+    t = jnp.arange(T)
+    cols = []
+    for j in range(ctx_len):
+        shift = start + j
+        src = t + shift                                   # [T]
+        base = jnp.take(xm, jnp.clip(src, 0, T - 1), axis=1)  # [B,T,D]
+        under = (src < 0)[None, :, None]
+        over = (src[None, :] >= lens[:, None])[..., None]
+        if pad_w is not None:
+            total = pad_w.shape[0]
+            u_idx = jnp.clip(begin_pad + src, 0, total - 1)
+            u_rows = pad_w[u_idx][None, :, :].astype(x.dtype)
+            o_idx = jnp.clip(begin_pad + (src[None, :] - lens[:, None]),
+                             0, total - 1)
+            o_rows = pad_w[o_idx].astype(x.dtype)
+            col = jnp.where(under, u_rows, base)
+            col = jnp.where(over, o_rows, col)
+        else:
+            col = jnp.where(under | over, jnp.zeros_like(base), base)
+        cols.append(col)
+    out = jnp.concatenate(cols, axis=-1) * m
+    ctx.set_len(ctx.op.outputs["Out"][0], lens)
+    return {"Out": out}
+
+
+@register_op("sub_nested_seq")
+def _sub_nested_seq(ctx, ins, attrs):
+    """SubNestedSequenceLayer.cpp: select subsequences of a level-2
+    sequence [B,S,T,...] by per-batch indices [B,K].  Invalid indices
+    (<0, the kmax_seq_score pad, or >=S) contribute zero rows and are
+    excluded from the output lengths, so downstream sequence ops mask
+    them as padding."""
+    x = ins["X"][0]
+    sel = ins["Selection"][0].astype(jnp.int32)
+    if sel.ndim == 1:
+        sel = sel[:, None]
+    S = x.shape[1]
+    valid = (sel >= 0) & (sel < S)                      # [B, K]
+    safe = jnp.clip(sel, 0, S - 1)
+    idx = safe.reshape(safe.shape + (1,) * (x.ndim - 2))
+    out = jnp.take_along_axis(x, idx, axis=1)
+    vmask = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+    out = out * vmask.astype(x.dtype)
+    lens2 = ctx.get_len2(ctx.op.inputs["X"][0])
+    if lens2 is not None:
+        ctx.set_len2(ctx.op.outputs["Out"][0],
+                     jnp.take_along_axis(lens2, safe, axis=1) *
+                     valid.astype(lens2.dtype))
+    ctx.set_len(ctx.op.outputs["Out"][0],
+                jnp.sum(valid, axis=1).astype(jnp.int32))
+    return {"Out": out}
+
+
+@register_op("conv2d_dynamic_filter")
+def _conv2d_dynamic_filter(ctx, ins, attrs):
+    """v1 conv_operator: convolution whose FILTER is another layer's
+    output (ConvOperator.cpp).  The filter layer yields one filter set
+    PER SAMPLE ([B, O*I*kh*kw]); lowered as one grouped conv by folding
+    the batch into channels (feature_group_count=B) — stays a single MXU
+    conv instead of a python loop over samples."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    O, I, kh, kw = attrs["filter_shape"]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    pads = tuple(attrs.get("paddings", [0, 0]))
+    B = x.shape[0]
+    w = w.astype(x.dtype)
+    if w.ndim == 2 and w.shape[0] == B and w.size == B * O * I * kh * kw:
+        # per-sample filters: x [B,C,H,W] -> [1,B*C,H,W], w -> [B*O,I,kh,kw]
+        xg = x.reshape((1, B * x.shape[1]) + x.shape[2:])
+        wg = w.reshape(B * O, I, kh, kw)
+        out = jax.lax.conv_general_dilated(
+            xg, wg, window_strides=strides,
+            padding=[(p, p) for p in pads],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=B)
+        out = out.reshape((B, O) + out.shape[2:])
+    else:
+        out = jax.lax.conv_general_dilated(
+            x, w.reshape(O, I, kh, kw), window_strides=strides,
+            padding=[(p, p) for p in pads],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Output": out}
+
+
 @register_op("sequence_conv")
 def _sequence_conv(ctx, ins, attrs):
     """sequence_conv_op: context-window projection along time

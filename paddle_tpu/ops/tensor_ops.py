@@ -226,6 +226,16 @@ def _top_k(ctx, ins, attrs):
     return {"Out": vals, "Indices": idx.astype(jnp.int64)}
 
 
+@register_op("sampling_id")
+def _sampling_id(ctx, ins, attrs):
+    """sampling_id_op (SamplingIdLayer.cpp): sample one id per row from
+    the row's probability distribution; per-step PRNG key from ctx."""
+    x = ins["X"][0]                  # [B, V] probabilities
+    logp = jnp.log(jnp.clip(x.astype(jnp.float32), 1e-20, None))
+    ids = jax.random.categorical(ctx.rng(), logp, axis=-1)
+    return {"Out": ids.astype(jnp.int64)}
+
+
 @register_op("argmax", "arg_max", "max_ids")
 def _argmax(ctx, ins, attrs):
     return {"Out": jnp.argmax(ins["X"][0], axis=attrs.get("axis", -1))

@@ -84,6 +84,7 @@ class _ConfigState:
         self.named_layers = {}
         self.evaluators = []
         self.input_order = None
+        self.defaults = {}      # default_momentum/default_decay_rate values
 
 
 _state = _ConfigState()
@@ -576,6 +577,69 @@ from .sequence import (  # noqa: E402
     power_layer, slope_intercept_layer, sum_to_one_norm_layer, cos_sim,
     trans_layer, repeat_layer, seq_reshape_layer, print_layer)
 
+# DSL tail (extra_layers.py) + networks composites (networks_extra.py):
+# appended so load_v1_config's namespace carries the full reference surface
+from .extra_layers import *        # noqa: E402,F401,F403
+from .networks_extra import *      # noqa: E402,F401,F403
+from .extra_layers import __all__ as _extra_all        # noqa: E402
+from .networks_extra import __all__ as _networks_all   # noqa: E402
+from . import layer_math           # noqa: E402  (vae_conf: layer_math.exp)
+__all__ += [n for n in list(_extra_all) + list(_networks_all)
+            if n not in __all__] + ["layer_math"]
+
+
+# -- default_decorators.py shims (model_zoo configs call these) -------------
+def default_momentum(m):
+    """default_decorators.py: the momentum Settings('momentum') uses, and
+    the fallback when settings() names no learning_method."""
+    _state.defaults["momentum"] = m
+
+
+def default_decay_rate(r):
+    """default_decorators.py: weight decay applied when settings() names
+    no regularization (consumed by make_optimizer)."""
+    _state.defaults["decay_rate"] = r
+
+
+def _default_noop(*a, **kw):
+    return None
+
+
+# initial_std/mean/strategy/smart map onto the global Xavier/defaults the
+# initializer module already applies; batch-regularization and clipping
+# are optimizer-level knobs read from settings()
+default_initial_std = default_initial_mean = _default_noop
+default_initial_strategy = default_initial_smart = _default_noop
+default_num_batches_regularization = _default_noop
+default_gradient_clipping_threshold = _default_noop
+
+def Settings(algorithm="sgd", batch_size=None, learning_rate=1e-3,
+             learning_method=None, **kw):
+    """Raw config_parser Settings() (trainer/config_parser.py) — the
+    pre-helpers API the model_zoo configs use; maps onto settings()."""
+    method_map = {"adam": AdamOptimizer, "adagrad": AdaGradOptimizer,
+                  "rmsprop": RMSPropOptimizer,
+                  "adadelta": AdaDeltaOptimizer}
+    method = learning_method
+    if isinstance(method, str):
+        if method in ("momentum", "sgd"):
+            method = MomentumOptimizer(
+                _state.defaults.get("momentum", 0.9))
+        else:
+            method = method_map.get(method, MomentumOptimizer)()
+    settings(batch_size=batch_size, learning_rate=learning_rate,
+             learning_method=method,
+             **{k: v for k, v in kw.items()
+                if k in ("regularization", "learning_rate_decay_a",
+                         "learning_rate_decay_b", "gradient_clipping_threshold")})
+
+
+__all__ += ["default_momentum", "default_decay_rate",
+            "default_initial_std", "default_initial_mean",
+            "default_initial_strategy", "default_initial_smart",
+            "default_num_batches_regularization",
+            "default_gradient_clipping_threshold", "Settings"]
+
 
 # ---------------------------------------------------------------------------
 # config loader
@@ -585,7 +649,7 @@ class V1Config:
 
     def __init__(self, main_program, startup_program, outputs, settings,
                  data_layers, data_sources, evaluators=None,
-                 named_layers=None, input_order=None):
+                 named_layers=None, input_order=None, defaults=None):
         self.main_program = main_program
         self.startup_program = startup_program
         self.outputs = outputs
@@ -595,6 +659,7 @@ class V1Config:
         self.evaluators = evaluators or []
         self.named_layers = named_layers or {}
         self.input_order = input_order
+        self.defaults = dict(defaults or {})
 
     def make_optimizer(self):
         s = self.settings
@@ -608,6 +673,8 @@ class V1Config:
             lr = lr_decay.v1_poly_decay(lr, decay_a, decay_b,
                                         s.get("batch_size") or 1)
         reg_obj = s.get("regularization")
+        if reg_obj is None and self.defaults.get("decay_rate"):
+            reg_obj = L2Regularization(self.defaults["decay_rate"])
         reg = reg_obj.make() if reg_obj is not None else None
         method = s.get("learning_method")
         if method is None:
@@ -662,4 +729,5 @@ def load_v1_config(path, **config_args):
                     dict(_state.settings), dict(_state.data_layers),
                     _state.data_sources, evaluators=list(_state.evaluators),
                     named_layers=dict(_state.named_layers),
-                    input_order=_state.input_order)
+                    input_order=_state.input_order,
+                    defaults=dict(_state.defaults))

@@ -1,0 +1,677 @@
+"""v1 DSL tail: the remaining trainer_config_helpers layer functions
+(reference: python/paddle/trainer_config_helpers/layers.py — 133 defs).
+Each wrapper adapts v1 semantics (flat sizes, Activation objects, image
+[C,H,W] recovery) onto the fluid-style layer library; cite lines refer to
+the reference layers.py.
+
+Unsupported-by-design (raise with guidance): cross_entropy_over_beam
+(beam-in-training, subsumed by the static-shape scan decoder) and
+lambda_cost (listwise LambdaRank needs per-query ragged lists; use
+rank_cost pairs instead)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import layers as L
+from ..param_attr import ParamAttr
+from .sequence import _Projection, track_layer
+
+__all__ = [
+    "bilinear_interp_layer", "block_expand_layer", "clip_layer",
+    "conv_shift_layer", "crop_layer", "cross_channel_norm_layer",
+    "cross_entropy_with_selfnorm", "ctc_layer", "detection_output_layer",
+    "dot_prod_layer", "eos_layer", "factorization_machine",
+    "gated_unit_layer", "get_output_layer", "gru_step_naive_layer",
+    "hsigmoid", "huber_classification_cost", "huber_regression_cost",
+    "img_conv3d_layer", "img_pool3d_layer", "interpolation_layer",
+    "kmax_seq_score_layer", "l2_distance_layer", "layer_support",
+    "linear_comb_layer", "lstm_step_layer", "maxout_layer",
+    "multi_binary_label_cross_entropy", "multibox_loss_layer",
+    "multiplex_layer", "nce_layer", "out_prod_layer", "pad_layer",
+    "prelu_layer", "printer_layer", "priorbox_layer", "rank_cost",
+    "resize_layer", "roi_pool_layer", "rotate_layer", "row_conv_layer",
+    "row_l2_norm_layer", "sampling_id_layer", "scale_shift_layer",
+    "scale_sub_region_layer", "selective_fc_layer", "seq_concat_layer",
+    "seq_slice_layer", "smooth_l1_cost", "spp_layer", "square_error_cost",
+    "sub_seq_layer", "sum_cost", "switch_order_layer", "tensor_layer",
+    "warp_ctc_layer", "cross_entropy_over_beam", "lambda_cost",
+    "context_projection", "dotmul_operator", "conv_operator",
+    "sub_nested_seq_layer",
+]
+
+
+def _act_name(a):
+    from . import _act_name as f
+    return f(a)
+
+
+def _as_image(input, num_channels=None):
+    from . import _as_image as f
+    if num_channels is None:
+        if input.shape is not None and len(input.shape) == 4:
+            return input
+        raise ValueError("this layer needs num_channels to recover the "
+                         "[C,H,W] image from a flat v1 data layer")
+    return f(input, num_channels)
+
+
+# -- image-shaped layers ----------------------------------------------------
+def bilinear_interp_layer(input, out_size_x, out_size_y, num_channels=None,
+                          name=None, **kw):
+    """layers.py bilinear_interp_layer: resize [C,H,W] bilinearly."""
+    img = _as_image(input, num_channels)
+    out = L.bilinear_interp(img, out_h=out_size_y, out_w=out_size_x,
+                            name=name)
+    return track_layer(name, out)
+
+
+def crop_layer(input, offset, shape=None, axis=2, name=None, **kw):
+    """layers.py crop_layer (static offsets form)."""
+    full = list(input.shape)
+    offs = [0] * len(full)
+    for i, o in enumerate(offset):
+        offs[axis + i] = o
+    if shape is None:
+        raise ValueError("crop_layer needs an explicit shape")
+    tgt = list(full[:axis]) + list(shape[axis - len(shape):]) \
+        if len(shape) < len(full) else list(shape)
+    tgt[0] = full[0]
+    out = L.crop(input, shape=tgt, offsets=offs, name=name)
+    return track_layer(name, out)
+
+
+def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None, **kw):
+    """layers.py pad_layer: zero-pad channel/height/width of [B,C,H,W]."""
+    pc, ph, pw = (pad_c or [0, 0]), (pad_h or [0, 0]), (pad_w or [0, 0])
+    paddings = [0, 0, pc[0], pc[1], ph[0], ph[1], pw[0], pw[1]]
+    out = L.pad(input, paddings=paddings, name=name)
+    return track_layer(name, out)
+
+
+def rotate_layer(input, height, width, num_channels=None, name=None, **kw):
+    """layers.py rotate_layer: 90° counter-clockwise rotation of each
+    [C,H,W] map (transpose + reverse rows)."""
+    img = input
+    if input.shape is None or len(input.shape) != 4:
+        ch = num_channels or 1
+        img = L.reshape(input, [-1, ch, height, width])
+    t = L.transpose(img, perm=[0, 1, 3, 2])
+    from ..layers.tensor import reverse
+    out = reverse(t, axis=2)
+    return track_layer(name, out)
+
+
+def switch_order_layer(input, reshape_axis=None, name=None, **kw):
+    """layers.py switch_order_layer: NCHW <-> NHWC."""
+    out = L.transpose(input, perm=[0, 2, 3, 1], name=name)
+    return track_layer(name, out)
+
+
+def resize_layer(input, size, name=None, **kw):
+    """layers.py resize_layer: reshape rows to the given flat size."""
+    out = L.reshape(input, [-1, size], name=name)
+    return track_layer(name, out)
+
+
+def cross_channel_norm_layer(input, name=None, param_attr=None, **kw):
+    """layers.py cross_channel_norm_layer (SSD L2Norm): per-pixel L2
+    normalization across channels with a learned per-channel scale."""
+    normed = L.l2_normalize(input, axis=1)
+    sc = scale_shift_layer(normed, per_channel=True, bias=False,
+                           param_attr=param_attr)
+    return track_layer(name, sc)
+
+
+def spp_layer(input, num_channels=None, pyramid_height=3, pool_type=None,
+              name=None, **kw):
+    """layers.py spp_layer (SpatialPyramidPoolLayer.cpp)."""
+    img = _as_image(input, num_channels)
+    ptype = pool_type.ptype if pool_type is not None else "max"
+    out = L.spp(img, pyramid_height=pyramid_height, pool_type=ptype,
+                name=name)
+    return track_layer(name, out)
+
+
+def maxout_layer(input, groups, num_channels=None, name=None, **kw):
+    img = _as_image(input, num_channels)
+    out = L.maxout(img, groups=groups, name=name)
+    return track_layer(name, out)
+
+
+def roi_pool_layer(input, rois, pooled_width, pooled_height,
+                   spatial_scale=1.0, num_channels=None, name=None, **kw):
+    img = _as_image(input, num_channels)
+    out = L.roi_pool(img, rois, pooled_height=pooled_height,
+                     pooled_width=pooled_width,
+                     spatial_scale=spatial_scale, name=name)
+    return track_layer(name, out)
+
+
+def img_conv3d_layer(input, filter_size, num_filters, num_channels=None,
+                     stride=1, padding=0, groups=1, act=None, name=None,
+                     param_attr=None, bias_attr=None, **kw):
+    """layers.py img_conv3d_layer: NCDHW conv (conv3d_op)."""
+    out = L.conv3d(input, num_filters=num_filters, filter_size=filter_size,
+                   stride=stride, padding=padding, groups=groups,
+                   act=_act_name(act), param_attr=param_attr,
+                   bias_attr=bias_attr, name=name)
+    return track_layer(name, out)
+
+
+def img_pool3d_layer(input, pool_size, stride=1, padding=0, pool_type=None,
+                     name=None, **kw):
+    ptype = pool_type.ptype if pool_type is not None else "max"
+    out = L.pool3d(input, pool_size=pool_size, pool_type=ptype,
+                   pool_stride=stride, pool_padding=padding, name=name)
+    return track_layer(name, out)
+
+
+def block_expand_layer(input, block_x, block_y, stride_x=1, stride_y=1,
+                       padding_x=0, padding_y=0, num_channels=None,
+                       name=None, **kw):
+    """layers.py block_expand_layer (BlockExpandLayer.cpp = im2sequence)."""
+    img = _as_image(input, num_channels)
+    out = L.im2sequence(img, filter_size=[block_y, block_x],
+                        stride=[stride_y, stride_x],
+                        padding=[padding_y, padding_x], name=name)
+    return track_layer(name, out)
+
+
+def prelu_layer(input, name=None, partial_sum=1, param_attr=None, **kw):
+    mode = "all" if partial_sum in (None, 0) or \
+        (input.shape and partial_sum == int(np.prod(input.shape[1:]))) \
+        else "channel" if input.shape and len(input.shape) == 4 else "all"
+    out = L.prelu(input, mode=mode, param_attr=param_attr, name=name)
+    return track_layer(name, out)
+
+
+# -- elementwise / algebra --------------------------------------------------
+def clip_layer(input, min, max, name=None, **kw):  # noqa: A002
+    return track_layer(name, L.clip(input, min=float(min), max=float(max),
+                                    name=name))
+
+
+def dot_prod_layer(input1, input2, name=None, **kw):
+    """layers.py dot_prod_layer: per-row inner product."""
+    out = L.reduce_sum(L.elementwise_mul(input1, input2), dim=-1,
+                       keep_dim=True)
+    return track_layer(name, out)
+
+
+def out_prod_layer(input1, input2, name=None, **kw):
+    return track_layer(name, L.outer_prod(input1, input2, name=name))
+
+
+def l2_distance_layer(x, y, name=None, **kw):
+    d = L.elementwise_sub(x, y)
+    out = L.reduce_sum(L.elementwise_mul(d, d), dim=-1, keep_dim=True)
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("sqrt", name=name)
+    o = helper.create_variable_for_type_inference(out.dtype, out.shape)
+    helper.append_op(type="sqrt", inputs={"X": [out]},
+                     outputs={"Out": [o]})
+    return track_layer(name, o)
+
+
+def row_l2_norm_layer(input, name=None, **kw):
+    return track_layer(name, L.l2_normalize(input, axis=-1, name=name))
+
+
+def linear_comb_layer(weights, vectors, size=None, name=None, **kw):
+    """layers.py linear_comb_layer: rows of ``vectors`` [B, M*size] grouped
+    into M vectors of ``size``, combined with weights [B, M]."""
+    size = size or vectors.shape[-1] // weights.shape[-1]
+    M = weights.shape[-1]
+    v = L.reshape(vectors, [-1, M, size])
+    w = L.reshape(weights, [-1, M, 1])
+    out = L.reduce_sum(L.elementwise_mul(v, w), dim=1)
+    return track_layer(name, out)
+
+
+def interpolation_layer(input, weight, name=None, **kw):
+    a, b = input
+    return track_layer(name, L.interpolation(weight, a, b, name=name))
+
+
+def conv_shift_layer(a, b, name=None, **kw):
+    return track_layer(name, L.conv_shift(a, b, name=name))
+
+
+def tensor_layer(a, b, size, act=None, name=None, param_attr=None,
+                 bias_attr=None, **kw):
+    """layers.py tensor_layer = bilinear tensor product."""
+    out = L.bilinear_tensor_product(a, b, size, act=_act_name(act),
+                                    param_attr=param_attr,
+                                    bias_attr=bias_attr, name=name)
+    return track_layer(name, out)
+
+
+def factorization_machine(input, factor_size, name=None, param_attr=None,
+                          **kw):
+    out = L.factorization_machine(input, factor_size,
+                                  param_attr=param_attr, name=name)
+    return track_layer(name, out)
+
+
+def scale_shift_layer(input, name=None, param_attr=None, bias_attr=None,
+                      per_channel=False, bias=True, **kw):
+    """layers.py scale_shift_layer: y = w * x + b with learned scalar (or
+    per-channel, for cross_channel_norm) w and b."""
+    from .. import initializer
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("scale_shift", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    if per_channel:
+        c = input.shape[1]
+        shape, axis = [c], 1
+    else:
+        shape, axis = [1], -1
+    w = helper.create_parameter(
+        param_attr if param_attr is not None else
+        ParamAttr(initializer=initializer.Constant(1.0)),
+        shape=shape, dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op(type="elementwise_mul",
+                     inputs={"X": [input], "Y": [w]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    if bias:
+        b = helper.create_parameter(
+            ParamAttr._to_attr(bias_attr) or ParamAttr(),
+            shape=shape, dtype=input.dtype, is_bias=True)
+        out2 = helper.create_variable_for_type_inference(
+            input.dtype, out.shape)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [out2]}, attrs={"axis": axis})
+        out = out2
+    return track_layer(name, out)
+
+
+def scale_sub_region_layer(input, indices, value, name=None, **kw):
+    out = L.scale_sub_region(input, indices, value, name=name)
+    return track_layer(name, out)
+
+
+def multiplex_layer(input, name=None, **kw):
+    """layers.py multiplex_layer: input[0] is the int selector."""
+    return track_layer(name, L.multiplex(list(input[1:]), input[0],
+                                         name=name))
+
+
+def gated_unit_layer(input, size, act=None, name=None, gate_attr=None,
+                     gate_param_attr=None, gate_bias_attr=None,
+                     inproj_attr=None, inproj_param_attr=None,
+                     inproj_bias_attr=None, **kw):
+    """layers.py gated_unit_layer: fc(input) * sigmoid(fc_gate(input))."""
+    proj = L.fc(input, size=size, act=_act_name(act),
+                param_attr=inproj_param_attr, bias_attr=inproj_bias_attr)
+    gate = L.fc(input, size=size, act="sigmoid",
+                param_attr=gate_param_attr, bias_attr=gate_bias_attr)
+    return track_layer(name, L.elementwise_mul(proj, gate, name=name))
+
+
+def selective_fc_layer(input, size, select=None, act=None, name=None,
+                       param_attr=None, bias_attr=None,
+                       has_selected_colums=True, **kw):
+    """layers.py selective_fc_layer: full fc; with a 0/1 ``select`` matrix
+    only the selected output columns survive.  (The reference's sparse
+    col-compute is a CPU-cache optimization; under XLA the dense matmul +
+    mask is the faster lowering on the MXU.)"""
+    out = L.fc(input, size=size, act=_act_name(act), param_attr=param_attr,
+               bias_attr=bias_attr)
+    if select is not None:
+        out = L.elementwise_mul(out, select)
+    return track_layer(name, out)
+
+
+# -- mixed_layer projections / operators ------------------------------------
+class context_projection(_Projection):
+    """layers.py context_projection: concat of context_len shifted
+    timesteps (function/ContextProjectionOp.cpp); width ctx_len*D.  A
+    truthy ``padding_attr`` creates trainable boundary rows (the
+    reference's trainable_padding) read where the window leaves the
+    sequence."""
+
+    def __init__(self, input, context_len, context_start=None,
+                 padding_attr=False, **kw):
+        super().__init__(input)
+        self.context_len = context_len
+        self.context_start = context_start
+        self.padding_attr = padding_attr
+
+    def _nfd(self):
+        return 2
+
+    def build(self, size):
+        from ..layer_helper import LayerHelper
+        x = self.input
+        start = self.context_start if self.context_start is not None \
+            else -(self.context_len // 2)
+        helper = LayerHelper("sequence_context",
+                             param_attr=self.padding_attr or None)
+        D = x.shape[-1]
+        inputs = {"X": [x]}
+        if self.padding_attr:
+            begin_pad = max(0, -start)
+            end_pad = max(0, start + self.context_len - 1)
+            attr = self.padding_attr if isinstance(
+                self.padding_attr, ParamAttr) else ParamAttr()
+            pad_w = helper.create_parameter(
+                attr, shape=[begin_pad + end_pad, D], dtype=x.dtype)
+            inputs["PadW"] = [pad_w]
+        out = helper.create_variable_for_type_inference(
+            x.dtype, tuple(x.shape[:-1]) + (D * self.context_len,),
+            lod_level=x.lod_level)
+        helper.append_op(type="sequence_context", inputs=inputs,
+                         outputs={"Out": [out]},
+                         attrs={"contextLength": self.context_len,
+                                "contextStart": start})
+        return out
+
+
+class dotmul_operator(_Projection):
+    """layers.py dotmul_operator: elementwise a*b of two mixed inputs."""
+
+    def __init__(self, a=None, b=None, scale=1.0, x=None, y=None, **kw):
+        self.a, self.b = (a if a is not None else x), \
+            (b if b is not None else y)
+        super().__init__(self.a)
+        self.scale = scale
+
+    def _nfd(self):
+        return 2 if getattr(self.a, "lod_level", 0) else 1
+
+    def build(self, size):
+        out = L.elementwise_mul(self.a, self.b)
+        if self.scale != 1.0:
+            out = L.scale(out, scale=self.scale)
+        return out
+
+
+class conv_operator(_Projection):
+    """layers.py conv_operator: conv whose filter is another layer's
+    output (ConvOperator.cpp)."""
+
+    def __init__(self, img, filter, filter_size, num_filters,  # noqa: A002
+                 num_channels=None, stride=1, padding=0,
+                 filter_size_y=None, stride_y=None, padding_y=None, **kw):
+        super().__init__(img)
+        self.img = img
+        self.filter = filter
+        self.filter_size = filter_size
+        self.filter_size_y = filter_size_y or filter_size
+        self.num_filters = num_filters
+        self.num_channels = num_channels
+        self.stride = stride
+        self.stride_y = stride_y or stride
+        self.padding = padding
+        self.padding_y = padding_y if padding_y is not None else padding
+
+    def _nfd(self):
+        return 1
+
+    def build(self, size):
+        from ..layer_helper import LayerHelper
+        img = _as_image(self.img, self.num_channels)
+        c = img.shape[1]
+        helper = LayerHelper("conv_operator")
+        fh, fw = self.filter_size_y, self.filter_size
+        oh = (img.shape[2] + 2 * self.padding_y - fh) // self.stride_y + 1
+        ow = (img.shape[3] + 2 * self.padding - fw) // self.stride + 1
+        out = helper.create_variable_for_type_inference(
+            img.dtype, (img.shape[0], self.num_filters, oh, ow))
+        helper.append_op(
+            type="conv2d_dynamic_filter",
+            inputs={"Input": [img], "Filter": [self.filter]},
+            outputs={"Output": [out]},
+            attrs={"filter_shape": [self.num_filters, c, fh, fw],
+                   "strides": [self.stride_y, self.stride],
+                   "paddings": [self.padding_y, self.padding]})
+        return L.reshape(out, [-1, self.num_filters * oh * ow])
+
+
+def sub_nested_seq_layer(input, selected_indices, name=None, **kw):
+    """layers.py sub_nested_seq_layer: pick subsequences of a level-2
+    sequence by per-batch indices."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("sub_nested_seq", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, input.shape, lod_level=max(1, input.lod_level - 1))
+    helper.append_op(type="sub_nested_seq",
+                     inputs={"X": [input],
+                             "Selection": [selected_indices]},
+                     outputs={"Out": [out]})
+    return track_layer(name, out)
+
+
+# -- sequence ---------------------------------------------------------------
+def seq_concat_layer(a, b, name=None, **kw):
+    return track_layer(name, L.sequence_concat([a, b], name=name))
+
+
+def seq_slice_layer(input, starts, ends=None, sizes=None, name=None, **kw):
+    if sizes is None and ends is not None:
+        sizes = L.elementwise_sub(ends, starts)
+    out = L.sequence_slice(input, starts, sizes, name=name)
+    return track_layer(name, out)
+
+
+sub_seq_layer = seq_slice_layer          # layers.py sub_seq_layer semantics
+
+
+def kmax_seq_score_layer(input, beam_size=1, name=None, **kw):
+    out = L.kmax_sequence_score(input, beam_size=beam_size, name=name)
+    return track_layer(name, out)
+
+
+def row_conv_layer(input, context_len, act=None, name=None,
+                   param_attr=None, **kw):
+    out = L.row_conv(input, future_context_size=context_len - 1,
+                     param_attr=param_attr, act=_act_name(act), name=name)
+    return track_layer(name, out)
+
+
+def eos_layer(input, eos_id, name=None, **kw):
+    """layers.py eos_layer: 1.0 where the id equals eos_id."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("eos", name=name)
+    const = L.fill_constant([1], input.dtype, eos_id)
+    flag = helper.create_variable_for_type_inference("bool", input.shape)
+    helper.append_op(type="equal", inputs={"X": [input], "Y": [const]},
+                     outputs={"Out": [flag]})
+    return track_layer(name, L.cast(flag, "float32"))
+
+
+def sampling_id_layer(input, name=None, **kw):
+    return track_layer(name, L.sampling_id(input, name=name))
+
+
+def lstm_step_layer(input, state, size=None, act=None, gate_act=None,
+                    state_act=None, name=None, bias_attr=None, **kw):
+    """layers.py lstm_step_layer: ONE LSTM step inside a recurrent_group.
+    ``input`` is the [B, 4H] pre-projection (mixed_layer output — this
+    layer owns no weights, LstmStepLayer.cpp), ``state`` the previous
+    cell.  The hidden is the tracked output; the new cell is exposed as
+    secondary output 'state' for get_output_layer."""
+    size = size or input.shape[-1] // 4
+    act_f = getattr(L, _act_name(act) or "tanh")
+    gate_f = getattr(L, _act_name(gate_act) or "sigmoid")
+    state_f = getattr(L, _act_name(state_act) or "tanh")
+    i, f, g, o = L.split(input, 4, dim=-1)
+    cell = L.elementwise_add(L.elementwise_mul(gate_f(f), state),
+                             L.elementwise_mul(gate_f(i), act_f(g)))
+    hidden = L.elementwise_mul(gate_f(o), state_f(cell))
+    out = track_layer(name, hidden)
+    out.v1_outputs = {"state": cell}
+    return out
+
+
+def gru_step_naive_layer(*args, **kw):
+    from .sequence import gru_step_layer
+    return gru_step_layer(*args, **kw)
+
+
+def get_output_layer(input, arg_name, name=None, **kw):
+    """layers.py get_output_layer: a named secondary output of a layer
+    (e.g. the LSTM cell state)."""
+    outs = getattr(input, "v1_outputs", {})
+    if arg_name not in outs:
+        raise ValueError(
+            f"layer {input.name!r} exposes no output {arg_name!r}; "
+            f"available: {sorted(outs)} (only step layers with secondary "
+            f"outputs support get_output_layer)")
+    return track_layer(name, outs[arg_name])
+
+
+def printer_layer(input, format=None, name=None, **kw):  # noqa: A002
+    from .sequence import print_layer
+    return print_layer(input=input, name=name)
+
+
+def layer_support(*attrs):
+    """Reference decorator marking supported ExtraAttrs — a no-op here."""
+    def deco(f):
+        return f
+    return deco
+
+
+# -- costs ------------------------------------------------------------------
+def square_error_cost(input, label, name=None, **kw):
+    return track_layer(name, L.mean(L.square_error_cost(input, label),
+                                    name=name))
+
+
+def sum_cost(input, name=None, **kw):
+    return track_layer(name, L.reduce_sum(input, name=name))
+
+
+def rank_cost(left, right, label, weight=None, name=None, **kw):
+    out = L.mean(L.rank_loss(label, left, right), name=name)
+    return track_layer(name, out)
+
+
+def smooth_l1_cost(input, label, name=None, **kw):
+    return track_layer(name, L.mean(L.smooth_l1(input, label), name=name))
+
+
+def huber_regression_cost(input, label, delta=1.0, name=None, **kw):
+    out = L.mean(L.huber_loss(input, label, delta=delta), name=name)
+    return track_layer(name, out)
+
+
+def huber_classification_cost(input, label, name=None, **kw):
+    """layers.py huber_classification_cost on ±1 labels."""
+    out = L.mean(L.modified_huber_loss(input, label), name=name)
+    return track_layer(name, out)
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, **kw):
+    """layers.py multi_binary_label_cross_entropy: sigmoid CE summed over
+    the independent binary labels."""
+    ce = L.sigmoid_cross_entropy_with_logits(input, label)
+    return track_layer(name, L.mean(ce, name=name))
+
+
+def cross_entropy_with_selfnorm(input, label, softmax_selfnorm_alpha=0.1,
+                                name=None, **kw):
+    """layers.py cross_entropy_with_selfnorm: CE + alpha * log(Z)^2 where
+    input rows are softmax probabilities (Z their sum)."""
+    from . import _label_layer
+    label = _label_layer(label)
+    ce = L.cross_entropy(input, label)
+    z = L.reduce_sum(input, dim=-1, keep_dim=True)
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("log", name=None)
+    logz = helper.create_variable_for_type_inference(z.dtype, z.shape)
+    helper.append_op(type="log", inputs={"X": [z]},
+                     outputs={"Out": [logz]})
+    pen = L.scale(L.elementwise_mul(logz, logz),
+                  scale=softmax_selfnorm_alpha)
+    return track_layer(name, L.mean(L.elementwise_add(ce, pen), name=name))
+
+
+def ctc_layer(input, label, size=None, blank=None, norm_by_times=False,
+              name=None, **kw):
+    """layers.py ctc_layer (CTCLayer.cpp); the warpctc op is the lowering
+    either way (hl_warpctc_wrap subsumed)."""
+    blank = blank if blank is not None else (
+        (size or input.shape[-1]) - 1)
+    out = L.warpctc(input, label, blank=blank,
+                    norm_by_times=norm_by_times, name=name)
+    return track_layer(name, L.mean(out))
+
+
+warp_ctc_layer = ctc_layer
+
+
+def nce_layer(input, label, num_classes=None, num_neg_samples=10,
+              param_attr=None, bias_attr=None, name=None, **kw):
+    out = L.nce(input, label, num_total_classes=num_classes,
+                num_neg_samples=num_neg_samples, param_attr=param_attr,
+                bias_attr=bias_attr, name=name)
+    return track_layer(name, L.mean(out))
+
+
+def hsigmoid(input, label, num_classes=None, param_attr=None,
+             bias_attr=None, name=None, **kw):
+    out = L.hsigmoid(input, label, num_classes=num_classes,
+                     param_attr=param_attr, bias_attr=bias_attr, name=name)
+    return track_layer(name, L.mean(out))
+
+
+def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, name=None,
+                **kw):
+    raise NotImplementedError(
+        "lambda_cost (listwise LambdaRank) needs per-query ragged lists; "
+        "use rank_cost pairs or the mq2007 pairwise pipeline instead")
+
+
+def cross_entropy_over_beam(input, name=None, **kw):
+    raise NotImplementedError(
+        "cross_entropy_over_beam trained the v1 beam in-graph; the "
+        "static-shape scan decoder (layers.generation.BeamSearchDecoder) "
+        "plus per-step cross_entropy subsumes this training scheme")
+
+
+# -- detection --------------------------------------------------------------
+def priorbox_layer(input, image, min_size, max_size=(), aspect_ratio=(),
+                   variance=(0.1, 0.1, 0.2, 0.2), name=None, **kw):
+    """layers.py priorbox_layer -> fluid prior_box (detection.py)."""
+    boxes, variances = L.detection.prior_box(
+        input, image, min_sizes=list(min_size),
+        max_sizes=list(max_size) or None,
+        aspect_ratios=list(aspect_ratio) or [1.0],
+        variance=list(variance), name=name)
+    out = track_layer(name, boxes)
+    out.v1_outputs = {"variances": variances}
+    return out
+
+
+def multibox_loss_layer(input_loc, input_conf, priorbox, label, gt_box,
+                        num_classes, overlap_threshold=0.5,
+                        neg_pos_ratio=3.0, name=None, **kw):
+    """layers.py multibox_loss_layer -> fluid ssd_loss."""
+    variances = getattr(priorbox, "v1_outputs", {}).get("variances")
+    out = L.detection.ssd_loss(
+        input_loc, input_conf, gt_box, label, priorbox, variances,
+        overlap_threshold=overlap_threshold,
+        neg_pos_ratio=neg_pos_ratio, background_label=0)
+    return track_layer(name, L.mean(out, name=name))
+
+
+def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
+                           nms_threshold=0.45, nms_top_k=400, keep_top_k=200,
+                           confidence_threshold=0.01, background_id=0,
+                           name=None, **kw):
+    """layers.py detection_output_layer: decode loc offsets against the
+    priors (box_coder) then class-wise NMS (detection_output)."""
+    variances = getattr(priorbox, "v1_outputs", {}).get("variances")
+    decoded = L.detection.box_coder(priorbox, variances, input_loc)
+    out = L.detection.detection_output(
+        input_conf, decoded,
+        nms_threshold=nms_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, score_threshold=confidence_threshold,
+        background_label=background_id, name=name)
+    return track_layer(name, out)

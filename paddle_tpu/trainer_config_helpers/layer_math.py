@@ -1,0 +1,37 @@
+"""v1 layer_math (reference: trainer_config_helpers/layer_math.py):
+unary math functions over layer outputs, plus the Variable arithmetic
+operators the reference installs on LayerOutput (add/sub/mul with scalars
+and layers) — used e.g. by the VAE demo's ``layer_math.exp(logvar) * 0.5``.
+
+The operator overloads live on core Variable (core/program.py) so they
+work for every front end, fluid-style included."""
+from __future__ import annotations
+
+from .. import layers as L
+
+__all__ = ["exp", "log", "abs", "sigmoid", "tanh", "square", "relu",
+           "sqrt", "reciprocal"]
+
+
+def _unary(op_type):
+    def fn(input, name=None):
+        from ..layer_helper import LayerHelper
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(
+            input.dtype, input.shape, lod_level=input.lod_level)
+        helper.append_op(type=op_type, inputs={"X": [input]},
+                         outputs={"Out": [out]})
+        return out
+    fn.__name__ = op_type
+    return fn
+
+
+exp = _unary("exp")
+log = _unary("log")
+abs = _unary("abs")          # noqa: A001  (mirrors the reference name)
+sigmoid = _unary("sigmoid")
+tanh = _unary("tanh")
+square = _unary("square")
+relu = _unary("relu")
+sqrt = _unary("sqrt")
+reciprocal = _unary("reciprocal")

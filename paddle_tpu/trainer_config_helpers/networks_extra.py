@@ -1,0 +1,240 @@
+"""v1 networks.py tail: the remaining composite network helpers
+(reference: python/paddle/trainer_config_helpers/networks.py — cite lines
+per function).  Composites only — each builds on the DSL layer wrappers,
+exactly as the reference composes them."""
+from __future__ import annotations
+
+import math
+
+from .. import layers as L
+from .sequence import track_layer
+
+__all__ = [
+    "simple_img_conv_pool", "img_conv_bn_pool", "img_separable_conv",
+    "small_vgg", "vgg_16_network", "lstmemory_unit", "gru_unit",
+    "simple_gru2", "bidirectional_gru", "dot_product_attention",
+    "multi_head_attention",
+]
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         name=None, pool_type=None, act=None, groups=1,
+                         conv_stride=1, conv_padding=0, bias_attr=None,
+                         num_channel=None, num_channels=None,
+                         param_attr=None, shared_bias=True,
+                         conv_layer_attr=None, pool_stride=1,
+                         pool_padding=0, pool_layer_attr=None):
+    """networks.py:144 — conv then pool."""
+    from . import img_conv_layer, img_pool_layer
+    conv = img_conv_layer(
+        input=input, filter_size=filter_size, num_filters=num_filters,
+        num_channels=num_channel or num_channels, act=act, groups=groups,
+        stride=conv_stride, padding=conv_padding, bias_attr=bias_attr,
+        param_attr=param_attr, layer_attr=conv_layer_attr)
+    out = img_pool_layer(
+        input=conv, pool_size=pool_size, pool_type=pool_type,
+        stride=pool_stride, padding=pool_padding,
+        layer_attr=pool_layer_attr)
+    return track_layer(name, out)
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size, name=None,
+                     pool_type=None, act=None, groups=1, conv_stride=1,
+                     conv_padding=0, conv_bias_attr=None,
+                     num_channel=None, num_channels=None,
+                     conv_param_attr=None, shared_bias=True,
+                     conv_layer_attr=None, bn_param_attr=None,
+                     bn_bias_attr=None, bn_layer_attr=None, pool_stride=1,
+                     pool_padding=0, pool_layer_attr=None):
+    """networks.py:231 — conv, batch-norm (activation on the BN), pool."""
+    from . import batch_norm_layer, img_conv_layer, img_pool_layer
+    conv = img_conv_layer(
+        input=input, filter_size=filter_size, num_filters=num_filters,
+        num_channels=num_channel or num_channels, act=None, groups=groups,
+        stride=conv_stride, padding=conv_padding, bias_attr=conv_bias_attr,
+        param_attr=conv_param_attr, layer_attr=conv_layer_attr)
+    bn = batch_norm_layer(input=conv, act=act, bias_attr=bn_bias_attr,
+                          param_attr=bn_param_attr,
+                          layer_attr=bn_layer_attr)
+    out = img_pool_layer(
+        input=bn, pool_size=pool_size, pool_type=pool_type,
+        stride=pool_stride, padding=pool_padding,
+        layer_attr=pool_layer_attr)
+    return track_layer(name, out)
+
+
+def img_separable_conv(input, num_channels, num_out_channels, filter_size,
+                       stride=1, padding=0, depth_multiplier=1, act=None,
+                       bias_attr=None, param_attr=None, shared_bias=True,
+                       layer_attr=None, name=None):
+    """networks.py:439 — depthwise conv (groups == channels) followed by a
+    1x1 pointwise conv."""
+    from . import img_conv_layer
+    depthwise = img_conv_layer(
+        input=input, filter_size=filter_size,
+        num_filters=num_channels * depth_multiplier,
+        num_channels=num_channels, groups=num_channels,
+        stride=stride, padding=padding, act=None, bias_attr=bias_attr,
+        param_attr=param_attr, layer_attr=layer_attr)
+    pointwise = img_conv_layer(
+        input=depthwise, filter_size=1, num_filters=num_out_channels,
+        num_channels=num_channels * depth_multiplier, stride=1, padding=0,
+        act=act, bias_attr=bias_attr, param_attr=param_attr,
+        layer_attr=layer_attr)
+    return track_layer(name, pointwise)
+
+
+def small_vgg(input_image, num_channels, num_classes):
+    """networks.py:517 — the CIFAR vgg (4 conv groups then fc+bn+fc)."""
+    from . import (MaxPooling, ReluActivation, SoftmaxActivation,
+                   batch_norm_layer, dropout_layer, fc_layer,
+                   img_conv_group, img_pool_layer)
+
+    def vgg_block(ipt, num_filter, times, dropouts, channels=None):
+        return img_conv_group(
+            input=ipt, num_channels=channels, pool_size=2, pool_stride=2,
+            conv_num_filter=[num_filter] * times, conv_filter_size=3,
+            conv_act=ReluActivation(), conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts, pool_type=MaxPooling())
+
+    tmp = vgg_block(input_image, 64, 2, [0.3, 0], num_channels)
+    tmp = vgg_block(tmp, 128, 2, [0.4, 0])
+    tmp = vgg_block(tmp, 256, 3, [0.4, 0.4, 0])
+    tmp = vgg_block(tmp, 512, 3, [0.4, 0.4, 0])
+    tmp = img_pool_layer(input=tmp, stride=2, pool_size=2,
+                         pool_type=MaxPooling())
+    tmp = dropout_layer(input=tmp, dropout_rate=0.5)
+    tmp = fc_layer(input=tmp, size=512, act=None)
+    tmp = batch_norm_layer(input=tmp, act=ReluActivation())
+    return fc_layer(input=tmp, size=num_classes, act=SoftmaxActivation())
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000):
+    """networks.py:547 — the canonical VGG-16."""
+    from . import (MaxPooling, ReluActivation, SoftmaxActivation,
+                   dropout_layer, fc_layer, img_conv_group)
+
+    def block(ipt, filters, times, channels=None):
+        return img_conv_group(
+            input=ipt, num_channels=channels, pool_size=2, pool_stride=2,
+            conv_num_filter=[filters] * times, conv_filter_size=3,
+            conv_act=ReluActivation(), pool_type=MaxPooling())
+
+    tmp = block(input_image, 64, 2, num_channels)
+    tmp = block(tmp, 128, 2)
+    tmp = block(tmp, 256, 3)
+    tmp = block(tmp, 512, 3)
+    tmp = block(tmp, 512, 3)
+    tmp = fc_layer(input=tmp, size=4096, act=ReluActivation())
+    tmp = dropout_layer(input=tmp, dropout_rate=0.5)
+    tmp = fc_layer(input=tmp, size=4096, act=ReluActivation())
+    tmp = dropout_layer(input=tmp, dropout_rate=0.5)
+    return fc_layer(input=tmp, size=num_classes, act=SoftmaxActivation())
+
+
+def lstmemory_unit(input, out_memory=None, name=None, size=None,
+                   param_attr=None, act=None, gate_act=None, state_act=None,
+                   input_proj_bias_attr=None, input_proj_layer_attr=None,
+                   lstm_bias_attr=None, lstm_layer_attr=None, **kw):
+    """networks.py:717 — one projected LSTM step for a recurrent_group
+    body: mixed full-matrix projection to 4H, then lstm_step_layer against
+    the memory of this unit's own output and cell."""
+    from . import _act_name
+    from .extra_layers import get_output_layer, lstm_step_layer
+    from .sequence import memory
+    size = size or input.shape[-1] // 4
+    out_mem = out_memory if out_memory is not None else \
+        memory(name=name, size=size)
+    state_mem = memory(name="%s@state" % name, size=size)
+    proj = L.fc([input, out_mem], size=size * 4, num_flatten_dims=1,
+                param_attr=param_attr, bias_attr=input_proj_bias_attr)
+    hidden = lstm_step_layer(proj, state_mem, size=size, act=act,
+                             gate_act=gate_act, state_act=state_act,
+                             name=name)
+    track_layer("%s@state" % name, get_output_layer(hidden, "state"))
+    return hidden
+
+
+def gru_unit(input, memory_boot=None, name=None, size=None,
+             param_attr=None, act=None, gate_act=None,
+             gru_bias_attr=None, gru_layer_attr=None, naive=False, **kw):
+    """networks.py:940 — one GRU step for a recurrent_group body."""
+    from .sequence import gru_step_layer, memory
+    size = size or input.shape[-1] // 3
+    out_mem = memory(name=name, size=size, boot_layer=memory_boot)
+    return gru_step_layer(input, out_mem, size=size, act=act,
+                          gate_act=gate_act, param_attr=param_attr,
+                          bias_attr=gru_bias_attr, name=name)
+
+
+def simple_gru2(input, size, name=None, reverse=False, mixed_param_attr=None,
+                mixed_bias_attr=None, gru_param_attr=None,
+                gru_bias_attr=None, act=None, gate_act=None,
+                mixed_layer_attr=None, gru_cell_attr=None, **kw):
+    """networks.py:1163 — same math as simple_gru, grouped like the v1
+    fast implementation (one projection + grumemory)."""
+    from .sequence import simple_gru
+    return simple_gru(input=input, size=size, name=name, reverse=reverse,
+                      act=act, gate_act=gate_act,
+                      param_attr=gru_param_attr or mixed_param_attr,
+                      bias_attr=gru_bias_attr or mixed_bias_attr)
+
+
+def bidirectional_gru(input, size, name=None, return_seq=False,
+                      fwd_act=None, fwd_gate_act=None, bwd_act=None,
+                      bwd_gate_act=None, **kw):
+    """networks.py:1226 — forward + backward GRU; concat of the two last
+    steps (or the full sequences with return_seq=True)."""
+    from . import _act_name
+    fwd_proj = L.fc(input, size=size * 3, num_flatten_dims=2)
+    fwd = L.dynamic_gru(fwd_proj, size=size,
+                        candidate_activation=_act_name(fwd_act) or "tanh",
+                        gate_activation=_act_name(fwd_gate_act) or "sigmoid")
+    bwd_proj = L.fc(input, size=size * 3, num_flatten_dims=2)
+    bwd = L.dynamic_gru(bwd_proj, size=size, is_reverse=True,
+                        candidate_activation=_act_name(bwd_act) or "tanh",
+                        gate_activation=_act_name(bwd_gate_act) or "sigmoid")
+    if return_seq:
+        out = L.concat([fwd, bwd], axis=-1)
+    else:
+        out = L.concat([L.sequence_last_step(fwd),
+                        L.sequence_first_step(bwd)], axis=-1)
+    return track_layer(name, out)
+
+
+def dot_product_attention(encoded_sequence, attended_sequence,
+                          transformed_state, softmax_param_attr=None,
+                          name=None, **kw):
+    """networks.py:1498 — dot-product attention: score each encoder
+    position by <transformed_state, encoded_t>, softmax over the sequence,
+    weight the attended sequence."""
+    expanded = L.sequence_expand(transformed_state, encoded_sequence)
+    scores = L.reduce_sum(L.elementwise_mul(expanded, encoded_sequence),
+                          dim=-1, keep_dim=True)
+    weight = L.sequence_softmax(scores)
+    scaled = L.elementwise_mul(attended_sequence, weight)
+    return track_layer(name, L.sequence_pool(scaled, "sum"))
+
+
+def multi_head_attention(query, key, value, key_proj_size, value_proj_size,
+                         head_num, attention_type="dot-product attention",
+                         softmax_param_attr=None, name=None, **kw):
+    """networks.py:1580 — project q/k/v per head, scaled-dot attention
+    over the key sequence per head, concat head contexts.  TPU note: the
+    per-head loop builds one fused graph; for long sequences prefer
+    layers.flash_attention."""
+    heads = []
+    for h in range(head_num):
+        q = L.fc(query, size=key_proj_size // head_num, bias_attr=False)
+        k = L.fc(key, size=key_proj_size // head_num, num_flatten_dims=2,
+                 bias_attr=False)
+        v = L.fc(value, size=value_proj_size // head_num,
+                 num_flatten_dims=2, bias_attr=False)
+        qe = L.sequence_expand(q, k)
+        scores = L.scale(
+            L.reduce_sum(L.elementwise_mul(qe, k), dim=-1, keep_dim=True),
+            scale=1.0 / math.sqrt(key_proj_size // head_num))
+        weight = L.sequence_softmax(scores)
+        heads.append(L.sequence_pool(L.elementwise_mul(v, weight), "sum"))
+    out = L.concat(heads, axis=-1) if len(heads) > 1 else heads[0]
+    return track_layer(name, out)
