@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Roofline accounting experiments for the LSTM and seq2seq benchmarks
+(the RESULTS.md ResNet section's method applied to the RNN rows): an
+analytic FLOP/byte model per config plus on-device controls that vary one
+factor at a time (batch, sequence length, vocab) to identify the binding
+resource.  Run on the real chip:
+
+    python benchmark/roofline_rnn.py [--quick]
+
+Prints one JSON line per experiment; the RESULTS.md "Where the RNN time
+goes" section quotes these numbers.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from benchmark.run import run_config  # noqa: E402
+
+
+def lstm_model(hidden, batch, seq_len=100, emb=128, lstm_num=2,
+               bytes_per_el=2):
+    """Analytic per-batch cost of the stacked-LSTM classifier.
+
+    FLOPs: the 4-gate input+recurrent matmuls, fwd + ~2x for backward.
+    Weight-stream bytes: under lax.scan the gate weights are re-read from
+    HBM every timestep (they cannot stay resident across the sequential
+    chain), fwd and again bwd, plus the dW accumulator carried through the
+    backward scan (read+write per step).
+    """
+    per_step_flops = 0
+    per_step_wbytes = 0
+    for li in range(lstm_num):
+        d_in = emb if li == 0 else hidden
+        n_w = (d_in + hidden) * 4 * hidden
+        per_step_flops += 2 * n_w          # MACs*2, per sample
+        per_step_wbytes += n_w * bytes_per_el
+    flops = 3 * batch * seq_len * per_step_flops          # fwd + 2x bwd
+    # fwd weight reads + bwd weight reads + dW accumulator read+write
+    wbytes = seq_len * per_step_wbytes * (1 + 1 + 2)
+    # activation traffic: h,c per layer per step, write fwd + read bwd
+    abytes = 3 * batch * seq_len * lstm_num * 2 * hidden * bytes_per_el
+    return {"gflops": flops / 1e9, "weight_gb": wbytes / 1e9,
+            "act_gb": abytes / 1e9}
+
+
+def seq2seq_model(batch, src_len=30, tgt_len=30, vocab=30000, dim=512,
+                  bytes_per_el=2):
+    """Analytic per-batch cost split: vocab head vs recurrent/attention."""
+    n_tok = batch * tgt_len
+    head_flops = 3 * n_tok * 2 * dim * vocab              # fwd+bwd matmul
+    # softmax+CE traffic: logits [n_tok, vocab] written fwd, read for
+    # softmax, read+write for dlogits in bwd (fp32 master in AMP loss)
+    head_bytes = 4 * n_tok * vocab * 4
+    # encoder GRU/LSTM + decoder step matmuls + attention projections
+    rec_flops = 3 * batch * (src_len + tgt_len) * 2 * (
+        (dim + dim) * 4 * dim + 3 * dim * dim)
+    rec_wbytes = (src_len + tgt_len) * ((dim + dim) * 4 * dim +
+                                        3 * dim * dim) * bytes_per_el * 4
+    return {"head_gflops": head_flops / 1e9,
+            "head_gb": head_bytes / 1e9,
+            "rec_gflops": rec_flops / 1e9,
+            "rec_weight_gb": rec_wbytes / 1e9}
+
+
+def vocab_head_control(batch_tokens=1920, dim=512, vocab=30000,
+                       reps=3, iters=40):
+    """Isolated vocab projection + softmax-CE training step, same shapes
+    as the seq2seq head ([B*T, dim] @ [dim, vocab] -> CE), bf16 matmul."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch_tokens, dim).astype("float32") - 0.5,
+                    dtype=jnp.bfloat16)
+    w = jnp.asarray(rng.rand(dim, vocab).astype("float32") * 0.02,
+                    dtype=jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, vocab, batch_tokens))
+
+    @jax.jit
+    def step(w, x, y):
+        def loss_fn(w):
+            logits = (x @ w).astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+            return jnp.mean(lse - picked)
+        l, g = jax.value_and_grad(loss_fn)(w)
+        return (w - 0.001 * g).astype(jnp.bfloat16), l
+
+    for _ in range(5):
+        w, l = step(w, x, y)
+    float(l)
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            w, l = step(w, x, y)
+        float(l)
+        rates.append((time.perf_counter() - t0) / iters)
+    ms = sorted(rates)[len(rates) // 2] * 1e3
+    return {"experiment": "vocab_head_control",
+            "tokens": batch_tokens, "dim": dim, "vocab": vocab,
+            "ms_per_batch": round(ms, 2)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="fewer reps/windows")
+    args = ap.parse_args()
+    reps = 2 if args.quick else 3
+
+    out = []
+
+    # --- LSTM: batch scaling (weight-bound => ms/batch ~flat in B) ------
+    for bs in (64, 128, 256):
+        r = run_config("lstm_h512", bs, reps=reps)
+        r["experiment"] = f"lstm_h512_bs{bs}"
+        out.append(r)
+    # model
+    for bs in (64, 128, 256):
+        m = lstm_model(512, bs)
+        m["experiment"] = f"lstm_model_bs{bs}"
+        print(json.dumps(m), flush=True)
+        out.append(m)
+
+    # --- seq2seq: full vs vocab-head control vs small-vocab -------------
+    r = run_config("seq2seq", 64, reps=reps)
+    r["experiment"] = "seq2seq_full_v30000"
+    out.append(r)
+    c = vocab_head_control()
+    print(json.dumps(c), flush=True)
+    out.append(c)
+    m = seq2seq_model(64)
+    m["experiment"] = "seq2seq_model"
+    print(json.dumps(m), flush=True)
+    out.append(m)
+
+    # small-vocab control: same recurrent work, 1/10 head
+    import benchmark.run as br
+    orig = br._build_seq2seq
+
+    def small_vocab(batch, **kw):
+        return orig(batch, vocab=3000)
+    br._build_seq2seq = small_vocab
+    try:
+        r = run_config("seq2seq", 64, reps=reps)
+        r["experiment"] = "seq2seq_full_v3000"
+        out.append(r)
+    finally:
+        br._build_seq2seq = orig
+
+    with open(os.path.join(os.path.dirname(__file__),
+                           "roofline_rnn_results.json"), "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,7 @@ generators with the same reader protocol and shapes, so every demo/benchmark
 script runs unchanged.  Swap in real data by pointing the loader at files.
 """
 from . import (mnist, cifar, imdb, imikolov, movielens, uci_housing,
-               conll05, wmt14)
+               conll05, wmt14, sentiment, flowers, voc2012, mq2007)
 
 __all__ = ["mnist", "cifar", "imdb", "imikolov", "movielens", "uci_housing",
-           "conll05", "wmt14"]
+           "conll05", "wmt14", "sentiment", "flowers", "voc2012", "mq2007"]

@@ -1,0 +1,143 @@
+"""Oxford 102 Flowers reader (reference: v2/dataset/flowers.py —
+102flowers.tgz of JPEGs + imagelabels.mat + setid.mat; train/test splits
+deliberately swapped (tstid is the larger split, used for training);
+samples are flattened float32 CHW crops + 0-based label).
+
+Real path decodes JPEGs straight out of the tar with PIL and applies the
+reference transform (resize shorter side to 256, center/random crop 224,
+channel-mean subtract, CHW).  Offline CI uses a deterministic synthetic
+generator with the same sample contract."""
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from .common import cached_path
+
+__all__ = ["train", "test", "valid"]
+
+DATA_URL = "http://www.robots.ox.ac.uk/~vgg/data/flowers/102/102flowers.tgz"
+LABEL_URL = ("http://www.robots.ox.ac.uk/~vgg/data/flowers/102/"
+             "imagelabels.mat")
+SETID_URL = "http://www.robots.ox.ac.uk/~vgg/data/flowers/102/setid.mat"
+DATA_MD5 = "33bfc11892f1e405ca193ae9a9f2a118"
+LABEL_MD5 = "e0620be6f572b9609742df49c70aed4d"
+SETID_MD5 = "a5357ecc9cb78c4bef273ce3793fc85c"
+
+# Reference swaps the official splits: tstid (6149 imgs) trains, trnid
+# (1020) tests (flowers.py:50-55).
+TRAIN_FLAG = "tstid"
+TEST_FLAG = "trnid"
+VALID_FLAG = "valid"
+
+MEAN = np.array([103.94, 116.78, 123.68], dtype="float32")  # BGR means
+NUM_CLASSES = 102
+CROP = 224
+
+
+def simple_transform(img_hwc, resize_to, crop_to, is_train, mean=MEAN):
+    """Reference paddle.v2.image.simple_transform: resize shorter side,
+    (random|center) crop, optional mirror, HWC→CHW, mean subtract."""
+    from PIL import Image
+
+    h, w = img_hwc.shape[:2]
+    scale = resize_to / min(h, w)
+    nh, nw = int(round(h * scale)), int(round(w * scale))
+    img = np.asarray(Image.fromarray(img_hwc).resize(
+        (nw, nh), Image.BILINEAR), dtype="float32")
+    if is_train:
+        r = np.random
+        top = r.randint(0, nh - crop_to + 1)
+        left = r.randint(0, nw - crop_to + 1)
+        flip = r.rand() < 0.5
+    else:
+        top, left, flip = (nh - crop_to) // 2, (nw - crop_to) // 2, False
+    img = img[top:top + crop_to, left:left + crop_to]
+    if flip:
+        img = img[:, ::-1]
+    img = img[:, :, ::-1] - mean            # RGB→BGR, mean subtract
+    return np.ascontiguousarray(img.transpose(2, 0, 1))
+
+
+def default_mapper(is_train, sample):
+    """(jpeg_bytes, label) → (flat float32 CHW crop, label)
+    (flowers.py:58)."""
+    from PIL import Image
+
+    data, label = sample
+    img = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    img = simple_transform(img, 256, CROP, is_train)
+    return img.reshape(-1), label
+
+
+def _loadmat_indices(path, key):
+    import scipy.io as scio
+    return scio.loadmat(path)[key][0]
+
+
+def _tar_reader(data_file, label_file, setid_file, flag, mapper):
+    """Stream (mapped_image, 0-based label) for the split's image ids
+    (flowers.py:73 reader_creator, without the batch-file detour — the tar
+    is indexed once and streamed)."""
+    labels = _loadmat_indices(label_file, "labels")
+    indexes = _loadmat_indices(setid_file, flag)
+
+    def reader():
+        with tarfile.open(data_file) as tf:
+            members = {m.name: m for m in tf.getmembers()}
+            for i in indexes:
+                name = "jpg/image_%05d.jpg" % i
+                raw = tf.extractfile(members[name]).read()
+                yield mapper((raw, int(labels[i - 1]) - 1))
+    return reader
+
+
+def _synthetic(n, seed, is_train):
+    """Class-k images tile a fixed low-res prototype (kept small so the
+    generator is cheap), matching the real sample contract: flat float32
+    of length 3*224*224 and a label in [0, 102)."""
+    r_protos = np.random.RandomState(7)
+    protos = r_protos.rand(NUM_CLASSES, 3, 8, 8).astype("float32") * 60.0
+
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            y = int(r.randint(NUM_CLASSES))
+            img = np.kron(protos[y], np.ones((1, CROP // 8, CROP // 8),
+                                             dtype="float32"))
+            img += 5.0 * r.randn(3, CROP, CROP).astype("float32")
+            yield img.reshape(-1), y
+    return reader
+
+
+def _make(flag, mapper, is_train, synth, download):
+    data = cached_path(DATA_URL, "flowers", DATA_MD5, download)
+    label = cached_path(LABEL_URL, "flowers", LABEL_MD5, download)
+    setid = cached_path(SETID_URL, "flowers", SETID_MD5, download)
+    if data and label and setid:
+        return _tar_reader(data, label, setid, flag, mapper)
+    n, seed = synth
+    return _synthetic(n, seed, is_train)
+
+
+def train(mapper=None, download=False):
+    """Training reader: 6149 images (official tstid) (flowers.py:127)."""
+    import functools
+    mapper = mapper or functools.partial(default_mapper, True)
+    return _make(TRAIN_FLAG, mapper, True, (600, 20), download)
+
+
+def test(mapper=None, download=False):
+    """Test reader: 1020 images (official trnid) (flowers.py:150)."""
+    import functools
+    mapper = mapper or functools.partial(default_mapper, False)
+    return _make(TEST_FLAG, mapper, False, (120, 21), download)
+
+
+def valid(mapper=None, download=False):
+    """Validation reader: 1020 images (flowers.py:173)."""
+    import functools
+    mapper = mapper or functools.partial(default_mapper, False)
+    return _make(VALID_FLAG, mapper, False, (120, 22), download)

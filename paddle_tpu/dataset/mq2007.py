@@ -1,0 +1,211 @@
+"""LETOR MQ2007 learning-to-rank reader (reference: v2/dataset/mq2007.py —
+``label qid:N 1:v .. 46:v # comment`` lines grouped per query; pointwise /
+pairwise / listwise / plain_txt generators; Fold1 train/test).
+
+The reference needs ``rarfile`` to unpack MQ2007.rar; this module parses
+the extracted text files directly (point it at the file or drop the
+extracted ``MQ2007/`` tree under DATA_HOME), and offline CI uses a
+deterministic synthetic corpus whose relevance is a noisy linear function
+of the features — genuinely learnable by rank_cost/lambda-rank models."""
+from __future__ import annotations
+
+import functools
+import os
+import random
+
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = ["train", "test", "Query", "QueryList", "gen_point", "gen_pair",
+           "gen_list", "gen_plain_txt", "query_filter", "load_from_text",
+           "FEATURE_DIM"]
+
+FEATURE_DIM = 46
+
+
+class Query:
+    """One query-document pair: relevance score, query id, 46 features,
+    trailing comment (mq2007.py:49)."""
+
+    def __init__(self, query_id=-1, relevance_score=-1, feature_vector=None,
+                 description=""):
+        self.query_id = query_id
+        self.relevance_score = relevance_score
+        self.feature_vector = feature_vector or []
+        self.description = description
+
+    def __str__(self):
+        return "%s %s %s" % (self.relevance_score, self.query_id,
+                             " ".join(str(f) for f in self.feature_vector))
+
+    @classmethod
+    def parse(cls, text):
+        """``label qid:N 1:v ... 46:v # docid`` → Query, or None on a
+        malformed line (mq2007.py:84)."""
+        comment_pos = text.find("#")
+        desc = text[comment_pos + 1:].strip() if comment_pos >= 0 else ""
+        line = text[:comment_pos] if comment_pos >= 0 else text
+        parts = line.split()
+        if len(parts) != FEATURE_DIM + 2:
+            return None
+        q = cls(description=desc)
+        q.relevance_score = int(parts[0])
+        q.query_id = int(parts[1].split(":")[1])
+        q.feature_vector = [float(p.split(":")[1]) for p in parts[2:]]
+        return q
+
+
+class QueryList:
+    """All documents of one query (mq2007.py:105)."""
+
+    def __init__(self, querylist=None):
+        self.query_id = -1
+        self.querylist = []
+        for q in querylist or []:
+            self._add_query(q)
+
+    def __iter__(self):
+        return iter(self.querylist)
+
+    def __len__(self):
+        return len(self.querylist)
+
+    def __getitem__(self, i):
+        return self.querylist[i]
+
+    def _correct_ranking_(self):
+        self.querylist.sort(key=lambda x: x.relevance_score, reverse=True)
+
+    def _add_query(self, query):
+        if self.query_id == -1:
+            self.query_id = query.query_id
+        elif self.query_id != query.query_id:
+            raise ValueError("query in list must be same query_id")
+        self.querylist.append(query)
+
+
+def _as_querylist(querylist):
+    ql = (querylist if isinstance(querylist, QueryList)
+          else QueryList(querylist))
+    ql._correct_ranking_()
+    return ql
+
+
+def gen_plain_txt(querylist):
+    """(query_id, label, features) per doc (mq2007.py:147)."""
+    ql = _as_querylist(querylist)
+    for q in ql:
+        yield ql.query_id, q.relevance_score, np.array(q.feature_vector)
+
+
+def gen_point(querylist):
+    """(label, features) per doc — pointwise LTR (mq2007.py:168)."""
+    for q in _as_querylist(querylist):
+        yield q.relevance_score, np.array(q.feature_vector)
+
+
+def gen_pair(querylist, partial_order="full"):
+    """(1, better_features, worse_features) per ordered doc pair — the
+    rank_cost training signal (mq2007.py:187)."""
+    ql = _as_querylist(querylist)
+    for i in range(len(ql)):
+        for j in range(i + 1, len(ql)):
+            a, b = ql[i], ql[j]
+            if a.relevance_score > b.relevance_score:
+                hi, lo = a, b
+            elif a.relevance_score < b.relevance_score:
+                hi, lo = b, a
+            else:
+                continue
+            yield (np.array([1]), np.array(hi.feature_vector),
+                   np.array(lo.feature_vector))
+
+
+def gen_list(querylist):
+    """([labels], [features]) whole-query — listwise LTR (mq2007.py:230)."""
+    ql = _as_querylist(querylist)
+    yield (np.array([[q.relevance_score] for q in ql]),
+           np.array([q.feature_vector for q in ql]))
+
+
+def query_filter(querylists):
+    """Drop queries with no relevant documents (mq2007.py:250)."""
+    return [ql for ql in querylists
+            if sum(q.relevance_score for q in ql) != 0]
+
+
+def load_from_text(filepath, shuffle=True):
+    """Parse a LETOR text file into QueryLists (mq2007.py:268)."""
+    querylists, current, prev_id = [], None, None
+    with open(filepath) as f:
+        for line in f:
+            q = Query.parse(line)
+            if q is None:
+                continue
+            if q.query_id != prev_id:
+                if current is not None:
+                    querylists.append(current)
+                current, prev_id = QueryList(), q.query_id
+            current._add_query(q)
+    if current is not None:
+        querylists.append(current)
+    if shuffle:
+        random.shuffle(querylists)
+    return querylists
+
+
+def _synthetic_querylists(n_queries, seed):
+    """Relevance = quantized noisy linear score of the features, so a
+    linear ranker can beat random and pairwise training converges."""
+    r = np.random.RandomState(seed)
+    w = np.random.RandomState(2007).randn(FEATURE_DIM)
+    out = []
+    for qid in range(1, n_queries + 1):
+        ql = QueryList()
+        for _ in range(int(r.randint(8, 24))):
+            feat = r.rand(FEATURE_DIM)
+            score = feat @ w + 0.3 * r.randn()
+            rel = int(np.clip(np.floor((score + 2.0) / 1.5), 0, 2))
+            ql._add_query(Query(qid, rel, feat.tolist(), "synthetic"))
+        out.append(ql)
+    return out
+
+
+def _resolve(filepath):
+    """The extracted LETOR text file under DATA_HOME, or None."""
+    for root in (os.path.join(DATA_HOME, "MQ2007"), DATA_HOME):
+        p = os.path.join(root, filepath)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _reader(filepath, format="pairwise", shuffle=True, synth_seed=0):
+    """Reader over one fold file in the requested LTR format
+    (mq2007.py:295)."""
+    def reader():
+        path = _resolve(filepath)
+        if path is not None:
+            querylists = query_filter(load_from_text(path, shuffle=shuffle))
+        else:
+            querylists = query_filter(
+                _synthetic_querylists(120, seed=synth_seed))
+        for ql in querylists:
+            if format == "plain_txt":
+                yield next(gen_plain_txt(ql))
+            elif format == "pointwise":
+                yield next(gen_point(ql))
+            elif format == "pairwise":
+                yield from gen_pair(ql)
+            elif format == "listwise":
+                yield from gen_list(ql)
+            else:
+                raise ValueError(f"unknown format {format!r}")
+    return reader
+
+
+train = functools.partial(_reader, filepath="MQ2007/Fold1/train.txt",
+                          synth_seed=50)
+test = functools.partial(_reader, filepath="MQ2007/Fold1/test.txt",
+                         synth_seed=51)

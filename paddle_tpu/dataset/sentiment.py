@@ -1,0 +1,141 @@
+"""NLTK movie_reviews sentiment reader (reference: v2/dataset/sentiment.py
+— 2000 NLTK movie reviews, pos/neg interleaved, word ids ordered by corpus
+frequency, first 1600 train / last 400 test).
+
+The reference shells out to ``nltk.download``; this module parses the
+official ``movie_reviews`` corpus layout directly (a zip or directory
+containing ``movie_reviews/{pos,neg}/cv*.txt``) so no nltk dependency is
+needed.  Offline CI falls back to a deterministic synthetic corpus whose
+label is a learnable function of word choice."""
+from __future__ import annotations
+
+import os
+import re
+import zipfile
+from itertools import chain
+
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = ["train", "test", "get_word_dict",
+           "NUM_TRAINING_INSTANCES", "NUM_TOTAL_INSTANCES"]
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+# NLTK's own tokenizer splits punctuation; \w+ over lowercase text matches
+# the reference's ``movie_reviews.words`` closely enough for id assignment.
+_TOKEN = re.compile(r"[a-z0-9']+")
+
+_CACHE = {}
+
+
+def _corpus_location():
+    """The movie_reviews corpus under DATA_HOME, as either
+    ``corpora/movie_reviews.zip`` (what nltk.download leaves) or an
+    extracted ``movie_reviews/`` directory; None when absent."""
+    for rel in ("corpora/movie_reviews.zip", "movie_reviews.zip"):
+        p = os.path.join(DATA_HOME, rel)
+        if os.path.exists(p):
+            return p
+    for rel in ("corpora/movie_reviews", "movie_reviews"):
+        p = os.path.join(DATA_HOME, rel)
+        if os.path.isdir(p):
+            return p
+    return None
+
+
+def _read_corpus(location):
+    """{(category, fileid): [tokens]} sorted by fileid (cv000..cv999)."""
+    docs = {}
+    if os.path.isdir(location):
+        for cat in ("neg", "pos"):
+            d = os.path.join(location, cat)
+            for fn in sorted(os.listdir(d)):
+                if not fn.endswith(".txt"):
+                    continue
+                with open(os.path.join(d, fn), errors="ignore") as f:
+                    docs[(cat, fn)] = _TOKEN.findall(f.read().lower())
+    else:
+        with zipfile.ZipFile(location) as z:
+            for name in sorted(z.namelist()):
+                m = re.match(r".*movie_reviews/(pos|neg)/([^/]+\.txt)$", name)
+                if not m:
+                    continue
+                text = z.read(name).decode("utf-8", errors="ignore")
+                docs[(m.group(1), m.group(2))] = _TOKEN.findall(text.lower())
+    return docs
+
+
+def get_word_dict(location=None):
+    """[(word, id)] sorted by descending corpus frequency
+    (sentiment.py:53 get_word_dict)."""
+    location = location or _corpus_location()
+    if location is None:
+        vocab = 5000
+        return [(f"w{i}", i) for i in range(vocab)]
+    if ("dict", location) not in _CACHE:
+        docs = _read_corpus(location)
+        freq = {}
+        for toks in docs.values():
+            for w in toks:
+                freq[w] = freq.get(w, 0) + 1
+        items = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        _CACHE[("dict", location)] = [(w, i) for i, (w, _) in
+                                      enumerate(items)]
+        _CACHE[("docs", location)] = docs
+    return _CACHE[("dict", location)]
+
+
+def load_sentiment_data(location=None):
+    """[(word_ids, 0|1)] with neg/pos files interleaved so train/test both
+    see both classes (sentiment.py:74 sort_files + :87)."""
+    location = location or _corpus_location()
+    if location is None:
+        return _synthetic()
+    word_ids = dict(get_word_dict(location))
+    docs = _CACHE[("docs", location)]
+    neg = sorted(k for k in docs if k[0] == "neg")
+    pos = sorted(k for k in docs if k[0] == "pos")
+    out = []
+    for key in chain.from_iterable(zip(neg, pos)):
+        label = 0 if key[0] == "neg" else 1
+        out.append(([word_ids[w] for w in docs[key]], label))
+    return out
+
+
+def _synthetic():
+    """2000 docs; positive docs draw from even ids, negative from odd, with
+    noise — linearly separable by a bag-of-words model."""
+    r = np.random.RandomState(42)
+    out = []
+    for i in range(NUM_TOTAL_INSTANCES):
+        label = i % 2          # interleaved like the real corpus
+        L = int(r.randint(20, 120))
+        base = r.randint(0, 2500, L) * 2 + label     # parity encodes class
+        noise = r.randint(0, 5000, max(1, L // 10))
+        toks = np.concatenate([base, noise])
+        r.shuffle(toks)
+        out.append((toks.tolist(), label))
+    return out
+
+
+def train(location=None):
+    """Reader over the first 1600 instances (sentiment.py:115)."""
+    data = load_sentiment_data(location)
+
+    def reader():
+        for words, cat in data[:NUM_TRAINING_INSTANCES]:
+            yield words, cat
+    return reader
+
+
+def test(location=None):
+    """Reader over the last 400 instances (sentiment.py:123)."""
+    data = load_sentiment_data(location)
+
+    def reader():
+        for words, cat in data[NUM_TRAINING_INSTANCES:]:
+            yield words, cat
+    return reader

@@ -1,0 +1,110 @@
+"""Pascal VOC2012 segmentation reader (reference: v2/dataset/voc2012.py —
+VOCtrainval tar; splits from ImageSets/Segmentation/{trainval,train,val}.txt;
+yields (HWC uint8 image, HW uint8 class mask) pairs, mask values 0-20 +
+255 void).
+
+Real path streams JPEG/PNG pairs out of the tar with PIL.  Offline CI uses
+deterministic synthetic scenes (rectangles of distinct classes on a
+background), same contract, which also feed the SSD detection demo."""
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+from .common import cached_path
+
+__all__ = ["train", "test", "val", "NUM_CLASSES"]
+
+VOC_URL = ("http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+           "VOCtrainval_11-May-2012.tar")
+VOC_MD5 = "6cd6e144f989b92b3379bac3b3de84fd"
+SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+NUM_CLASSES = 21            # 20 object classes + background
+
+
+def _tar_reader(filename, sub_name):
+    """(image HWC, mask HW) for every id in the split file
+    (voc2012.py:42 reader_creator)."""
+    import tarfile
+
+    from PIL import Image
+
+    def reader():
+        with tarfile.open(filename) as tar:
+            name2mem = {m.name: m for m in tar.getmembers()}
+            sets = tar.extractfile(name2mem[SET_FILE.format(sub_name)])
+            for line in sets:
+                key = line.decode().strip()
+                data = tar.extractfile(name2mem[DATA_FILE.format(key)]).read()
+                label = tar.extractfile(
+                    name2mem[LABEL_FILE.format(key)]).read()
+                img = np.array(Image.open(io.BytesIO(data)).convert("RGB"))
+                mask = np.array(Image.open(io.BytesIO(label)))
+                yield img, mask
+    return reader
+
+
+def _synthetic(n, seed, size=96):
+    """Scenes of 1-3 axis-aligned rectangles, each a distinct class painted
+    into both the image (as a color block) and the mask — segmentable AND
+    detectable, so the same generator feeds the SSD demo via
+    ``boxes_from_mask``."""
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            img = (r.rand(size, size, 3) * 40).astype("uint8")
+            mask = np.zeros((size, size), dtype="uint8")
+            for _ in range(int(r.randint(1, 4))):
+                cls = int(r.randint(1, NUM_CLASSES))
+                h = int(r.randint(size // 6, size // 2))
+                w = int(r.randint(size // 6, size // 2))
+                top = int(r.randint(0, size - h))
+                left = int(r.randint(0, size - w))
+                color = np.array([cls * 11 % 256, cls * 37 % 256,
+                                  cls * 73 % 256], dtype="uint8")
+                img[top:top + h, left:left + w] = color
+                mask[top:top + h, left:left + w] = cls
+            yield img, mask
+    return reader
+
+
+def boxes_from_mask(mask):
+    """[(class, ymin, xmin, ymax, xmax)] per connected class region —
+    bridges the segmentation masks to the detection demo (the reference
+    feeds VOC to SSD through xml annotations; the mask carries the same
+    geometry for the classes present)."""
+    out = []
+    for cls in np.unique(mask):
+        if cls in (0, 255):
+            continue
+        ys, xs = np.nonzero(mask == cls)
+        out.append((int(cls), int(ys.min()), int(xs.min()),
+                    int(ys.max()) + 1, int(xs.max()) + 1))
+    return out
+
+
+def _make(sub_name, synth, download):
+    path = cached_path(VOC_URL, "voc2012", VOC_MD5, download)
+    if path:
+        return _tar_reader(path, sub_name)
+    n, seed = synth
+    return _synthetic(n, seed)
+
+
+def train(download=False):
+    """trainval split, 2913 images (voc2012.py:67)."""
+    return _make("trainval", (200, 30), download)
+
+
+def test(download=False):
+    """train split, 1464 images (voc2012.py:74)."""
+    return _make("train", (60, 31), download)
+
+
+def val(download=False):
+    """val split, 1449 images (voc2012.py:81)."""
+    return _make("val", (60, 32), download)

@@ -47,6 +47,8 @@ class Master:
         self.done: List[Task] = []
         self.epoch = 0
         self._next_id = 0
+        self._saving_trainer = ""
+        self._saving_until = 0.0
 
     # -- dataset -----------------------------------------------------------
     def set_dataset(self, chunks: List):
@@ -106,6 +108,26 @@ class Master:
             else:
                 self.todo.append(t)
 
+    def request_save_model(self, trainer_id: str,
+                           block_dur_s: float = 60.0) -> bool:
+        """Elect ONE trainer to checkpoint the model (service.go:481
+        RequestSaveModel): the first requester within a window wins and
+        re-asking by the winner stays true; everyone else gets False until
+        ``block_dur_s`` elapses.  Prevents N trainers racing on the same
+        checkpoint directory."""
+        if not trainer_id:
+            raise ValueError("trainer id is empty")
+        with self._lock:
+            now = time.time()
+            if now >= self._saving_until:
+                self._saving_trainer = ""
+            need = (self._saving_trainer == "" or
+                    self._saving_trainer == trainer_id)
+            if need:
+                self._saving_trainer = trainer_id
+                self._saving_until = now + block_dur_s
+            return need
+
     def _requeue_timeouts(self):
         now = time.time()
         for tid in list(self.pending):
@@ -150,7 +172,7 @@ class MasterServer:
     """
 
     METHODS = ("get_task", "task_finished", "task_failed", "set_dataset",
-               "stats", "ping")
+               "stats", "ping", "request_save_model")
 
     def __init__(self, master: Master, host: str = "127.0.0.1",
                  port: int = 0):
@@ -194,6 +216,9 @@ class MasterServer:
             return self.master.set_dataset(params["chunks"])
         if method == "stats":
             return self.master.stats()
+        if method == "request_save_model":
+            return self.master.request_save_model(
+                params["trainer_id"], params.get("block_dur_s", 60.0))
         return getattr(self.master, method)(params["task_id"])
 
     def start(self) -> "MasterServer":
@@ -274,6 +299,11 @@ class MasterClient:
 
     def ping(self) -> str:
         return self._call("ping")
+
+    def request_save_model(self, trainer_id: str,
+                           block_dur_s: float = 60.0) -> bool:
+        return self._call("request_save_model", trainer_id=trainer_id,
+                          block_dur_s=block_dur_s)
 
     def close(self):
         try:

@@ -112,6 +112,170 @@ class ChunkEvaluator(Evaluator):
         return np.array([p, r, f1], np.float32)
 
 
+class RankAuc:
+    """Streaming per-query rank-AUC (reference:
+    gserver/evaluators/Evaluator.cpp:513 RankAucEvaluator).
+
+    Each query contributes calcRankAuc(scores, clicks, pv): sort by score
+    descending, sweep accumulating click mass vs (pv − click) mass with the
+    trapezoid tie-correction for equal scores; AUC = area / (clickSum ·
+    noClickSum).  ``eval`` is the mean over queries (the evaluator's
+    totalScore/numSamples print).  Host-side streaming by design — metric
+    aggregation has no MXU work.
+
+    One deliberate deviation: the reference accumulates ``noClickSum +=
+    noClick`` (the running within-tie-group sum), which inflates the
+    denominator whenever scores tie and under-reports AUC; here the
+    denominator is the exact pair mass clickSum · Σ(pv−click) — bit-identical
+    to the reference for all-distinct scores, and the textbook value
+    (tied pairs at half credit) under ties.
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self, *a, **kw):
+        self._total = 0.0
+        self._count = 0
+
+    @staticmethod
+    def _query_auc(scores, clicks, pv):
+        order = np.argsort(-np.asarray(scores, np.float64), kind="stable")
+        scores = np.asarray(scores, np.float64)[order]
+        clicks = np.asarray(clicks, np.float64)[order]
+        pv = np.asarray(pv, np.float64)[order]
+        auc = click_sum = old_click_sum = 0.0
+        no_click = no_click_sum = 0.0
+        last = scores[0] + 1.0
+        for s, c, p in zip(scores, clicks, pv):
+            if s != last:
+                auc += (click_sum + old_click_sum) * no_click / 2.0
+                old_click_sum = click_sum
+                no_click = 0.0
+                last = s
+            no_click += p - c
+            no_click_sum += p - c
+            click_sum += c
+        auc += (click_sum + old_click_sum) * no_click / 2.0
+        denom = click_sum * no_click_sum
+        return auc / denom if denom != 0.0 else 0.0
+
+    def update(self, scores, clicks, pv=None, seq_lens=None):
+        """Add one batch.  ``scores``/``clicks`` (and optional ``pv`` page
+        views) are flat arrays; ``seq_lens`` splits them into queries
+        (whole batch = one query when omitted — the non-sequence case)."""
+        scores = np.asarray(scores).reshape(-1)
+        clicks = np.asarray(clicks).reshape(-1)
+        pv = (np.ones_like(scores) if pv is None
+              else np.asarray(pv).reshape(-1))
+        bounds = (np.cumsum([0] + list(seq_lens)) if seq_lens is not None
+                  else np.array([0, len(scores)]))
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            if b > a:
+                self._total += self._query_auc(scores[a:b], clicks[a:b],
+                                               pv[a:b])
+                self._count += 1
+
+    def eval(self, *a, **kw):
+        return self._total / self._count if self._count else 0.0
+
+
+class CTCError:
+    """Streaming CTC sequence-error evaluator (reference:
+    gserver/evaluators/CTCErrorEvaluator.cpp — best-path decode, collapse
+    repeats/blanks (blank = num_classes−1, a repeat separated by blank is
+    kept), Levenshtein alignment with substitution/deletion/insertion
+    backtrace, per-sequence normalization by max(len(gt), len(rec))).
+
+    ``eval`` returns the CER; ``results`` exposes the evaluator's full dict
+    (error / deletion_error / insertion_error / substitution_error /
+    sequence_error).
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self, *a, **kw):
+        self._dist = 0.0
+        self._del = 0.0
+        self._ins = 0.0
+        self._sub = 0.0
+        self._seq_err = 0
+        self._count = 0
+
+    @staticmethod
+    def best_path(acts, blank):
+        """argmax path → collapsed label string (path2String)."""
+        path = np.asarray(acts).argmax(axis=-1)
+        out = []
+        prev = -1
+        for lab in path:
+            if lab != blank and (not out or lab != out[-1] or prev == blank):
+                out.append(int(lab))
+            prev = lab
+        return out
+
+    @staticmethod
+    def _align(gt, rec):
+        """(distance, subs, dels, ins) via Levenshtein backtrace preferring
+        diagonal moves (stringAlignment)."""
+        n, m = len(gt), len(rec)
+        if n == 0:
+            return m, 0, 0, m
+        if m == 0:
+            return n, 0, n, 0
+        mat = np.zeros((n + 1, m + 1), np.int64)
+        mat[:, 0] = np.arange(n + 1)
+        mat[0, :] = np.arange(m + 1)
+        for i in range(1, n + 1):
+            for j in range(1, m + 1):
+                cost = 0 if gt[i - 1] == rec[j - 1] else 1
+                mat[i, j] = min(mat[i - 1, j] + 1, mat[i, j - 1] + 1,
+                                mat[i - 1, j - 1] + cost)
+        subs = dels = ins = 0
+        i, j = n, m
+        while i and j:
+            if mat[i, j] == mat[i - 1, j - 1]:
+                i -= 1; j -= 1
+            elif mat[i, j] == mat[i - 1, j - 1] + 1:
+                subs += 1; i -= 1; j -= 1
+            elif mat[i, j] == mat[i - 1, j] + 1:
+                dels += 1; i -= 1
+            else:
+                ins += 1; j -= 1
+        dels += i
+        ins += j
+        return subs + dels + ins, subs, dels, ins
+
+    def update(self, activations, labels, blank=None):
+        """One sequence: ``activations`` [T, num_classes] (softmax or
+        logits — only argmax matters), ``labels`` the ground-truth ids."""
+        acts = np.asarray(activations)
+        blank = acts.shape[-1] - 1 if blank is None else blank
+        rec = self.best_path(acts, blank)
+        gt = [int(x) for x in np.asarray(labels).reshape(-1)]
+        dist, subs, dels, ins = self._align(gt, rec)
+        max_len = max(len(gt), len(rec), 1)
+        self._dist += dist / max_len
+        self._sub += subs / max_len
+        self._del += dels / max_len
+        self._ins += ins / max_len
+        if dist:
+            self._seq_err += 1
+        self._count += 1
+
+    def results(self):
+        n = max(self._count, 1)
+        return {"error": self._dist / n,
+                "deletion_error": self._del / n,
+                "insertion_error": self._ins / n,
+                "substitution_error": self._sub / n,
+                "sequence_error": self._seq_err / n}
+
+    def eval(self, *a, **kw):
+        return self.results()["error"]
+
+
 class DetectionMAP:
     """Detection mean-average-precision (reference:
     gserver/evaluators/DetectionMAPEvaluator.cpp; fluid detection_map_op).

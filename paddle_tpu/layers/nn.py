@@ -562,7 +562,17 @@ def expand(x, expand_times, name=None):
 
 def _reduce_layer(op, input, dim, keep_dim, name):
     helper = LayerHelper(op, name=name)
-    out = helper.create_variable_for_type_inference(input.dtype)
+    shape = None
+    if dim is not None and input.shape is not None:
+        dims = dim if isinstance(dim, (list, tuple)) else [dim]
+        nd = len(input.shape)
+        dropped = {d % nd for d in dims}
+        shape = tuple(1 if i in dropped else s
+                      for i, s in enumerate(input.shape)) if keep_dim else \
+            tuple(s for i, s in enumerate(input.shape) if i not in dropped)
+    elif dim is None:
+        shape = (1,) if not keep_dim else None
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
     attrs = {"keep_dim": keep_dim}
     if dim is None:
         attrs["reduce_all"] = True

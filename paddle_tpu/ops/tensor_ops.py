@@ -165,7 +165,9 @@ def _crop(ctx, ins, attrs):
     x = ins["X"][0]
     offsets = attrs["offsets"]
     shape = attrs["shape"]
-    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    # a negative size is a symbolic dim (e.g. batch -1): keep to the end
+    slices = tuple(slice(o, o + s if s >= 0 else None)
+                   for o, s in zip(offsets, shape))
     return {"Out": x[slices]}
 
 

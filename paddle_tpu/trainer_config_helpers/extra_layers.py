@@ -75,8 +75,15 @@ def crop_layer(input, offset, shape=None, axis=2, name=None, **kw):
         offs[axis + i] = o
     if shape is None:
         raise ValueError("crop_layer needs an explicit shape")
-    tgt = list(full[:axis]) + list(shape[axis - len(shape):]) \
-        if len(shape) < len(full) else list(shape)
+    if len(shape) < len(full):
+        # ``shape`` covers dims from ``axis`` onward (layers.py crop_layer)
+        if axis + len(shape) != len(full):
+            raise ValueError(
+                f"crop_layer: axis({axis}) + len(shape)({len(shape)}) must "
+                f"equal input rank {len(full)}")
+        tgt = list(full[:axis]) + list(shape)
+    else:
+        tgt = list(shape)
     tgt[0] = full[0]
     out = L.crop(input, shape=tgt, offsets=offs, name=name)
     return track_layer(name, out)
@@ -179,10 +186,22 @@ def block_expand_layer(input, block_x, block_y, stride_x=1, stride_y=1,
     return track_layer(name, out)
 
 
-def prelu_layer(input, name=None, partial_sum=1, param_attr=None, **kw):
-    mode = "all" if partial_sum in (None, 0) or \
-        (input.shape and partial_sum == int(np.prod(input.shape[1:]))) \
-        else "channel" if input.shape and len(input.shape) == 4 else "all"
+def prelu_layer(input, name=None, partial_sum=1, channel_shared=None,
+                param_attr=None, **kw):
+    """layers.py:6676 prelu_layer — partial_sum=1: element-wise alpha;
+    = elements-per-channel: channel-wise; = all outputs (or
+    channel_shared=True): one shared alpha."""
+    n_el = int(np.prod(input.shape[1:])) if input.shape else None
+    if channel_shared is True:
+        mode = "all"
+    elif channel_shared is False:
+        mode = "channel"
+    elif partial_sum == 1:
+        mode = "element"
+    elif n_el is not None and partial_sum in (None, 0, n_el):
+        mode = "all"
+    else:
+        mode = "channel"
     out = L.prelu(input, mode=mode, param_attr=param_attr, name=name)
     return track_layer(name, out)
 
@@ -205,14 +224,10 @@ def out_prod_layer(input1, input2, name=None, **kw):
 
 
 def l2_distance_layer(x, y, name=None, **kw):
+    from . import layer_math
     d = L.elementwise_sub(x, y)
     out = L.reduce_sum(L.elementwise_mul(d, d), dim=-1, keep_dim=True)
-    from ..layer_helper import LayerHelper
-    helper = LayerHelper("sqrt", name=name)
-    o = helper.create_variable_for_type_inference(out.dtype, out.shape)
-    helper.append_op(type="sqrt", inputs={"X": [out]},
-                     outputs={"Out": [o]})
-    return track_layer(name, o)
+    return track_layer(name, layer_math.sqrt(out, name=name))
 
 
 def row_l2_norm_layer(input, name=None, **kw):
@@ -458,7 +473,11 @@ def seq_slice_layer(input, starts, ends=None, sizes=None, name=None, **kw):
     return track_layer(name, out)
 
 
-sub_seq_layer = seq_slice_layer          # layers.py sub_seq_layer semantics
+def sub_seq_layer(input, offsets, sizes, act=None, bias_attr=None,
+                  name=None, **kw):
+    """layers.py:7354 sub_seq_layer(input, offsets, sizes) — slice each
+    sequence at per-sequence offset/size."""
+    return seq_slice_layer(input, starts=offsets, sizes=sizes, name=name)
 
 
 def kmax_seq_score_layer(input, beam_size=1, name=None, **kw):
@@ -580,13 +599,10 @@ def cross_entropy_with_selfnorm(input, label, softmax_selfnorm_alpha=0.1,
     input rows are softmax probabilities (Z their sum)."""
     from . import _label_layer
     label = _label_layer(label)
+    from . import layer_math
     ce = L.cross_entropy(input, label)
     z = L.reduce_sum(input, dim=-1, keep_dim=True)
-    from ..layer_helper import LayerHelper
-    helper = LayerHelper("log", name=None)
-    logz = helper.create_variable_for_type_inference(z.dtype, z.shape)
-    helper.append_op(type="log", inputs={"X": [z]},
-                     outputs={"Out": [logz]})
+    logz = layer_math.log(z)
     pen = L.scale(L.elementwise_mul(logz, logz),
                   scale=softmax_selfnorm_alpha)
     return track_layer(name, L.mean(L.elementwise_add(ce, pen), name=name))

@@ -1,0 +1,140 @@
+"""Rank-AUC + CTC-error evaluators and master save-model election
+(reference: gserver/evaluators/Evaluator.cpp:513 RankAucEvaluator,
+CTCErrorEvaluator.cpp, go/master/service.go:481 RequestSaveModel)."""
+import numpy as np
+
+from paddle_tpu.distributed.master import Master, MasterServer, MasterClient
+from paddle_tpu.evaluator import CTCError, RankAuc
+
+
+# ---------------------------------------------------------------------------
+# RankAuc
+# ---------------------------------------------------------------------------
+def _brute_auc(scores, clicks, pv):
+    """Pairwise definition with tie credit 0.5, weighted by click mass
+    (pos) and pv-click mass (neg)."""
+    num = den = 0.0
+    for i in range(len(scores)):
+        for j in range(len(scores)):
+            pos, neg = clicks[i], pv[j] - clicks[j]
+            w = pos * neg
+            if w <= 0:
+                continue
+            den += w
+            if scores[i] > scores[j]:
+                num += w
+            elif scores[i] == scores[j]:
+                num += 0.5 * w
+    return num / den if den else 0.0
+
+
+def test_rank_auc_matches_pairwise_definition(rng):
+    for trial in range(5):
+        n = 12
+        scores = np.round(rng.rand(n), 1)       # rounding forces ties
+        clicks = rng.randint(0, 3, n).astype(float)
+        pv = clicks + rng.randint(0, 3, n)
+        ev = RankAuc()
+        ev.update(scores, clicks, pv)
+        assert abs(ev.eval() - _brute_auc(scores, clicks, pv)) < 1e-9
+
+
+def test_rank_auc_perfect_and_default_pv():
+    ev = RankAuc()
+    # clicks exactly where scores are highest -> AUC 1 (pv defaults to 1)
+    ev.update([0.9, 0.8, 0.2, 0.1], [1, 1, 0, 0])
+    assert ev.eval() == 1.0
+    ev.reset()
+    ev.update([0.1, 0.2, 0.8, 0.9], [1, 1, 0, 0])
+    assert ev.eval() == 0.0
+    ev.reset()
+    # per-query split: one perfect + one inverted query -> mean 0.5
+    ev.update([0.9, 0.1, 0.1, 0.9], [1, 0, 1, 0], seq_lens=[2, 2])
+    assert ev.eval() == 0.5
+
+
+# ---------------------------------------------------------------------------
+# CTCError
+# ---------------------------------------------------------------------------
+def _onehot_path(path, num_classes):
+    acts = np.zeros((len(path), num_classes), np.float32)
+    acts[np.arange(len(path)), path] = 1.0
+    return acts
+
+
+def test_ctc_best_path_collapse():
+    # blank = 4; "a a blank a b b" -> a a b (repeat across blank kept)
+    acts = _onehot_path([0, 0, 4, 0, 1, 1], 5)
+    assert CTCError.best_path(acts, blank=4) == [0, 0, 1]
+    # leading/trailing blanks dropped
+    acts = _onehot_path([4, 2, 4, 4, 3, 4], 5)
+    assert CTCError.best_path(acts, blank=4) == [2, 3]
+
+
+def test_ctc_error_counts():
+    ev = CTCError()
+    # decoded = [0, 1] vs gt [0, 1]: perfect
+    ev.update(_onehot_path([0, 4, 1], 5), [0, 1])
+    assert ev.eval() == 0.0
+    assert ev.results()["sequence_error"] == 0.0
+    ev.reset()
+    # decoded [0, 2] vs gt [0, 1]: one substitution, maxLen 2
+    ev.update(_onehot_path([0, 4, 2], 5), [0, 1])
+    r = ev.results()
+    assert r["error"] == 0.5 and r["substitution_error"] == 0.5
+    assert r["deletion_error"] == 0.0 and r["sequence_error"] == 1.0
+    ev.reset()
+    # decoded [] vs gt [7]: deletion; decoded [3] vs gt []: insertion
+    ev.update(_onehot_path([4, 4], 5), [3])
+    r = ev.results()
+    assert r["deletion_error"] == 1.0 and r["insertion_error"] == 0.0
+    ev.update(_onehot_path([3], 5), [])
+    r = ev.results()
+    assert r["insertion_error"] == 0.5          # averaged over 2 seqs
+    assert r["sequence_error"] == 1.0
+
+
+def test_ctc_error_streaming_mean(rng):
+    ev = CTCError()
+    # 3 perfect + 1 fully wrong (4 subs / maxLen 4 = 1.0) -> mean 0.25
+    for _ in range(3):
+        ev.update(_onehot_path([0, 1, 2, 3], 5), [0, 1, 2, 3])
+    ev.update(_onehot_path([1, 2, 3, 1], 5), [0, 0, 0, 0])
+    assert abs(ev.eval() - 0.25) < 1e-9
+    assert ev.results()["sequence_error"] == 0.25
+
+
+# ---------------------------------------------------------------------------
+# master save-model election
+# ---------------------------------------------------------------------------
+def test_request_save_model_election():
+    m = Master()
+    # first asker wins; different trainer blocked; same trainer re-asks ok
+    assert m.request_save_model("t0", block_dur_s=30.0) is True
+    assert m.request_save_model("t1", block_dur_s=30.0) is False
+    assert m.request_save_model("t0", block_dur_s=30.0) is True
+    # expiry frees the slot
+    m._saving_until = 0.0
+    assert m.request_save_model("t1", block_dur_s=30.0) is True
+    assert m.request_save_model("t0") is False
+
+
+def test_request_save_model_over_rpc():
+    srv = MasterServer(Master()).start()
+    try:
+        c0 = MasterClient(srv.address)
+        c1 = MasterClient(srv.address)
+        assert c0.request_save_model("t0", block_dur_s=30.0) is True
+        assert c1.request_save_model("t1", block_dur_s=30.0) is False
+        assert c0.request_save_model("t0") is True
+        c0.close()
+        c1.close()
+    finally:
+        srv.stop()
+
+
+def test_request_save_model_empty_id_rejected():
+    m = Master()
+    import pytest
+    with pytest.raises(ValueError):
+        m.request_save_model("")

@@ -24,6 +24,7 @@ def _eval(path, **args):
     return load_v1_config(os.path.join(REF, path), **args)
 
 
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
 def test_dsl_surface_complete():
     """Every def in the reference layers.py + networks.py is exported."""
     import paddle_tpu.trainer_config_helpers as tch
