@@ -6,18 +6,15 @@ headline metric, with the seq2seq number carried in "extra_metrics" on the
 same line (the driver records the whole object).
 
 Methodology (pinned, round 4 — see benchmark/RESULTS.md "Methodology"):
-- ONE compiled step variant per model: every call uses the same fetch_list
-  ([loss], return_numpy=False).  With auto_layout the [] and [loss]
-  variants pick different parameter layouts, so mixing them corrupts the
-  donated state (measured: InvalidArgument on the 3rd step).
-- Long timing windows: each timed window enqueues >=80 steps and ends in
-  one loss-scalar readback (the only reliable barrier over the axon
-  tunnel).  Short windows under-report by 5-10%: the queue drain/refill
-  around each barrier costs a fixed ~200 ms, and 30-step windows eat it
-  as ~2 ms/step.
-- Median of N windows: the tunnel occasionally delivers a 1.7x-slow
-  window (external contention); the median is stable to ~1-2% where
-  single windows swing 15%.
+- Each timed window is ONE compiled dispatch: Executor.run_steps(K)
+  compiles lax.scan over K training steps with donated state, so host
+  dispatch rate and axon-tunnel latency are out of the measurement (and
+  out of the training loop — run_steps is the user-facing API).  Reading
+  the stacked losses is the window barrier; the first call is
+  compile + warmup.
+- Median of N windows with the (max-min)/median spread reported: the
+  tunnel can deliver slow windows under external contention; the median
+  rejects them.
 
 Baselines: the reference's best published ResNet-50 *training* number is
 82.35 img/s (batch 128) on a 2x20-core Skylake with MKL-DNN
@@ -41,22 +38,21 @@ BATCH = 128
 
 
 def _median_window_throughput(exe, prog, feeds, loss, units_per_step,
-                              warmup, iters, reps):
-    """Pinned timing core: warm up, then `reps` windows of `iters` steps
-    each (single compiled variant, one readback barrier per window);
-    returns (median_throughput, spread) where spread = (max-min)/median
-    across windows."""
-    for _ in range(warmup):
-        (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
-                        return_numpy=False)
-    assert np.isfinite(float(lv))   # block: warmup fully executed
+                              iters, reps):
+    """Pinned timing core (round 4): each window is ONE compiled dispatch
+    of ``iters`` steps (`Executor.run_steps` — a device-side lax.scan with
+    donated state), so per-step host dispatch and tunnel latency are out
+    of the measurement entirely; the first (untimed) call is the compile +
+    warmup.  Median of `reps` windows; spread = (max-min)/median."""
+    (lv,) = exe.run_steps(iters, prog, feed=feeds, fetch_list=[loss],
+                          return_numpy=False)
+    assert np.isfinite(np.asarray(lv)[-1])     # compile+warmup executed
     rates = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
-                            return_numpy=False)
-        assert np.isfinite(float(lv))
+        (lv,) = exe.run_steps(iters, prog, feed=feeds, fetch_list=[loss],
+                              return_numpy=False)
+        assert np.isfinite(np.asarray(lv)[-1])   # barrier: window done
         rates.append(units_per_step * iters / (time.perf_counter() - t0))
     med = statistics.median(rates)
     return med, (max(rates) - min(rates)) / med
@@ -75,10 +71,10 @@ def main():
     opt = pt.optimizer.Momentum(learning_rate=0.01 / BATCH, momentum=0.9)
     opt.minimize(loss)
 
-    # bf16 compute + fp32 master weights + XLA-chosen parameter layouts:
-    # the TPU-idiomatic training mode (auto_layout removes the per-step
-    # layout-normalizing copies on every donated conv filter)
-    exe = pt.Executor(amp=True, auto_layout=True)
+    # bf16 compute + fp32 master weights.  auto_layout is unnecessary
+    # under run_steps: inside one scan executable XLA keeps parameters in
+    # compute layouts across iterations (measured equal, 2648 vs 2652)
+    exe = pt.Executor(amp=True)
     exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
 
     rng = np.random.RandomState(0)
@@ -90,8 +86,7 @@ def main():
 
     prog = pt.default_main_program()
     img_s, spread = _median_window_throughput(
-        exe, prog, feeds, loss, units_per_step=BATCH,
-        warmup=5, iters=80, reps=3)
+        exe, prog, feeds, loss, units_per_step=BATCH, iters=80, reps=3)
 
     tok_s = tok_spread = None
     try:
@@ -155,8 +150,7 @@ def _seq2seq_tokens_per_sec(batch=64):
     prog = pt.default_main_program()
     return _median_window_throughput(
         exe, prog, feeds, loss,
-        units_per_step=batch * (src_len + tgt_len),
-        warmup=6, iters=150, reps=5)
+        units_per_step=batch * (src_len + tgt_len), iters=150, reps=5)
 
 
 if __name__ == "__main__":

@@ -85,8 +85,7 @@ def vocab_head_control(batch_tokens=1920, dim=512, vocab=30000,
                     dtype=jnp.bfloat16)
     y = jnp.asarray(rng.randint(0, vocab, batch_tokens))
 
-    @jax.jit
-    def step(w, x, y):
+    def step(w, _):
         def loss_fn(w):
             logits = (x @ w).astype(jnp.float32)
             lse = jax.scipy.special.logsumexp(logits, axis=-1)
@@ -95,15 +94,18 @@ def vocab_head_control(batch_tokens=1920, dim=512, vocab=30000,
         l, g = jax.value_and_grad(loss_fn)(w)
         return (w - 0.001 * g).astype(jnp.bfloat16), l
 
-    for _ in range(5):
-        w, l = step(w, x, y)
-    float(l)
+    @jax.jit
+    def window(w):
+        # device-side loop: same dispatch-free methodology as run_steps
+        return jax.lax.scan(step, w, None, length=iters)
+
+    w, ls = window(w)
+    float(ls[-1])
     rates = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            w, l = step(w, x, y)
-        float(l)
+        w, ls = window(w)
+        float(ls[-1])
         rates.append((time.perf_counter() - t0) / iters)
     ms = sorted(rates)[len(rates) // 2] * 1e3
     return {"experiment": "vocab_head_control",
@@ -132,10 +134,12 @@ def main():
         print(json.dumps(m), flush=True)
         out.append(m)
 
-    # --- seq2seq: full vs vocab-head control vs small-vocab -------------
-    r = run_config("seq2seq", 64, reps=reps)
-    r["experiment"] = "seq2seq_full_v30000"
-    out.append(r)
+    # --- seq2seq: batch scaling, vocab-head control, small-vocab,
+    # dense-vs-lazy Adam A/B -------------------------------------------
+    for bs in (64, 128, 256):
+        r = run_config("seq2seq", bs, reps=reps)
+        r["experiment"] = f"seq2seq_full_v30000_bs{bs}"
+        out.append(r)
     c = vocab_head_control()
     print(json.dumps(c), flush=True)
     out.append(c)
@@ -144,16 +148,28 @@ def main():
     print(json.dumps(m), flush=True)
     out.append(m)
 
-    # small-vocab control: same recurrent work, 1/10 head
     import benchmark.run as br
     orig = br._build_seq2seq
 
+    # small-vocab control: same recurrent work, 1/10 head
     def small_vocab(batch, **kw):
         return orig(batch, vocab=3000)
     br._build_seq2seq = small_vocab
     try:
         r = run_config("seq2seq", 64, reps=reps)
         r["experiment"] = "seq2seq_full_v3000"
+        out.append(r)
+    finally:
+        br._build_seq2seq = orig
+
+    # lazy (row-sparse) Adam A/B at bs64: same conditions as the dense
+    # run above; see RESULTS.md for the (negative) verdict
+    def lazy(batch, **kw):
+        return orig(batch, lazy_adam=True)
+    br._build_seq2seq = lazy
+    try:
+        r = run_config("seq2seq", 64, reps=reps)
+        r["experiment"] = "seq2seq_full_v30000_lazy_adam"
         out.append(r)
     finally:
         br._build_seq2seq = orig

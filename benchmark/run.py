@@ -88,7 +88,8 @@ def _build_lstm(hidden, batch, seq_len=100, vocab=30000, emb=128,
     return loss, feeds, batch
 
 
-def _build_seq2seq(batch, src_len=30, tgt_len=30, vocab=30000, dim=512):
+def _build_seq2seq(batch, src_len=30, tgt_len=30, vocab=30000, dim=512,
+                   lazy_adam=False):
     import paddle_tpu as pt
     from paddle_tpu import layers, models
     src = layers.data("src", shape=[], dtype="int64", lod_level=1)
@@ -99,7 +100,7 @@ def _build_seq2seq(batch, src_len=30, tgt_len=30, vocab=30000, dim=512):
     flat = layers.reshape(probs, [-1, vocab])
     loss = layers.mean(layers.cross_entropy(
         flat, layers.reshape(lbl, [-1, 1])))
-    pt.optimizer.Adam(1e-3).minimize(loss)
+    pt.optimizer.Adam(1e-3, lazy_mode=lazy_adam).minimize(loss)
     rng = np.random.RandomState(0)
     feeds = {"src": rng.randint(0, vocab, (batch, src_len)),
              "src@LEN": np.full(batch, src_len),
@@ -111,7 +112,7 @@ def _build_seq2seq(batch, src_len=30, tgt_len=30, vocab=30000, dim=512):
     return loss, feeds, batch * (src_len + tgt_len)
 
 
-def run_config(name, batch, amp=True, warmup=5, iters=None, reps=3):
+def run_config(name, batch, amp=True, iters=None, reps=3):
     import statistics
 
     import jax
@@ -135,38 +136,24 @@ def run_config(name, batch, amp=True, warmup=5, iters=None, reps=3):
     exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
     feeds = {k: jax.device_put(v) for k, v in feeds.items()}
     prog = pt.default_main_program()
-    # Pinned methodology (see RESULTS.md): ONE compiled variant throughout
-    # (same fetch_list every call, loss kept on device), long windows ending
-    # in a single scalar readback (the only reliable tunnel barrier), median
-    # of `reps` windows.  Short windows under-report: the drain/refill
-    # around each barrier costs a fixed ~200 ms.
-    for _ in range(warmup):
-        (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
-                        return_numpy=False)
-    assert np.isfinite(float(lv))
+    # Pinned methodology (round 4, see RESULTS.md): each window is ONE
+    # compiled dispatch of `iters` steps (Executor.run_steps — device-side
+    # lax.scan with donated state), so host dispatch rate and tunnel
+    # latency are out of the measurement; first call = compile + warmup.
+    # Fixed window sizes (no probe compiles): big CNNs 60 steps, small
+    # models 300.
     if iters is None:
-        # size the window to ~2s of device time: difference two probe
-        # windows (1 step vs 21 steps, each ending in a barrier) so the
-        # fixed ~200ms barrier cost cancels out of the per-step estimate
-        t0 = time.perf_counter()
-        (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
-                        return_numpy=False)
-        float(lv)
-        dt1 = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        for _ in range(21):
-            (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
-                            return_numpy=False)
-        float(lv)
-        per_step = max((time.perf_counter() - t0 - dt1) / 20, 1e-4)
-        iters = max(60, int(2.0 / per_step))
+        iters = 60 if name in ("alexnet", "googlenet", "resnet50",
+                               "vgg19") else 300
+    (lv,) = exe.run_steps(iters, prog, feed=feeds, fetch_list=[loss],
+                          return_numpy=False)
+    assert np.isfinite(np.asarray(lv)[-1])
     rates = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            (lv,) = exe.run(prog, feed=feeds, fetch_list=[loss],
-                            return_numpy=False)
-        assert np.isfinite(float(lv))
+        (lv,) = exe.run_steps(iters, prog, feed=feeds, fetch_list=[loss],
+                              return_numpy=False)
+        assert np.isfinite(np.asarray(lv)[-1])
         rates.append(units * iters / (time.perf_counter() - t0))
     thr = statistics.median(rates)
     spread = (max(rates) - min(rates)) / thr
@@ -195,8 +182,8 @@ def main():
     ap.add_argument("--model", default=None)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--iters", type=int, default=None,
-                    help="steps per timed window (default: auto-size to "
-                         "~2s of device time)")
+                    help="steps per timed window (default: 60 for the "
+                         "big CNNs, 300 otherwise)")
     ap.add_argument("--amp", action="store_true", default=True)
     ap.add_argument("--no-amp", dest="amp", action="store_false")
     ap.add_argument("--all", action="store_true")

@@ -453,6 +453,122 @@ class Executor:
                        for f in fetches]
         return fetches
 
+    def run_steps(self, num_steps: int,
+                  program: Optional[Program] = None,
+                  feed: Optional[Dict[str, object]] = None,
+                  fetch_list: Optional[Sequence] = None,
+                  scope: Optional[Scope] = None,
+                  return_numpy: bool = True,
+                  is_test: bool = False,
+                  feeds_stacked: bool = False):
+        """Run ``num_steps`` training steps as ONE compiled dispatch — a
+        device-side ``lax.scan`` over the per-step function with donated
+        state threading.
+
+        TPU-native training-loop design: the per-step host dispatch (and
+        any host↔device link latency) is paid once per CHUNK instead of
+        once per step, which is the difference between wire-latency-bound
+        and device-bound throughput for small models (see
+        benchmark/RESULTS.md methodology).  The reference's closest analog
+        is the trainer's inner batch loop (trainer/Trainer.cpp), which is
+        host-driven per batch; here the loop itself is compiled.
+
+        ``feeds_stacked=False`` reuses ``feed`` for every step (timing
+        windows, synthetic data).  ``feeds_stacked=True`` expects every
+        feed to carry a leading ``num_steps`` axis — a device-resident
+        input pipeline: stage K batches, dispatch once.
+
+        Fetches come back stacked with a leading ``num_steps`` axis.
+        """
+        from .program import default_main_program
+        if self.check_nan_inf:
+            raise ValueError(
+                "run_steps: check_nan_inf needs per-step host inspection; "
+                "use run() for NaN hunts")
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        scope = global_scope() if scope is None else scope
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_list]
+
+        gb = program.global_block()
+        feed_arrays: Dict[str, jnp.ndarray] = {}
+        for name, val in feed.items():
+            arr = val if isinstance(val, jax.Array) else np.asarray(val)
+            if feeds_stacked and arr.shape[:1] != (num_steps,):
+                raise ValueError(
+                    f"run_steps(feeds_stacked=True): feed {name!r} must "
+                    f"have leading dim {num_steps}, got {arr.shape}")
+            if gb.has_var(name):
+                want = jax.dtypes.canonicalize_dtype(gb.var(name).dtype)
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            feed_arrays[name] = arr
+
+        state_keys = self._state_keys(program, scope)
+        state = {k: scope.get(k) for k in state_keys}
+
+        sig = ("steps", id(program), program.version,
+               num_steps, feeds_stacked,
+               tuple(sorted((n, a.shape, str(a.dtype))
+                            for n, a in feed_arrays.items())),
+               tuple(fetch_names), tuple(sorted(state_keys)), is_test)
+        entry = self._cache.get(sig)
+        jfn = None
+        if entry is not None:
+            prog_ref, jfn = entry
+            if prog_ref() is not program:
+                jfn = None
+        if jfn is None:
+            step_fn = self._make_fn(program, fetch_names, is_test)
+
+            def multi(feeds, st, step0):
+                def body(carry, xs):
+                    s, step = carry
+                    f = xs if feeds_stacked else feeds
+                    fetches, new_s = step_fn(f, s, step)
+                    return (new_s, step + 1), fetches
+
+                init = (st, jnp.asarray(step0, jnp.uint32))
+                if feeds_stacked:
+                    (s_out, _), ys = jax.lax.scan(body, init, feeds)
+                else:
+                    (s_out, _), ys = jax.lax.scan(body, init, None,
+                                                  length=num_steps)
+                return ys, s_out
+
+            jfn = self._build_steps(program, multi, feeds_stacked)
+            self._cache[sig] = (weakref.ref(program), jfn)
+
+        step0 = self._step
+        self._step += num_steps
+        fetches, new_state = jfn(feed_arrays, state, step0)
+        fetches = list(fetches)
+        for k, v in new_state.items():
+            scope.set(k, v)
+        if return_numpy:
+            fetches = [np.asarray(f) if f is not None else None
+                       for f in fetches]
+        return fetches
+
+    def _build_steps(self, program: Program, multi, feeds_stacked: bool):
+        """jit wrapper for the K-step scan fn (ShardedExecutor overrides
+        this to pin mesh shardings).  auto_layout executors route through
+        _AutoLayoutStep — the shared format registry keeps run() and
+        run_steps() variants agreeing on the donated state's layouts
+        (mixing pinned-AUTO and default layouts on the same donated
+        buffers is the InvalidArgument ping-pong the methodology notes
+        describe)."""
+        if not self.use_jit:
+            return multi
+        if self.auto_layout:
+            return _AutoLayoutStep(multi, self._fmt_registry,
+                                   self.compiler_options)
+        if self.compiler_options:
+            return _OptionsStep(multi, self.compiler_options)
+        return jax.jit(multi, donate_argnums=(1,))
+
     # -- internals ---------------------------------------------------------
     def _state_keys(self, program: Program, scope: Scope) -> List[str]:
         """Persistable vars referenced by the program that exist in scope.

@@ -571,7 +571,12 @@ def _reduce_layer(op, input, dim, keep_dim, name):
                       for i, s in enumerate(input.shape)) if keep_dim else \
             tuple(s for i, s in enumerate(input.shape) if i not in dropped)
     elif dim is None:
-        shape = (1,) if not keep_dim else None
+        # reduce_all: 0-d result (matches the runtime op and layers.mean);
+        # keep_dim keeps the rank as all-ones
+        if not keep_dim:
+            shape = ()
+        elif input.shape is not None:
+            shape = tuple(1 for _ in input.shape)
     out = helper.create_variable_for_type_inference(input.dtype, shape)
     attrs = {"keep_dim": keep_dim}
     if dim is None:

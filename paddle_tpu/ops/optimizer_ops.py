@@ -50,13 +50,31 @@ def _adam(ctx, ins, attrs):
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
     lr = _lr(ins)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    out = {"Beta1PowOut": (b1p * b1).reshape(1),
+           "Beta2PowOut": (b2p * b2).reshape(1)}
+    if attrs.get("lazy_mode") and "Rows" in ins:
+        # adam_op.cc lazy_mode: touch only the rows the batch looked up.
+        # The dense grad row already sums duplicate ids, so per-row values
+        # are identical across duplicates and .at[ids].set is idempotent;
+        # untouched rows keep stale moments (reference sparse semantics).
+        ids = jnp.concatenate([i.reshape(-1) for i in ins["Rows"]])
+        g_r = g[ids]
+        m_r = b1 * m[ids] + (1 - b1) * g_r
+        v_r = b2 * v[ids] + (1 - b2) * jnp.square(g_r)
+        p_r = p[ids] - lr_t * m_r / (jnp.sqrt(v_r) + eps)
+        mode = "promise_in_bounds"
+        out.update({
+            "ParamOut": p.at[ids].set(p_r.astype(p.dtype), mode=mode),
+            "Moment1Out": m.at[ids].set(m_r.astype(m.dtype), mode=mode),
+            "Moment2Out": v.at[ids].set(v_r.astype(v.dtype), mode=mode)})
+        return out
     m_out = b1 * m + (1 - b1) * g
     v_out = b2 * v + (1 - b2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     p_out = p - lr_t * m_out / (jnp.sqrt(v_out) + eps)
-    return {"ParamOut": p_out, "Moment1Out": m_out, "Moment2Out": v_out,
-            "Beta1PowOut": (b1p * b1).reshape(1),
-            "Beta2PowOut": (b2p * b2).reshape(1)}
+    out.update({"ParamOut": p_out, "Moment1Out": m_out,
+                "Moment2Out": v_out})
+    return out
 
 
 @register_op("adamax")

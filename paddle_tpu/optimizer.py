@@ -152,10 +152,20 @@ class AdagradOptimizer(Optimizer):
 
 
 class AdamOptimizer(Optimizer):
+    """Adam-as-an-op (adam_op.cc).  ``lazy_mode`` mirrors the reference's
+    lazy_mode attr: parameters that are ONLY read through ``lookup_table``
+    (embedding tables) update just the rows the batch touched — on TPU
+    this turns three full [V,D] moment read-write sweeps per step into
+    [B·T,D] gather/scatters, which is the difference between an
+    HBM-bandwidth-bound and an MXU-bound seq2seq step (see
+    benchmark/RESULTS.md RNN roofline).  Untouched rows keep stale
+    moments, exactly like the reference's sparse path."""
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, **kw):
+                 epsilon=1e-8, lazy_mode=False, **kw):
         super().__init__(learning_rate, **kw)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lazy_mode = lazy_mode
 
     def _create_accumulators(self, program, params):
         for p in params:
@@ -164,21 +174,44 @@ class AdamOptimizer(Optimizer):
             self._add_accumulator("beta1_pow", p, self._beta1, shape=[1])
             self._add_accumulator("beta2_pow", p, self._beta2, shape=[1])
 
+    @staticmethod
+    def _lookup_ids(program, param):
+        """Ids vars of every lookup_table reading ``param``; None when the
+        param is also consumed by any other op (dense path required)."""
+        ids, other_use = [], False
+        for block in program.blocks:
+            for op in block.ops:
+                names = [n for ns in op.inputs.values() for n in ns]
+                if param.name not in names:
+                    continue
+                if op.type == "lookup_table":
+                    ids.extend(op.inputs.get("Ids", []))
+                else:
+                    other_use = True
+        return None if (other_use or not ids) else ids
+
     def _append_optimize_op(self, program, param, grad):
         m1 = self._get_accumulator("moment1", param)
         m2 = self._get_accumulator("moment2", param)
         b1 = self._get_accumulator("beta1_pow", param)
         b2 = self._get_accumulator("beta2_pow", param)
+        inputs = {"Param": [param], "Grad": [grad], "Moment1": [m1],
+                  "Moment2": [m2], "Beta1Pow": [b1], "Beta2Pow": [b2],
+                  "LearningRate": [self._lr_for_param(param)]}
+        attrs = {"beta1": self._beta1, "beta2": self._beta2,
+                 "epsilon": self._epsilon}
+        if self._lazy_mode:
+            ids = self._lookup_ids(program, param)
+            if ids is not None:
+                inputs["Rows"] = ids
+                attrs["lazy_mode"] = True
         return program.global_block().append_op(
             "adam",
-            inputs={"Param": [param], "Grad": [grad], "Moment1": [m1],
-                    "Moment2": [m2], "Beta1Pow": [b1], "Beta2Pow": [b2],
-                    "LearningRate": [self._lr_for_param(param)]},
+            inputs=inputs,
             outputs={"ParamOut": [param.name], "Moment1Out": [m1.name],
                      "Moment2Out": [m2.name], "Beta1PowOut": [b1.name],
                      "Beta2PowOut": [b2.name]},
-            attrs={"beta1": self._beta1, "beta2": self._beta2,
-                   "epsilon": self._epsilon})
+            attrs=attrs)
 
 
 class AdamaxOptimizer(Optimizer):

@@ -89,6 +89,45 @@ class ShardedExecutor(Executor):
             return super().run(program, feed=feed, fetch_list=fetch_list,
                                **kw)
 
+    def run_steps(self, num_steps, program=None, feed=None, **kw):
+        with self.mesh:
+            return super().run_steps(num_steps, program, feed=feed, **kw)
+
+    def _build_steps(self, program: Program, multi, feeds_stacked: bool):
+        """K-step scan with the same mesh shardings as the per-step path;
+        stacked feeds shard their PER-STEP dims (the leading steps axis
+        stays unsharded — it is scanned over, not distributed)."""
+        if not self.use_jit:
+            return multi
+        mesh = self.mesh
+        jitted = {}
+
+        def wrapper(feed_arrays, state, step0):
+            key = (tuple(sorted(feed_arrays)), tuple(sorted(state)))
+            if key not in jitted:
+                lead = 1 if feeds_stacked else 0
+                feed_sh = {}
+                for n, a in feed_arrays.items():
+                    spec = self._feed_spec(program, n, np.ndim(a) - lead)
+                    if feeds_stacked:
+                        spec = P(None, *spec)
+                    feed_sh[n] = NamedSharding(mesh, spec)
+                state_sh = {}
+                for k in state:
+                    spec = self.param_specs.get(k)
+                    if spec is None:
+                        v = self._find_var(program, k)
+                        if v is not None and getattr(v, "sharding", None):
+                            spec = P(*v.sharding)
+                    state_sh[k] = NamedSharding(mesh, spec) \
+                        if spec is not None else None
+                jitted[key] = jax.jit(
+                    multi, in_shardings=(feed_sh, state_sh, None),
+                    donate_argnums=(1,))
+            return jitted[key](feed_arrays, state, step0)
+
+        return wrapper
+
     def _build(self, program: Program, feed_names, fetch_names,
                state_keys, is_test):
         fn = self._make_fn(program, fetch_names, is_test)

@@ -197,7 +197,9 @@ def prelu_layer(input, name=None, partial_sum=1, channel_shared=None,
     elif channel_shared is False:
         mode = "channel"
     elif partial_sum == 1:
-        mode = "element"
+        # element-wise alpha needs a static shape; shape-less inputs fall
+        # back to the shared-alpha mode (the pre-round-4 behavior)
+        mode = "element" if input.shape is not None else "all"
     elif n_el is not None and partial_sum in (None, 0, n_el):
         mode = "all"
     else:

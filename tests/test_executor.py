@@ -211,3 +211,51 @@ def test_weight_norm_param_attr(rng):
     w_eff = g * v / np.linalg.norm(v, axis=0, keepdims=True)
     np.testing.assert_allclose(yv, feeds["x"] @ w_eff, rtol=1e-4,
                                atol=1e-5)
+
+
+def test_run_steps_matches_per_step_run(rng):
+    """run_steps(K) (one lax.scan dispatch, donated state) reproduces K
+    sequential run() calls bitwise-closely, and feeds_stacked threads a
+    different batch per step."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    true_w = np.array([[1.0], [2.0], [-1.0], [0.5]], "float32")
+    xb = rng.rand(8, 4).astype("float32")
+    yb = xb @ true_w
+
+    def build():
+        pt.core.reset_default_programs()
+        pt.core.reset_global_scope()
+        pt.unique_name.reset()
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1, name="w")
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.Adam(0.1).minimize(loss)
+        return loss
+
+    loss = build()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    seq = [float(exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])[0])
+           for _ in range(6)]
+    w_seq = np.asarray(pt.global_scope().get("w.w_0")).copy()
+
+    loss = build()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    (stacked,) = exe.run_steps(6, feed={"x": xb, "y": yb},
+                               fetch_list=[loss])
+    np.testing.assert_allclose(stacked.reshape(-1), seq, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.global_scope().get("w.w_0")),
+                               w_seq, rtol=1e-5)
+
+    xs = rng.rand(3, 8, 4).astype("float32")
+    ys = np.einsum("kbd,dj->kbj", xs, true_w)
+    (st2,) = exe.run_steps(3, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                           feeds_stacked=True)
+    assert st2.shape[0] == 3 and np.isfinite(st2).all()
+    with pytest.raises(ValueError):
+        exe.run_steps(3, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                      feeds_stacked=True)      # missing leading K axis

@@ -205,3 +205,85 @@ def test_static_pruning_hook(rng):
         w = np.asarray(pt.global_scope().get("w"))
         assert (w[mask == 0] == 0).all()        # pruned entries stay zero
     assert (w[mask == 1] != 0).any()            # survivors keep training
+
+
+def test_adam_lazy_mode_rows():
+    """adam_op.cc lazy_mode analog: only looked-up rows update; untouched
+    rows keep param AND stale moments (no decay)."""
+    V, D = 8, 3
+    p = R.uniform(-1, 1, (V, D)).astype("float32")
+    g = np.zeros((V, D), "float32")
+    ids = np.array([[1, 5, 1]], "int64")       # row 1 duplicated
+    for i in (1, 5):
+        g[i] = R.uniform(-1, 1, D)
+    g[1] *= 2.0                                 # summed duplicate grad
+    m = R.uniform(-1, 1, (V, D)).astype("float32")
+    v = R.uniform(0, 1, (V, D)).astype("float32")
+    b1p = np.array([0.9], "float32")
+    b2p = np.array([0.999], "float32")
+    got = run_op("adam",
+                 {"Param": ("p", p), "Grad": ("g", g), "Moment1": ("m", m),
+                  "Moment2": ("v", v), "Beta1Pow": ("b1", b1p),
+                  "Beta2Pow": ("b2", b2p), "LearningRate": ("lr", LR),
+                  "Rows": ("ids", ids)},
+                 {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+                  "lazy_mode": True},
+                 ["ParamOut", "Moment1Out", "Moment2Out"])
+    p_o, m_o, v_o = (got["paramout__out0"], got["moment1out__out0"],
+                     got["moment2out__out0"])
+    # touched rows match the dense formula
+    for i in (1, 5):
+        m_ref = 0.9 * m[i] + 0.1 * g[i]
+        v_ref = 0.999 * v[i] + 0.001 * g[i] * g[i]
+        lr_t = 0.1 * np.sqrt(1 - b2p) / (1 - b1p)
+        np.testing.assert_allclose(m_o[i], m_ref, rtol=1e-5)
+        np.testing.assert_allclose(v_o[i], v_ref, rtol=1e-5)
+        np.testing.assert_allclose(
+            p_o[i], p[i] - lr_t * m_ref / (np.sqrt(v_ref) + 1e-8),
+            rtol=1e-5)
+    # untouched rows: bitwise frozen (param and moments)
+    untouched = [i for i in range(V) if i not in (1, 5)]
+    np.testing.assert_array_equal(p_o[untouched], p[untouched])
+    np.testing.assert_array_equal(m_o[untouched], m[untouched])
+    np.testing.assert_array_equal(v_o[untouched], v[untouched])
+
+
+def test_adam_lazy_mode_end_to_end():
+    """Adam(lazy_mode=True) routes embedding tables through the sparse
+    path (Rows wired from lookup_table Ids) and still learns; a param
+    used outside lookup_table stays dense."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    V, D = 50, 8
+    x = layers.data("x", shape=[4], dtype="int64")
+    y = layers.data("y", shape=[1], dtype="int64")
+    emb = layers.embedding(x, size=[V, D], param_attr=pt.ParamAttr(
+        name="lazy_emb"))
+    pred = layers.fc(layers.reduce_mean(emb, dim=1), size=5, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    opt = pt.optimizer.Adam(1e-1, lazy_mode=True)
+    opt.minimize(loss)
+    prog = pt.default_main_program()
+    adam_ops = [op for op in prog.global_block().ops if op.type == "adam"]
+    by_param = {op.inputs["Param"][0]: op for op in adam_ops}
+    assert "Rows" in by_param["lazy_emb"].inputs
+    assert by_param["lazy_emb"].attrs.get("lazy_mode") is True
+    dense = [n for n in by_param if n != "lazy_emb"]
+    assert dense and all("Rows" not in by_param[n].inputs for n in dense)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    rng = np.random.RandomState(0)
+    xs = rng.randint(0, V, (16, 4))
+    ys = (xs[:, 0] % 5)[:, None]
+    emb0 = np.asarray(pt.global_scope().get("lazy_emb")).copy()
+    vals = [float(exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+            for _ in range(30)]
+    assert vals[-1] < vals[0] * 0.7
+    emb1 = np.asarray(pt.global_scope().get("lazy_emb"))
+    touched = np.unique(xs)
+    untouched = np.setdiff1d(np.arange(V), touched)
+    if len(untouched):
+        np.testing.assert_array_equal(emb1[untouched], emb0[untouched])
+    assert not np.allclose(emb1[touched], emb0[touched])
