@@ -68,25 +68,75 @@ class SGD:
     # -- training ----------------------------------------------------------
     def train(self, reader: Callable, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
-              feeding=None, feed_list: Optional[Sequence[Variable]] = None):
+              feeding=None, feed_list: Optional[Sequence[Variable]] = None,
+              steps_per_dispatch: int = 1):
         """reader yields batches (lists of rows); feeding maps data-layer
-        names to row positions (v2 trainer.py feeding) or pass feed_list."""
+        names to row positions (v2 trainer.py feeding) or pass feed_list.
+
+        ``steps_per_dispatch > 1`` stacks runs of consecutive same-shape
+        batches and executes each run as ONE device-side scan
+        (`Executor.run_steps` with stacked feeds) — the compiled training
+        loop.  Iteration events still fire per batch (after the dispatch
+        that contained them); differently-shaped batches (bucketed
+        padding) fall back to per-batch dispatch automatically.
+        """
         event_handler = event_handler or (lambda e: None)
         feeder = self._feeder(feeding, feed_list)
         if not self._initialized:
             self.exe.run(default_startup_program(), feed={}, fetch_list=[])
             self._initialized = True
+        fetch = [self.cost] + self.extra
+
+        def emit_end(pass_id, batch_id, out):
+            metrics = {getattr(v, "name", str(i)): out[1 + i]
+                       for i, v in enumerate(self.extra)}
+            event_handler(events.EndIteration(
+                pass_id, batch_id, float(out[0]), metrics))
+
+        def flush(pass_id, first_id, chunk):
+            if len(chunk) == 1:
+                event_handler(events.BeginIteration(pass_id, first_id))
+                out = self.exe.run(self.main_program, feed=chunk[0],
+                                   fetch_list=fetch)
+                emit_end(pass_id, first_id, out)
+                return
+            stacked = {k: np.stack([f[k] for f in chunk])
+                       for k in chunk[0]}
+            outs = self.exe.run_steps(
+                len(chunk), self.main_program, feed=stacked,
+                fetch_list=fetch, feeds_stacked=True)
+            for i in range(len(chunk)):
+                event_handler(events.BeginIteration(pass_id, first_id + i))
+                emit_end(pass_id, first_id + i, [o[i] for o in outs])
+
         for pass_id in range(num_passes):
             event_handler(events.BeginPass(pass_id))
+            if steps_per_dispatch <= 1:
+                for batch_id, batch in enumerate(reader()):
+                    event_handler(events.BeginIteration(pass_id, batch_id))
+                    out = self.exe.run(self.main_program,
+                                       feed=feeder.feed(batch),
+                                       fetch_list=fetch)
+                    emit_end(pass_id, batch_id, out)
+                event_handler(events.EndPass(pass_id))
+                continue
+            chunk, first_id, sig = [], 0, None
             for batch_id, batch in enumerate(reader()):
-                event_handler(events.BeginIteration(pass_id, batch_id))
                 feed = feeder.feed(batch)
-                out = self.exe.run(self.main_program, feed=feed,
-                                   fetch_list=[self.cost] + self.extra)
-                metrics = {getattr(v, "name", str(i)): out[1 + i]
-                           for i, v in enumerate(self.extra)}
-                event_handler(events.EndIteration(
-                    pass_id, batch_id, float(out[0]), metrics))
+                fsig = tuple(sorted(
+                    (k, np.shape(v), str(np.asarray(v).dtype))
+                    for k, v in feed.items()))
+                if chunk and fsig != sig:
+                    flush(pass_id, first_id, chunk)
+                    chunk = []
+                if not chunk:
+                    first_id, sig = batch_id, fsig
+                chunk.append(feed)
+                if len(chunk) == steps_per_dispatch:
+                    flush(pass_id, first_id, chunk)
+                    chunk = []
+            if chunk:
+                flush(pass_id, first_id, chunk)
             event_handler(events.EndPass(pass_id))
 
     def test(self, reader: Callable, feeding=None, feed_list=None):

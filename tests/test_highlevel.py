@@ -234,3 +234,58 @@ def test_v2_ploter(tmp_path):
     assert out.exists() and out.stat().st_size > 0
     p.reset()
     assert p.data["train"] == ([], [])
+
+
+def test_trainer_steps_per_dispatch_matches_per_batch(rng):
+    """steps_per_dispatch=4 (stacked run_steps chunks) reproduces the
+    per-batch training trajectory and still fires per-batch events;
+    shape-changing batches fall back cleanly."""
+    def build():
+        pt.core.reset_default_programs()
+        pt.core.reset_global_scope()
+        pt.unique_name.reset()
+        x = layers.data("x", shape=[8], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1, name="tw")
+        cost = layers.mean(layers.square_error_cost(pred, y))
+        return x, y, cost
+
+    w_true = rng.rand(8, 1).astype("float32")
+    rows = []
+    for _ in range(10):
+        xb = rng.rand(16, 8).astype("float32")
+        rows.append([(xb[i], xb[i] @ w_true) for i in range(16)])
+
+    def reader():
+        yield from rows
+
+    def run(k):
+        x, y, cost = build()
+        tr = pt.trainer.SGD(cost=cost,
+                            update_equation=pt.optimizer.SGD(0.2))
+        costs = []
+        tr.train(reader, num_passes=2, feed_list=[x, y],
+                 steps_per_dispatch=k,
+                 event_handler=lambda e: costs.append(e.cost)
+                 if isinstance(e, pt.trainer.events.EndIteration) else None)
+        return costs, np.asarray(pt.global_scope().get("tw.w_0")).copy()
+
+    c1, w1 = run(1)
+    c4, w4 = run(4)          # 10 batches/pass -> chunks of 4,4,2
+    assert len(c1) == len(c4) == 20
+    np.testing.assert_allclose(c4, c1, rtol=2e-2, atol=1e-6)
+    np.testing.assert_allclose(w4, w1, rtol=2e-2, atol=1e-6)
+
+    # bucketed shapes: alternate batch sizes force per-run chunking
+    def bucketed():
+        for i, r in enumerate(rows):
+            yield r[:8] if i % 2 else r
+
+    x, y, cost = build()
+    tr = pt.trainer.SGD(cost=cost, update_equation=pt.optimizer.SGD(0.2))
+    n = {"iters": 0}
+    tr.train(bucketed, num_passes=1, feed_list=[x, y],
+             steps_per_dispatch=4,
+             event_handler=lambda e: n.__setitem__("iters", n["iters"] + 1)
+             if isinstance(e, pt.trainer.events.EndIteration) else None)
+    assert n["iters"] == 10
