@@ -72,6 +72,7 @@ from . import lr_decay
 from . import net_drawer
 from . import flags
 from . import trainer
+from . import image
 from . import models
 from .trainer import infer
 from . import framework  # compat alias namespace
@@ -87,5 +88,5 @@ __all__ = [
     "metrics", "io", "save_params", "load_params", "save_persistables",
     "load_persistables", "save_inference_model", "load_inference_model",
     "DataFeeder", "ParamAttr", "profiler", "parallel", "distributed",
-    "reader", "dataset", "trainer", "models", "infer",
+    "reader", "dataset", "trainer", "models", "infer", "image",
 ]

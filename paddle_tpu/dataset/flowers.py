@@ -9,7 +9,7 @@ channel-mean subtract, CHW).  Offline CI uses a deterministic synthetic
 generator with the same sample contract."""
 from __future__ import annotations
 
-import io
+
 import tarfile
 
 import numpy as np
@@ -37,39 +37,16 @@ NUM_CLASSES = 102
 CROP = 224
 
 
-def simple_transform(img_hwc, resize_to, crop_to, is_train, mean=MEAN):
-    """Reference paddle.v2.image.simple_transform: resize shorter side,
-    (random|center) crop, optional mirror, HWC→CHW, mean subtract."""
-    from PIL import Image
-
-    h, w = img_hwc.shape[:2]
-    scale = resize_to / min(h, w)
-    nh, nw = int(round(h * scale)), int(round(w * scale))
-    img = np.asarray(Image.fromarray(img_hwc).resize(
-        (nw, nh), Image.BILINEAR), dtype="float32")
-    if is_train:
-        r = np.random
-        top = r.randint(0, nh - crop_to + 1)
-        left = r.randint(0, nw - crop_to + 1)
-        flip = r.rand() < 0.5
-    else:
-        top, left, flip = (nh - crop_to) // 2, (nw - crop_to) // 2, False
-    img = img[top:top + crop_to, left:left + crop_to]
-    if flip:
-        img = img[:, ::-1]
-    img = img[:, :, ::-1] - mean            # RGB→BGR, mean subtract
-    return np.ascontiguousarray(img.transpose(2, 0, 1))
-
-
 def default_mapper(is_train, sample):
     """(jpeg_bytes, label) → (flat float32 CHW crop, label)
-    (flowers.py:58)."""
-    from PIL import Image
+    (flowers.py:58) — the reference transform via paddle_tpu.image
+    (BGR decode, short-side resize, crop/flip, CHW, mean subtract)."""
+    from .. import image
 
     data, label = sample
-    img = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
-    img = simple_transform(img, 256, CROP, is_train)
-    return img.reshape(-1), label
+    img = image.load_image_bytes(data)
+    img = image.simple_transform(img, 256, CROP, is_train, mean=MEAN)
+    return np.ascontiguousarray(img).reshape(-1), label
 
 
 def _loadmat_indices(path, key):

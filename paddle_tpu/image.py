@@ -1,0 +1,159 @@
+"""Image utilities (reference: python/paddle/v2/image.py — load/resize/
+crop/flip/transform helpers + batch_images_from_tar; HWC in, optional CHW
+out).
+
+PIL-backed instead of cv2 (not in this environment); same function
+surface and semantics: `load_image*` return HWC uint8 (BGR channel order,
+matching the reference's cv2 convention, so published per-channel means
+transfer verbatim), `simple_transform` resizes the short side, crops
+(random+flip when training, center otherwise), converts to CHW float32
+and subtracts the mean."""
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+__all__ = [
+    "load_image_bytes", "load_image", "resize_short", "to_chw",
+    "center_crop", "random_crop", "left_right_flip", "simple_transform",
+    "load_and_transform", "batch_images_from_tar",
+]
+
+
+def _to_bgr(arr, is_color):
+    if not is_color:
+        return arr
+    return arr[:, :, ::-1]            # PIL decodes RGB; reference is BGR
+
+
+def load_image_bytes(bytes, is_color=True):  # noqa: A002 (reference name)
+    """Decode an image from a bytes blob into HWC uint8 (BGR when color)
+    (image.py:98)."""
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(bytes))
+    img = img.convert("RGB" if is_color else "L")
+    return _to_bgr(np.asarray(img), is_color)
+
+
+def load_image(file, is_color=True):  # noqa: A002
+    """Load an image file into HWC uint8 (BGR when color) (image.py:122)."""
+    with open(file, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def resize_short(im, size):
+    """Resize so the SHORTER edge becomes ``size`` (image.py:150)."""
+    from PIL import Image
+
+    h, w = im.shape[:2]
+    scale = size / min(h, w)
+    nh, nw = int(round(h * scale)), int(round(w * scale))
+    return np.asarray(Image.fromarray(im).resize((nw, nh), Image.BILINEAR))
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """HWC → CHW (image.py:177)."""
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    """Center-crop a size×size window (image.py:201)."""
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True):
+    """Random size×size window (image.py:229)."""
+    h, w = im.shape[:2]
+    h_start = np.random.randint(0, h - size + 1)
+    w_start = np.random.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im):
+    """Horizontal mirror (image.py:257).  NB: the 2-D (grayscale) branch
+    flips VERTICALLY — reproduced bug-for-bug from the reference; do not
+    'fix' without breaking parity with models trained against it."""
+    return im[:, ::-1] if len(im.shape) == 3 else im[::-1, :]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short → (random crop + coin-flip mirror | center crop) →
+    CHW float32 → mean subtract (image.py:277)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1:
+            mean = mean[:, np.newaxis, np.newaxis]
+        else:
+            assert len(mean.shape) == len(im.shape)
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    """load_image + simple_transform (image.py:331)."""
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Repack tar members named in ``img2label`` into pickle batch files
+    of {'data': [jpeg bytes], 'label': [...]} and return the meta-file
+    listing them (image.py:35) — the cluster data-prep step the flowers
+    reader used."""
+    batch_dir = data_file + "_batch"
+    out_path = os.path.join(batch_dir, dataset_name)
+    meta_file = os.path.join(batch_dir, dataset_name + ".txt")
+    # the meta file is the commit marker (written last, atomically):
+    # a run killed mid-repack leaves no meta and is redone from scratch
+    if os.path.exists(meta_file):
+        return meta_file
+    if os.path.exists(out_path):
+        import shutil
+        shutil.rmtree(out_path)       # partial prior attempt
+    os.makedirs(out_path)
+
+    def dump(data, labels, file_id):
+        with open(os.path.join(out_path, f"batch_{file_id}"), "wb") as f:
+            pickle.dump({"label": labels, "data": data}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+    data, labels, file_id = [], [], 0
+    with tarfile.open(data_file) as tf:
+        for mem in tf.getmembers():
+            if mem.name in img2label:
+                data.append(tf.extractfile(mem).read())
+                labels.append(img2label[mem.name])
+                if len(data) == num_per_batch:
+                    dump(data, labels, file_id)
+                    file_id += 1
+                    data, labels = [], []
+    if data:
+        dump(data, labels, file_id)
+    tmp = meta_file + ".part"
+    with open(tmp, "w") as meta:
+        for fn in sorted(os.listdir(out_path)):
+            meta.write(os.path.abspath(os.path.join(out_path, fn)) + "\n")
+    os.replace(tmp, meta_file)
+    return meta_file

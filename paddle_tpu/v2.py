@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import types as _types
 
-from . import dataset, reader  # noqa: F401
+from . import dataset, image, reader  # noqa: F401
 from . import trainer as _trainer_mod
 from . import optimizer as _opt
 from .reader import batch  # noqa: F401

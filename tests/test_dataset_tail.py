@@ -282,3 +282,74 @@ def test_sentiment_classifier_demo():
 
     vals = _train_steps(loss, feeds, steps=40, lr=2.0)
     assert vals[-1] < vals[0] * 0.9
+
+
+# ---------------------------------------------------------------------------
+# paddle_tpu.image (reference v2/image.py)
+# ---------------------------------------------------------------------------
+def test_image_module_transforms(tmp_path):
+    from PIL import Image
+    from paddle_tpu import image
+
+    # BGR convention: a pure-red RGB image loads with red in channel 2
+    rgb = np.zeros((40, 60, 3), "uint8")
+    rgb[..., 0] = 200
+    buf = io.BytesIO()
+    Image.fromarray(rgb).save(buf, format="PNG")
+    im = image.load_image_bytes(buf.getvalue())
+    assert im.shape == (40, 60, 3)
+    assert im[..., 2].mean() == 200 and im[..., 0].mean() == 0
+
+    r = image.resize_short(im, 20)
+    assert min(r.shape[:2]) == 20 and r.shape[:2] == (20, 30)
+    c = image.center_crop(r, 16)
+    assert c.shape[:2] == (16, 16)
+    rc = image.random_crop(r, 16)
+    assert rc.shape[:2] == (16, 16)
+    assert image.left_right_flip(r).shape == r.shape
+    assert np.array_equal(image.left_right_flip(r), r[:, ::-1])
+    chw = image.to_chw(c)
+    assert chw.shape == (3, 16, 16)
+
+    t = image.simple_transform(im, 24, 16, is_train=False,
+                               mean=[10.0, 20.0, 30.0])
+    assert t.shape == (3, 16, 16) and t.dtype == np.float32
+    assert abs(float(t[2].mean()) - (200 - 30.0)) < 1e-5   # red - mean[2]
+    assert abs(float(t[0].mean()) - (0 - 10.0)) < 1e-5
+
+    # file round-trip + load_and_transform
+    p = tmp_path / "img.png"
+    Image.fromarray(rgb).save(p)
+    lt = image.load_and_transform(str(p), 24, 16, is_train=True)
+    assert lt.shape == (3, 16, 16)
+
+
+def test_batch_images_from_tar(tmp_path):
+    from PIL import Image
+    from paddle_tpu import image
+
+    tar_p = str(tmp_path / "imgs.tar")
+    with tarfile.open(tar_p, "w") as tf:
+        for i in range(5):
+            buf = io.BytesIO()
+            Image.fromarray(np.full((8, 8, 3), i * 40, "uint8")).save(
+                buf, format="JPEG")
+            blob = buf.getvalue()
+            info = tarfile.TarInfo(f"jpg/im_{i}.jpg")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    img2label = {f"jpg/im_{i}.jpg": i for i in range(5)}
+    meta = image.batch_images_from_tar(tar_p, "train", img2label,
+                                       num_per_batch=2)
+    files = [ln.strip() for ln in open(meta)]
+    assert len(files) == 3                       # 2+2+1
+    import pickle as pkl
+    total = []
+    for f in files:
+        with open(f, "rb") as fh:
+            b = pkl.load(fh)
+        assert len(b["data"]) == len(b["label"])
+        total.extend(b["label"])
+    assert sorted(total) == [0, 1, 2, 3, 4]
+    # idempotent: existing batch dir returns the same meta
+    assert image.batch_images_from_tar(tar_p, "train", img2label) == meta
