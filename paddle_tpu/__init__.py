@@ -73,6 +73,7 @@ from . import net_drawer
 from . import flags
 from . import trainer
 from . import image
+from . import utils
 from . import models
 from .trainer import infer
 from . import framework  # compat alias namespace
@@ -88,5 +89,5 @@ __all__ = [
     "metrics", "io", "save_params", "load_params", "save_persistables",
     "load_persistables", "save_inference_model", "load_inference_model",
     "DataFeeder", "ParamAttr", "profiler", "parallel", "distributed",
-    "reader", "dataset", "trainer", "models", "infer", "image",
+    "reader", "dataset", "trainer", "models", "infer", "image", "utils",
 ]

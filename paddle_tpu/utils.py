@@ -1,0 +1,122 @@
+"""User utilities (reference: python/paddle/utils/ — dump_config.py,
+make_model_diagram.py, merge_model.py, plotcurve.py; image_util/
+preprocess_img are subsumed by `paddle_tpu.image` + `reader.xmap_readers`,
+torch2paddle/predefined_net were one-off migration glue).
+
+Each helper here is the TPU-native equivalent of one reference script,
+operating on Programs / v1 configs instead of protobufs."""
+from __future__ import annotations
+
+import json
+import os
+import re
+import tarfile
+import tempfile
+
+import numpy as np
+
+__all__ = ["dump_config", "make_model_diagram", "merge_model",
+           "load_merged_model", "plotcurve"]
+
+
+def dump_config(config_path, config_args=None, as_json=True):
+    """Parse a v1 config file and return its full Program structure
+    (utils/dump_config.py: parse_config + print the TrainerConfig proto —
+    here the Program's dict serialization plays the proto's role)."""
+    from .trainer_config_helpers import load_v1_config
+
+    cfg = load_v1_config(config_path, **(config_args or {}))
+    d = cfg.main_program.to_dict()
+    return json.dumps(d, indent=1, default=str) if as_json else d
+
+
+def make_model_diagram(config_path=None, program=None, dot_path=None,
+                       config_args=None):
+    """DOT diagram of a model (utils/make_model_diagram.py).  Accepts a
+    v1 config path or a Program directly; returns the DOT source (and
+    writes it to ``dot_path`` if given)."""
+    from .net_drawer import draw_graph
+
+    if program is None:
+        from .trainer_config_helpers import load_v1_config
+        program = load_v1_config(config_path,
+                                 **(config_args or {})).main_program
+    return draw_graph(program, path=dot_path)
+
+
+def merge_model(output_file, program=None, scope=None):
+    """Merge model structure + parameters into ONE deployable file
+    (utils/merge_model.py merge_v2_model: config proto + Parameters →
+    single binary).  Format: a .tar.gz holding ``program.json`` (the IR)
+    and ``params.npz`` (every persistable scope array)."""
+    from .core.program import default_main_program
+    from .core.scope import global_scope
+
+    program = program or default_main_program()
+    scope = scope or global_scope()
+    persistable = {v.name for b in program.blocks
+                   for v in b.vars.values() if v.persistable}
+    params = {n: np.asarray(scope.get(n)) for n in sorted(persistable)
+              if scope.has(n)}
+    with tempfile.TemporaryDirectory() as td:
+        pj = os.path.join(td, "program.json")
+        with open(pj, "w") as f:
+            json.dump(program.to_dict(), f)
+        pp = os.path.join(td, "params.npz")
+        np.savez(pp, **params)
+        tmp = output_file + ".part"
+        with tarfile.open(tmp, "w:gz") as tf:
+            tf.add(pj, arcname="program.json")
+            tf.add(pp, arcname="params.npz")
+        os.replace(tmp, output_file)
+    return output_file
+
+
+def load_merged_model(path, scope=None):
+    """Load a `merge_model` artifact: returns the Program and installs the
+    parameters into ``scope`` (default global scope)."""
+    import io as _io
+
+    from .core.program import Program
+    from .core.scope import global_scope
+
+    scope = scope or global_scope()
+    with tarfile.open(path, "r:gz") as tf:
+        prog = Program.from_dict(json.load(tf.extractfile("program.json")))
+        blob = tf.extractfile("params.npz").read()
+    arrs = np.load(_io.BytesIO(blob))
+    for n in arrs.files:
+        scope.set(n, arrs[n])
+    return prog
+
+
+def plotcurve(log_lines, key="cost", output_path=None):
+    """Parse a training log into (pass_ids, values) for metric ``key``
+    and optionally plot it (utils/plotcurve.py: gnuplot the
+    'Pass N ... cost=X' lines; the output file was an argument there
+    too).  Accepts an iterable of lines or a file path; returns the
+    parsed arrays; writes a plot only when ``output_path`` is given
+    (requires matplotlib)."""
+    if isinstance(log_lines, str):
+        with open(log_lines) as f:
+            log_lines = f.readlines()
+    pat = re.compile(
+        r"Pass[= ](\d+).*?" + re.escape(key) + r"[= ]([0-9.eE+-]+)",
+        re.IGNORECASE)
+    ids, vals = [], []
+    for line in log_lines:
+        m = pat.search(line)
+        if m:
+            ids.append(int(m.group(1)))
+            vals.append(float(m.group(2)))
+    if output_path is not None:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        fig = plt.figure()
+        plt.plot(ids, vals, marker="o")
+        plt.xlabel("pass")
+        plt.ylabel(key)
+        fig.savefig(output_path)
+        plt.close(fig)
+    return np.asarray(ids), np.asarray(vals)

@@ -1,0 +1,85 @@
+"""paddle_tpu.utils tests (reference: python/paddle/utils/ —
+dump_config, make_model_diagram, merge_model, plotcurve)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, utils
+
+REF_CFG = "/root/reference/v1_api_demo/quick_start/trainer_config.lr.py"
+
+
+def _build(rng):
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1, name="mw")
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    xb = rng.rand(8, 4).astype("float32")
+    exe.run(feed={"x": xb, "y": xb.sum(1, keepdims=True)},
+            fetch_list=[loss])
+    return loss, exe
+
+
+@pytest.mark.skipif(not os.path.exists(REF_CFG),
+                    reason="reference not mounted")
+def test_dump_config_and_diagram(tmp_path, monkeypatch):
+    # the config reads ./data/dict.txt at evaluation time
+    (tmp_path / "data").mkdir()
+    with open(tmp_path / "data" / "dict.txt", "w") as f:
+        for i in range(30):
+            f.write(f"word{i}\t{i}\n")
+    monkeypatch.chdir(tmp_path)
+    args = {"dict_file": str(tmp_path / "data" / "dict.txt")}
+    s = utils.dump_config(REF_CFG, config_args=args)
+    d = json.loads(s)
+    assert d["blocks"][0]["ops"], "dump contains ops"
+    dot = utils.make_model_diagram(REF_CFG, config_args=args,
+                                   dot_path=str(tmp_path / "m.dot"))
+    assert "digraph" in dot and (tmp_path / "m.dot").exists()
+
+
+def test_merge_and_load_model_roundtrip(tmp_path, rng):
+    loss, exe = _build(rng)
+    w_before = np.asarray(pt.global_scope().get("mw.w_0")).copy()
+    out = utils.merge_model(str(tmp_path / "model.tar.gz"))
+    assert os.path.exists(out)
+
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    prog = utils.load_merged_model(out)
+    w_after = np.asarray(pt.global_scope().get("mw.w_0"))
+    np.testing.assert_array_equal(w_before, w_after)
+    # the restored program is runnable: same loss vs rebuilt feeds
+    loss_vars = [v for b in prog.blocks
+                 for v in b.vars.values() if "mean" in v.name]
+    assert loss_vars and prog.global_block().ops
+
+
+def test_plotcurve_parses_log(tmp_path):
+    log = ["Pass 0, Batch 10, Cost=2.5",
+           "noise line",
+           "Pass=1 avg cost=1.25",
+           "Pass 2, Cost 0.7 acc=0.9"]
+    ids, costs = utils.plotcurve(log)
+    assert ids.tolist() == [0, 1, 2]
+    assert costs.tolist() == [2.5, 1.25, 0.7]
+    p = tmp_path / "train.log"
+    p.write_text("\n".join(log) + "\n")
+    ids2, costs2 = utils.plotcurve(str(p))
+    assert ids2.tolist() == ids.tolist()
+    # key selects the metric; no plot file unless output_path given
+    ids3, accs = utils.plotcurve(["Pass 0 Cost=2.0 acc=0.5"], key="acc")
+    assert accs.tolist() == [0.5]
+    assert not (tmp_path / "plot.png").exists()
+    out = tmp_path / "curve.png"
+    try:
+        utils.plotcurve(log, output_path=str(out))
+        assert out.exists()
+    except ImportError:
+        pass
