@@ -90,10 +90,11 @@ def job_train(cfg, exe, feeds, args):
         pt.load_persistables(exe, args.init_model_path, cfg.main_program)
     steps = args.steps_per_pass
     for p in range(args.num_passes):
-        vals = [float(exe.run(cfg.main_program, feed=feeds,
-                              fetch_list=[loss])[0])
-                for _ in range(steps)]
-        print(json.dumps({"pass": p, "loss": vals[-1],
+        # one compiled dispatch per pass (device-side scan over the steps)
+        (vals,) = exe.run_steps(steps, cfg.main_program, feed=feeds,
+                                fetch_list=[loss])
+        vals = np.asarray(vals).reshape(-1)
+        print(json.dumps({"pass": p, "loss": float(vals[-1]),
                           "mean_loss": float(np.mean(vals))}), flush=True)
         if args.save_dir:
             d = os.path.join(args.save_dir, f"pass-{p:05d}")
@@ -119,17 +120,21 @@ def job_test(cfg, exe, feeds, args):
 
 
 def job_time(cfg, exe, feeds, args):
+    """TrainerMain's timing job with the compiled-window methodology
+    (benchmark/RESULTS.md): the timed window is ONE run_steps dispatch, so
+    host dispatch latency is out of the measurement."""
     cfg.minimize_outputs()
     loss = cfg.outputs[0]
     exe.run(cfg.startup_program, feed={}, fetch_list=[])
-    for _ in range(args.warmup):
-        exe.run(cfg.main_program, feed=feeds, fetch_list=[loss])
+    # the untimed first call MUST use the same num_steps as the timed one:
+    # run_steps compiles per scan length, so it is the compile + warmup
+    (lv,) = exe.run_steps(args.iters, cfg.main_program, feed=feeds,
+                          fetch_list=[loss], return_numpy=False)
+    assert np.isfinite(np.asarray(lv)[-1])
     t0 = time.perf_counter()
-    for _ in range(args.iters - 1):
-        exe.run(cfg.main_program, feed=feeds, fetch_list=[],
-                return_numpy=False)
-    (lv,) = exe.run(cfg.main_program, feed=feeds, fetch_list=[loss])
-    assert np.isfinite(float(lv))
+    (lv,) = exe.run_steps(args.iters, cfg.main_program, feed=feeds,
+                          fetch_list=[loss], return_numpy=False)
+    assert np.isfinite(np.asarray(lv)[-1])
     dt = (time.perf_counter() - t0) / args.iters
     print(json.dumps({"ms_per_batch": round(dt * 1e3, 3),
                       "batches_per_sec": round(1.0 / dt, 2)}), flush=True)

@@ -106,9 +106,14 @@ def plotcurve(log_lines, key="cost", output_path=None):
     ids, vals = [], []
     for line in log_lines:
         m = pat.search(line)
-        if m:
-            ids.append(int(m.group(1)))
-            vals.append(float(m.group(2)))
+        if not m:
+            continue
+        try:
+            v = float(m.group(2))
+        except ValueError:          # malformed value (e.g. 'cost=...')
+            continue
+        ids.append(int(m.group(1)))
+        vals.append(v)
     if output_path is not None:
         import matplotlib
         matplotlib.use("Agg")

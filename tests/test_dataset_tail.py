@@ -353,3 +353,35 @@ def test_batch_images_from_tar(tmp_path):
     assert sorted(total) == [0, 1, 2, 3, 4]
     # idempotent: existing batch dir returns the same meta
     assert image.batch_images_from_tar(tar_p, "train", img2label) == meta
+
+
+def test_wmt14_tgz_parser(tmp_path, monkeypatch):
+    """Official-layout wmt14.tgz (src.dict/trg.dict + tab-separated
+    parallel files) parses with <s>/<e> framing, UNK mapping, and the
+    >80-token drop (reference wmt14.py:45,71)."""
+    from paddle_tpu.dataset import wmt14
+
+    d = tmp_path / "wmt14"
+    os.makedirs(d)
+    src_dict = "\n".join(["<s>", "<e>", "<unk>", "hello", "world"])
+    trg_dict = "\n".join(["<s>", "<e>", "<unk>", "bonjour", "monde"])
+    train = ("hello world\tbonjour monde\n"
+             "hello oov\tbonjour oov\n"
+             + " ".join(["hello"] * 90) + "\tbonjour\n")   # dropped: >80
+    tar_p = d / "wmt14.tgz"
+    with tarfile.open(tar_p, "w:gz") as tf:
+        for name, text in [("wmt14/train/src.dict", src_dict),
+                           ("wmt14/train/trg.dict", trg_dict),
+                           ("wmt14/train/train", train)]:
+            blob = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    wmt14._DICT_MEMO.clear()
+    samples = list(wmt14._tar_reader(str(tar_p), "train/train", 5)())
+    assert len(samples) == 2                    # long pair dropped
+    src, trg, nxt = samples[0]
+    assert src == [0, 3, 4, 1]                  # <s> hello world <e>
+    assert trg == [0, 3, 4] and nxt == [3, 4, 1]
+    # oov maps to UNK_IDX
+    assert samples[1][0] == [0, 3, 2, 1]

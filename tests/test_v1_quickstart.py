@@ -52,7 +52,8 @@ def test_quickstart_lr(qs_cwd, rng):
 @pytest.mark.parametrize("conf", ["trainer_config.emb.py",
                                   "trainer_config.cnn.py",
                                   "trainer_config.lstm.py",
-                                  "trainer_config.bidi-lstm.py"])
+                                  "trainer_config.bidi-lstm.py",
+                                  "trainer_config.db-lstm.py"])
 def test_quickstart_sequence_configs(qs_cwd, rng, conf):
     cfg = load_v1_config(os.path.join(QS, conf), dict_file=qs_cwd)
     _train(cfg, _seq_feeds(rng))
