@@ -48,7 +48,7 @@ def test_cli_train_saves_and_test_loads(tmp_path):
 
 def test_cli_time(tmp_path):
     r = _run("--config", CONF, "--job", "time", "--iters", "8",
-             "--warmup", "2")
+             )
     assert r.returncode == 0, r.stderr
     rec = _json_lines(r.stdout)[-1]
     assert rec["ms_per_batch"] > 0 and rec["batches_per_sec"] > 0
