@@ -32,6 +32,7 @@ from . import trainer as _trainer_mod
 from . import optimizer as _opt
 from .reader import batch  # noqa: F401
 from .trainer import events, infer  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
 from . import trainer_config_helpers as _dsl
 
 
@@ -43,71 +44,123 @@ def init(use_gpu=None, use_tpu=None, trainer_count=1, **kw):
     return None
 
 
-# -- paddle.layer ------------------------------------------------------------
-layer = _types.SimpleNamespace(
-    data=_dsl.data_layer,
-    fc=_dsl.fc_layer,
-    img_conv=_dsl.img_conv_layer,
-    img_pool=_dsl.img_pool_layer,
-    img_cmrnorm=_dsl.img_cmrnorm_layer,
-    batch_norm=_dsl.batch_norm_layer,
-    dropout=_dsl.dropout_layer,
-    embedding=_dsl.embedding_layer,
-    concat=_dsl.concat_layer,
-    addto=_dsl.addto_layer,
-    lstmemory=_dsl.lstmemory,
-    simple_lstm=_dsl.simple_lstm,
-    last_seq=_dsl.last_seq,
-    first_seq=_dsl.first_seq,
-    classification_cost=_dsl.classification_cost,
-    cross_entropy_cost=_dsl.cross_entropy_cost,
+# -- paddle.data_type (v2/data_type.py: InputType descriptors) ---------------
+class InputType:
+    """v2 InputType: dim + sequence level + value kind."""
+
+    def __init__(self, dim, seq_type, type):  # noqa: A002 (reference name)
+        self.dim = dim
+        self.seq_type = seq_type
+        self.type = type
+
+
+def _dt(kind, seq):
+    def f(dim=None, *a, **kw):
+        return InputType(dim, seq, kind)
+    return f
+
+
+data_type = _types.SimpleNamespace(
+    dense_vector=_dt("dense", 0),
+    dense_array=_dt("dense", 0),
+    dense_vector_sequence=_dt("dense", 1),
+    integer_value=_dt("int", 0),
+    integer_value_sequence=_dt("int", 1),
+    integer_value_sub_sequence=_dt("int", 2),
+    sparse_binary_vector=_dt("sparse_binary", 0),
+    sparse_binary_vector_sequence=_dt("sparse_binary", 1),
+    sparse_float_vector=_dt("sparse_float", 0),
+    sparse_float_vector_sequence=_dt("sparse_float", 1),
+    InputType=InputType,
+)
+
+
+def _v2_data(name, type=None, size=None, **kw):  # noqa: A002
+    """v2 layer.data(name=, type=paddle.data_type.X(dim)): creates the v1
+    data layer and eagerly applies the InputType's dtype/sequence level
+    (the v1 path retypes lazily at first integer use).  The v1-style
+    positional ``data(name, size)`` form still works."""
+    import numpy as _np
+    if type is not None and not isinstance(type, InputType):
+        if isinstance(type, int) and size is None:
+            type, size = None, type      # v1 positional data(name, size)
+        else:
+            raise TypeError(
+                f"layer.data 'type' must be a paddle.data_type InputType "
+                f"(got {type!r}); for the v1 form use data(name, size=N)")
+    if type is not None:
+        size = type.dim if type.dim is not None else size
+    v = _dsl.data_layer(name, size, **kw)
+    if type is None:
+        return v
+    if type.type == "int":
+        v.dtype = _np.dtype("int64")
+        if type.seq_type:
+            v.lod_level = type.seq_type
+            v.shape = (-1, -1)
+        else:
+            v.shape = (-1, 1)
+    elif type.type == "dense":
+        if type.seq_type:                # dense sequence: [B, T, dim]
+            v.lod_level = type.seq_type
+            v.shape = (-1, -1, type.dim)
+    else:
+        raise NotImplementedError(
+            f"sparse input type {type.type!r} is not supported: feed "
+            f"dense rows (dense_vector) or integer id lists "
+            f"(integer_value_sequence) instead — SelectedRows-style "
+            f"sparsity lives in the embedding tables, not the feeds")
+    return v
+
+
+# -- paddle.layer / paddle.networks ------------------------------------------
+# The v2 layer module auto-generates its surface from trainer_config_helpers
+# (v2/layer.py: every *_layer becomes the suffix-stripped name).  The DSL now
+# exports the full 133-function surface, so build the namespaces from it.
+_layer_ns = {}
+for _n in _dsl.__all__:
+    _obj = getattr(_dsl, _n, None)
+    if _obj is None:
+        continue
+    _layer_ns.setdefault(_n, _obj)
+    if _n.endswith("_layer"):
+        _layer_ns[_n[:-len("_layer")]] = _obj
+_layer_ns.update(
+    data=_v2_data,
     square_error_cost=_dsl.regression_cost,
     regression_cost=_dsl.regression_cost,
-    # sequence / generation DSL surface (round-3 additions)
-    recurrent_group=_dsl.recurrent_group,
-    memory=_dsl.memory,
-    mixed=_dsl.mixed_layer,
-    full_matrix_projection=_dsl.full_matrix_projection,
-    table_projection=_dsl.table_projection,
-    identity_projection=_dsl.identity_projection,
-    dotmul_projection=_dsl.dotmul_projection,
-    trans_full_matrix_projection=_dsl.trans_full_matrix_projection,
-    recurrent=_dsl.recurrent_layer,
-    lstmemory_group=_dsl.lstmemory_group,
-    grumemory=_dsl.grumemory,
-    gru_group=_dsl.gru_group,
-    simple_gru=_dsl.simple_gru,
-    beam_search=_dsl.beam_search,
-    crf=_dsl.crf_layer,
-    crf_decoding=_dsl.crf_decoding_layer,
     max_id=_dsl.maxid_layer,
-    pooling=_dsl.pooling_layer,
-    expand=_dsl.expand_layer,
-    scaling=_dsl.scaling_layer,
-    StaticInput=_dsl.StaticInput,
-    GeneratedInput=_dsl.GeneratedInput,
-    SubsequenceInput=_dsl.SubsequenceInput,
 )
+layer = _types.SimpleNamespace(**_layer_ns)
 
-# paddle.networks (v2 networks namespace: the composite helpers)
+_net_names = (
+    "simple_lstm", "simple_gru", "simple_gru2", "bidirectional_lstm",
+    "bidirectional_gru", "sequence_conv_pool", "simple_attention",
+    "dot_product_attention", "multi_head_attention", "img_conv_group",
+    "simple_img_conv_pool", "img_conv_bn_pool", "img_separable_conv",
+    "vgg_16_network", "small_vgg", "lstmemory_unit", "lstmemory_group",
+    "gru_unit", "gru_group", "simple_lstmemory_group", "text_conv_pool",
+)
 networks = _types.SimpleNamespace(
-    simple_lstm=_dsl.simple_lstm,
-    simple_gru=_dsl.simple_gru,
-    bidirectional_lstm=_dsl.bidirectional_lstm,
-    sequence_conv_pool=_dsl.sequence_conv_pool,
-    simple_attention=_dsl.simple_attention,
-    img_conv_group=_dsl.img_conv_group,
-)
+    **{n: getattr(_dsl, n) for n in _net_names if hasattr(_dsl, n)})
 
-# -- paddle.activation / paddle.pooling --------------------------------------
+# -- paddle.activation / paddle.pooling / paddle.attr ------------------------
 activation = _types.SimpleNamespace(
-    Linear=_dsl.LinearActivation, Relu=_dsl.ReluActivation,
-    Sigmoid=_dsl.SigmoidActivation, Tanh=_dsl.TanhActivation,
-    Softmax=_dsl.SoftmaxActivation, Identity=_dsl.IdentityActivation,
-)
+    **{n[:-len("Activation")]: getattr(_dsl, n) for n in _dsl.__all__
+       if n.endswith("Activation")})
 pooling = _types.SimpleNamespace(
-    Max=_dsl.MaxPooling, Avg=_dsl.AvgPooling, Sum=_dsl.SumPooling,
-)
+    **{n[:-len("Pooling")]: getattr(_dsl, n) for n in _dsl.__all__
+       if n.endswith("Pooling")})
+attr = _types.SimpleNamespace(
+    Param=_dsl.ParamAttr, ParamAttr=_dsl.ParamAttr,
+    Extra=_dsl.ExtraAttr, ExtraAttr=_dsl.ExtraAttr,
+    ParameterAttribute=_dsl.ParamAttr,
+    ExtraLayerAttribute=_dsl.ExtraLayerAttribute)
+
+# -- paddle.evaluator (v2 evaluator namespace: *_evaluator stripped) ---------
+evaluator = _types.SimpleNamespace(
+    **{n[:-len("_evaluator")]: getattr(_dsl, n) for n in _dsl.__all__
+       if n.endswith("_evaluator")})
 
 
 # -- paddle.optimizer (v2 signature: momentum first, lr kwarg) ---------------

@@ -289,3 +289,78 @@ def test_trainer_steps_per_dispatch_matches_per_batch(rng):
              event_handler=lambda e: n.__setitem__("iters", n["iters"] + 1)
              if isinstance(e, pt.trainer.events.EndIteration) else None)
     assert n["iters"] == 10
+
+
+def test_v2_full_namespace_and_data_type_idiom(rng):
+    """The auto-generated v2 facade: every DSL *_layer appears suffix-
+    stripped in paddle.layer, data_type InputTypes retype data layers, and
+    the classic v2 script shape (data_type + pooling_type + event loop)
+    trains (reference: python/paddle/v2/layer.py auto-generation +
+    data_type.py)."""
+    import paddle_tpu.v2 as paddle
+
+    # surface: the suffix-stripped names exist for the full DSL
+    import paddle_tpu.trainer_config_helpers as tch
+    for n in tch.__all__:
+        if n.endswith("_layer"):
+            assert hasattr(paddle.layer, n[:-6]), n
+    for ns, names in [(paddle.activation, ["Relu", "Softmax", "Linear"]),
+                      (paddle.pooling, ["Max", "Avg", "Sum"]),
+                      (paddle.attr, ["Param", "Extra"]),
+                      (paddle.evaluator, ["classification_error"]),
+                      (paddle.networks, ["vgg_16_network",
+                                         "bidirectional_gru"])]:
+        for n in names:
+            assert hasattr(ns, n), n
+
+    # data_type idiom end-to-end
+    words = paddle.layer.data(
+        name="w2", type=paddle.data_type.integer_value_sequence(100))
+    lab = paddle.layer.data(name="l2",
+                            type=paddle.data_type.integer_value(2))
+    assert words.dtype == np.dtype("int64") and words.lod_level == 1
+    emb = paddle.layer.embedding(input=words, size=16)
+    pooled = paddle.layer.pooling(input=emb,
+                                  pooling_type=paddle.pooling.Max())
+    out = paddle.layer.fc(input=pooled, size=2,
+                          act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=out, label=lab)
+    tr = paddle.trainer.SGD(
+        cost=cost,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+
+    def reader():
+        for _ in range(48):
+            toks = rng.randint(2, 100, rng.randint(3, 9)).tolist()
+            yield toks, toks[0] % 2
+
+    costs = []
+    tr.train(paddle.batch(reader, 16), num_passes=3,
+             event_handler=lambda e: costs.append(e.cost)
+             if isinstance(e, paddle.event.EndIteration) else None,
+             feed_list=[words, lab])
+    assert costs[-1] < costs[0]
+
+
+def test_v2_data_type_forms(rng):
+    """layer.data accepts the v1 positional form, dense sequences get
+    lod+shape, sparse types raise with guidance, wrong types raise
+    TypeError (review findings)."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.data_feeder import DataFeeder
+
+    v = paddle.layer.data("pixel9", 784)
+    assert v.shape == (-1, 784)
+    ds = paddle.layer.data(name="ds9",
+                           type=paddle.data_type.dense_vector_sequence(4))
+    assert ds.lod_level == 1 and ds.shape == (-1, -1, 4)
+    rows = [([np.ones(4), np.zeros(4)],), ([np.ones(4)] * 3,)]
+    feed = DataFeeder([ds]).feed(rows)
+    a = np.asarray(feed["ds9"])
+    assert a.shape[0] == 2 and a.shape[1] >= 3 and a.shape[2] == 4
+    assert np.asarray(feed["ds9@LEN"]).tolist() == [2, 3]
+    with pytest.raises(NotImplementedError):
+        paddle.layer.data(name="sb9",
+                          type=paddle.data_type.sparse_binary_vector(9))
+    with pytest.raises(TypeError):
+        paddle.layer.data("x9", 7, 3)
