@@ -46,7 +46,7 @@ def _load_feeds(path: Optional[str]):
     return {k: data[k] for k in data.files}
 
 
-def _synth_feeds(cfg, batch: int, seed: int = 0):
+def _synth_feeds(cfg, batch: int, seed: int = 0, seq_len: int = 12):
     """Random feeds shaped from the config's data layers (the fake-data
     provider TrainerMain's time job leaned on)."""
     rng = np.random.RandomState(seed)
@@ -55,7 +55,7 @@ def _synth_feeds(cfg, batch: int, seed: int = 0):
         if v.dtype == np.dtype("int64"):
             vocab = getattr(v, "v1_size", None) or 2
             if v.lod_level:
-                T = 12
+                T = seq_len
                 feeds[name] = rng.randint(0, vocab, (batch, T))
                 feeds[name + "@LEN"] = np.full(batch, T)
             else:
@@ -214,6 +214,8 @@ def main(argv=None):
     ap.add_argument("--num_passes", type=int, default=1)
     ap.add_argument("--steps_per_pass", type=int, default=10)
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--seq_len", type=int, default=12,
+                    help="synthetic-feed sequence length")
     ap.add_argument("--save_dir", default=None)
     ap.add_argument("--init_model_path", default=None)
     ap.add_argument("--use_amp", action="store_true")
@@ -224,7 +226,7 @@ def main(argv=None):
 
     cfg = load_v1_config(args.config, **_parse_config_args(args.config_args))
     batch = args.batch or cfg.settings.get("batch_size") or 16
-    feeds = _load_feeds(args.feed_npz) or _synth_feeds(cfg, batch)
+    feeds = _load_feeds(args.feed_npz) or _synth_feeds(cfg, batch, seq_len=args.seq_len)
     used = _used_feed_names(cfg)
     feeds = {k: v for k, v in feeds.items() if k in used}
     # stage feeds on device ONCE: re-uploading a big batch per dispatch

@@ -705,6 +705,11 @@ def _install_import_shim():
     sys.modules["paddle.trainer_config_helpers"] = this
 
 
+# reference networks.py:136 — text_conv_pool is sequence_conv_pool
+text_conv_pool = sequence_conv_pool
+__all__.append("text_conv_pool")
+
+
 def load_v1_config(path, **config_args):
     """Evaluate a v1 config file (the config_parser.parse_config role,
     config_parser.py:126) against a fresh program pair.  Python-2-era

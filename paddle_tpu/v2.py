@@ -139,7 +139,7 @@ _net_names = (
     "dot_product_attention", "multi_head_attention", "img_conv_group",
     "simple_img_conv_pool", "img_conv_bn_pool", "img_separable_conv",
     "vgg_16_network", "small_vgg", "lstmemory_unit", "lstmemory_group",
-    "gru_unit", "gru_group", "simple_lstmemory_group", "text_conv_pool",
+    "gru_unit", "gru_group", "text_conv_pool",
 )
 networks = _types.SimpleNamespace(
     **{n: getattr(_dsl, n) for n in _net_names if hasattr(_dsl, n)})
