@@ -162,6 +162,38 @@ evaluator = _types.SimpleNamespace(
     **{n[:-len("_evaluator")]: getattr(_dsl, n) for n in _dsl.__all__
        if n.endswith("_evaluator")})
 
+# -- paddle.op (v2/op.py: unary math over layers; the +-*/ overloads live
+# on core Variable so every front end gets them) -----------------------------
+from .trainer_config_helpers import layer_math as _lm  # noqa: E402
+
+op = _types.SimpleNamespace(
+    **{n: getattr(_lm, n) for n in _lm.__all__})
+
+
+# -- paddle.inference (v2/inference.py Inference class) ----------------------
+class Inference:
+    """v2 Inference: bind an output layer once, infer repeatedly
+    (inference.py:10; parameters are the live scope here).  ``field``
+    keeps the reference semantics: 'value' returns the raw outputs, 'id'
+    the argmax ids."""
+
+    def __init__(self, output_layer, parameters=None):
+        self._out = output_layer
+
+    def infer(self, input, feeding=None, field="value", *,  # noqa: A002
+              feed_list=None, **kw):
+        import numpy as _np
+        res = infer(output_layer=self._out, input=input,
+                    feed_list=feed_list, feeding=feeding, **kw)
+        if field == "value":
+            return res
+        if field == "id":
+            return _np.argmax(_np.asarray(res), axis=-1)
+        raise ValueError(f"field must be 'value' or 'id', got {field!r}")
+
+
+inference = _types.SimpleNamespace(Inference=Inference, infer=infer)
+
 
 # -- paddle.optimizer (v2 signature: momentum first, lr kwarg) ---------------
 class _V2Opt:

@@ -364,3 +364,28 @@ def test_v2_data_type_forms(rng):
                           type=paddle.data_type.sparse_binary_vector(9))
     with pytest.raises(TypeError):
         paddle.layer.data("x9", 7, 3)
+
+
+def test_v2_op_and_inference_namespaces(rng):
+    """paddle.op unary math over layers and the Inference class
+    (reference v2/op.py, v2/inference.py)."""
+    import paddle_tpu.v2 as paddle
+
+    x = paddle.layer.data(name="xi2", type=paddle.data_type.dense_vector(8))
+    h = paddle.layer.fc(input=x, size=4, act=paddle.activation.Relu())
+    y = paddle.op.sqrt(paddle.op.square(h))
+    out = paddle.layer.fc(input=y, size=2, act=paddle.activation.Softmax())
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    inf = paddle.inference.Inference(output_layer=out)
+    res = inf.infer(input=[(rng.rand(8).astype("float32"),)],
+                    feed_list=[x])
+    a = np.asarray(res)
+    assert a.shape == (1, 2) and np.allclose(a.sum(), 1.0, atol=1e-5)
+    # field='id' returns argmax ids (reference inference.py semantics)
+    ids = inf.infer(input=[(rng.rand(8).astype("float32"),)],
+                    feed_list=[x], field="id")
+    assert ids.shape == (1,) and ids[0] in (0, 1)
+    with pytest.raises(ValueError):
+        inf.infer(input=[(rng.rand(8).astype("float32"),)],
+                  feed_list=[x], field="prob")
