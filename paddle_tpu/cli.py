@@ -90,7 +90,15 @@ def job_train(cfg, exe, feeds, args):
     if args.init_model_path:
         pt.load_persistables(exe, args.init_model_path, cfg.main_program)
     steps = args.steps_per_pass
-    for p in range(args.num_passes):
+    # --start_pass resume semantics (Flags.cpp:81, TrainerMain.cpp:25):
+    # saved pass dirs keep their true index; num_passes is the TOTAL pass
+    # index bound, so resuming past it is a usage error, not a no-op
+    if args.start_pass >= args.num_passes:
+        raise SystemExit(
+            f"--start_pass={args.start_pass} >= --num_passes="
+            f"{args.num_passes}: nothing to train (num_passes is the "
+            f"total pass count, not additional passes)")
+    for p in range(args.start_pass, args.num_passes):
         # one compiled dispatch per pass (device-side scan over the steps)
         (vals,) = exe.run_steps(steps, cfg.main_program, feed=feeds,
                                 fetch_list=[loss])
@@ -212,6 +220,9 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=None,
                     help="synthetic-feed batch (default: settings batch)")
     ap.add_argument("--num_passes", type=int, default=1)
+    ap.add_argument("--start_pass", type=int, default=0,
+                    help="resume pass numbering (use with "
+                         "--init_model_path)")
     ap.add_argument("--steps_per_pass", type=int, default=10)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--seq_len", type=int, default=12,

@@ -59,3 +59,37 @@ def test_cli_checkgrad():
     assert r.returncode == 0, r.stderr + r.stdout
     final = _json_lines(r.stdout)[-1]
     assert final["checkgrad"] == "PASS"
+
+
+def test_cli_start_pass_resume(tmp_path):
+    """--save_dir + --init_model_path + --start_pass: train 1 pass, resume
+    from its checkpoint at pass 1 (Flags.cpp:81 resume semantics)."""
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(
+        "from paddle_tpu.trainer_config_helpers import *\n"
+        "settings(batch_size=8, learning_rate=0.1)\n"
+        "x = data_layer('x', 4)\n"
+        "y = data_layer('label', 2)\n"
+        "h = fc_layer(input=x, size=8, act=ReluActivation())\n"
+        "out = fc_layer(input=h, size=2, act=SoftmaxActivation())\n"
+        "outputs(classification_cost(input=out, label=y))\n")
+    def run(*extra):
+        out = _run(f"--config={cfg}", "--job=train", "--steps_per_pass=3",
+                   "--batch=8", *extra)
+        assert out.returncode == 0, out.stderr[-800:]
+        return _json_lines(out.stdout)
+
+    d = tmp_path / "saves"
+    first = run("--num_passes=1", f"--save_dir={d}")
+    assert first[0]["pass"] == 0 and (d / "pass-00000").is_dir()
+    second = run("--num_passes=3", "--start_pass=1",
+                 f"--init_model_path={d / 'pass-00000'}",
+                 f"--save_dir={d}")
+    assert [r["pass"] for r in second] == [1, 2]
+    assert (d / "pass-00002").is_dir()
+    # resumed training continues from the saved weights: loss keeps falling
+    assert second[-1]["mean_loss"] < first[0]["mean_loss"]
+    # start_pass past num_passes is a usage error, not a silent no-op
+    bad = _run(f"--config={cfg}", "--job=train", "--start_pass=1",
+               "--batch=8")
+    assert bad.returncode != 0 and "nothing to train" in bad.stderr
