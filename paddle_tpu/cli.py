@@ -93,11 +93,11 @@ def job_train(cfg, exe, feeds, args):
     # --start_pass resume semantics (Flags.cpp:81, TrainerMain.cpp:25):
     # saved pass dirs keep their true index; num_passes is the TOTAL pass
     # index bound, so resuming past it is a usage error, not a no-op
-    if args.start_pass >= args.num_passes:
+    if not 0 <= args.start_pass < args.num_passes:
         raise SystemExit(
-            f"--start_pass={args.start_pass} >= --num_passes="
-            f"{args.num_passes}: nothing to train (num_passes is the "
-            f"total pass count, not additional passes)")
+            f"--start_pass={args.start_pass} must be in [0, "
+            f"--num_passes={args.num_passes}) — num_passes is the total "
+            f"pass count, not additional passes")
     for p in range(args.start_pass, args.num_passes):
         # one compiled dispatch per pass (device-side scan over the steps)
         (vals,) = exe.run_steps(steps, cfg.main_program, feed=feeds,

@@ -125,11 +125,23 @@ for _n in _dsl.__all__:
     _layer_ns.setdefault(_n, _obj)
     if _n.endswith("_layer"):
         _layer_ns[_n[:-len("_layer")]] = _obj
+def _parse_network(*output_layers, extra_layers=None):
+    """v2 layer.parse_network (v2/layer.py:263): the model config for the
+    given outputs — here the pruned Program slice (the ModelConfig proto's
+    role; serialize with .to_dict())."""
+    outs = []
+    for o in output_layers:
+        outs.extend(o if isinstance(o, (list, tuple)) else [o])
+    outs.extend(extra_layers or [])
+    return outs[0].block.program.prune(outs)
+
+
 _layer_ns.update(
     data=_v2_data,
     square_error_cost=_dsl.regression_cost,
     regression_cost=_dsl.regression_cost,
     max_id=_dsl.maxid_layer,
+    parse_network=_parse_network,
 )
 layer = _types.SimpleNamespace(**_layer_ns)
 

@@ -377,6 +377,9 @@ def test_v2_op_and_inference_namespaces(rng):
     out = paddle.layer.fc(input=y, size=2, act=paddle.activation.Softmax())
     exe = pt.Executor()
     exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    # parse_network: the api_train.py idiom for the model config
+    cfg_prog = paddle.layer.parse_network(out)
+    assert cfg_prog.global_block().ops and cfg_prog.to_dict()["blocks"]
     inf = paddle.inference.Inference(output_layer=out)
     res = inf.infer(input=[(rng.rand(8).astype("float32"),)],
                     feed_list=[x])
