@@ -1215,11 +1215,15 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     return counter
 
 
-def flash_attention(q, k, v, causal=False, block_q=512, block_k=512,
-                    name=None):
+def flash_attention(q, k, v, causal=False, block_q=1024, block_k=1024,
+                    sequence_parallel=True, name=None):
     """Fused O(T)-memory attention (Pallas kernel on TPU; exact).  q/k/v:
     [B, T, H, D] or [BH, T, D].  The long-context path the reference never
-    had — pairs with parallel.ring_attention for sp-sharded sequences."""
+    had.  Under a ``ShardedExecutor`` whose mesh has sp>1, eligible
+    self-attention (Tq==Tk, T divisible by sp) automatically lowers to
+    ring attention over the sp axis — K/V circulate on ICI, O(T/sp)
+    memory per device; pass ``sequence_parallel=False`` to force the
+    device-global kernel."""
     helper = LayerHelper("flash_attention", name=name)
     out_shape = tuple(q.shape[:-1]) + (v.shape[-1],)
     out = helper.create_variable_for_type_inference(q.dtype, out_shape)
@@ -1227,7 +1231,8 @@ def flash_attention(q, k, v, causal=False, block_q=512, block_k=512,
                      inputs={"Q": [q], "K": [k], "V": [v]},
                      outputs={"Out": [out]},
                      attrs={"causal": causal, "block_q": block_q,
-                            "block_k": block_k})
+                            "block_k": block_k,
+                            "sequence_parallel": sequence_parallel})
     return out
 
 

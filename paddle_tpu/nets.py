@@ -56,16 +56,19 @@ def glu(input, dim=-1):
 
 
 def scaled_dot_product_attention(queries, keys, values, num_heads=1,
-                                 dropout_rate=0.0):
-    """Single-mesh attention block (fluid nets.py analog).  Routes through
-    the fused flash-attention kernel (Pallas on TPU) unless attention-weight
-    dropout is requested, which the fused kernel does not express.  For
-    sharded / ring variants see paddle_tpu.parallel.ring_attention."""
+                                 dropout_rate=0.0, sequence_parallel=True):
+    """Attention block (fluid nets.py analog).  Routes through the fused
+    flash-attention kernel (Pallas on TPU) unless attention-weight dropout
+    is requested, which the fused kernel does not express.  Under a
+    ``ShardedExecutor`` with an sp>1 mesh axis, the kernel further lowers
+    to ring attention over the sequence ring (see layers.flash_attention);
+    ``sequence_parallel=False`` opts out."""
     # route 3-D [B, T, D] self/cross attention through the fused kernel;
     # 4-D callers here historically used [B, H, T, D], which conflicts with
     # flash_attention's [B, T, H, D] convention, so keep those on matmuls
     if dropout_rate == 0.0 and len(queries.shape) == 3:
-        return layers.flash_attention(queries, keys, values)
+        return layers.flash_attention(queries, keys, values,
+                                      sequence_parallel=sequence_parallel)
     d = queries.shape[-1]
     scaled_q = layers.scale(queries, scale=float(d) ** -0.5)
     logits = layers.matmul(scaled_q, keys, transpose_y=True)

@@ -437,11 +437,44 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
 from ..core.registry import register_op  # noqa: E402
 
 
+def _in_manual_mesh_context() -> bool:
+    """True when tracing inside a shard_map manual region (e.g. a
+    pipeline stage body): entering another shard_map with a concrete mesh
+    there is an error, so the sp routing must fall back to the
+    device-global kernel."""
+    try:
+        from jax.sharding import AxisType
+        am = jax.sharding.get_abstract_mesh()
+        return any(t == AxisType.Manual for t in am.axis_types)
+    except Exception:
+        return False
+
+
 @register_op("flash_attention")
 def _flash_attention_op(ctx, ins, attrs):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    causal = attrs.get("causal", False)
+    # First-class sequence parallelism: under a ShardedExecutor whose mesh
+    # has sp>1, eligible self-attention lowers to ring attention over the
+    # sp axis (parallel/ring_attention.py) — K/V circulate on ICI, memory
+    # O(T/sp) — instead of one device-global attention.  Eligibility is
+    # checked statically; ineligible shapes (cross-attention, ragged T)
+    # fall back to the GSPMD whole-array kernel.
+    sp = ctx.mesh_axis_size("sp")
+    if (sp > 1 and attrs.get("sequence_parallel", True)
+            and not _in_manual_mesh_context()
+            and q.ndim in (3, 4) and q.shape[1] == k.shape[1]
+            and q.shape[1] % sp == 0):
+        from ..parallel.ring_attention import ring_attention_sharded
+        q4, k4, v4 = (x[:, :, None, :] if x.ndim == 3 else x
+                      for x in (q, k, v))
+        out = ring_attention_sharded(
+            q4, k4, v4, ctx.mesh, causal=causal,
+            block_q=attrs.get("block_q", 1024),
+            block_k=attrs.get("block_k", 1024))
+        return {"Out": out[:, :, 0, :] if q.ndim == 3 else out}
     return {"Out": flash_attention(
         q, k, v,
-        causal=attrs.get("causal", False),
+        causal=causal,
         block_q=attrs.get("block_q", 1024),   # swept best at 16k, D=64
         block_k=attrs.get("block_k", 1024))}

@@ -17,6 +17,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 
 def _block_attn(q, k, v, bias=None):
@@ -157,6 +158,29 @@ def _ring_attention_jnp(q, k, v, axis_name, causal, scale):
         step, (kh, vh, o0, m0, l0), jnp.arange(n))
     out = o / jnp.maximum(l, 1e-20)
     return jnp.swapaxes(out, 1, 2)
+
+
+def ring_attention_sharded(q, k, v, mesh, causal=False, axis_name: str = "sp",
+                           scale=None, block_q: int = 1024,
+                           block_k: int = 1024):
+    """Global-array entry point: partial-manual shard_map over ONLY the sp
+    axis (dp/tp stay GSPMD-managed, mirroring pipeline_program.py), with
+    :func:`ring_attention` inside.  q,k,v: global [B, T, H, D]; returns the
+    same global shape, time axis sharded on ``axis_name``.
+
+    This is what the ``flash_attention`` op lowering calls when the mesh has
+    sp>1 — the first-class framework path to sequence parallelism: a
+    Paddle-API user writes ``layers.flash_attention(...)`` (or
+    ``nets.scaled_dot_product_attention``) and long sequences shard over the
+    ring without touching shard_map themselves.
+    """
+    spec = P(None, axis_name)
+    body = functools.partial(ring_attention, axis_name=axis_name,
+                             causal=causal, scale=scale, block_q=block_q,
+                             block_k=block_k)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({axis_name}), check_vma=False)(q, k, v)
 
 
 def sequence_parallel_attention(q, k, v, axis_name="sp", causal=False):

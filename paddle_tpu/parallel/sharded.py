@@ -58,7 +58,8 @@ class ShardedExecutor(Executor):
                 return b.vars[name]
         return None
 
-    def _feed_spec(self, program: Program, name: str, ndim: int) -> P:
+    def _feed_spec(self, program: Program, name: str, ndim: int,
+                   shape=None) -> P:
         if name in self.feed_specs:
             return self.feed_specs[name]
         if ndim == 0:
@@ -69,7 +70,9 @@ class ShardedExecutor(Executor):
                 else None]
         if (not name.endswith("@LEN") and v is not None and v.lod_level
                 and "sp" in self.mesh.axis_names
-                and self.mesh.shape["sp"] > 1 and ndim >= 2):
+                and self.mesh.shape["sp"] > 1 and ndim >= 2
+                and (shape is None
+                     or shape[1] % self.mesh.shape["sp"] == 0)):
             axes.append("sp")
         axes = axes[:ndim]
         return P(*axes)
@@ -108,7 +111,8 @@ class ShardedExecutor(Executor):
                 lead = 1 if feeds_stacked else 0
                 feed_sh = {}
                 for n, a in feed_arrays.items():
-                    spec = self._feed_spec(program, n, np.ndim(a) - lead)
+                    spec = self._feed_spec(program, n, np.ndim(a) - lead,
+                                           shape=np.shape(a)[lead:])
                     if feeds_stacked:
                         spec = P(None, *spec)
                     feed_sh[n] = NamedSharding(mesh, spec)
@@ -137,7 +141,8 @@ class ShardedExecutor(Executor):
 
         def shardings_for_call(feed_arrays, state):
             feed_sh = {n: NamedSharding(mesh, self._feed_spec(
-                program, n, np.ndim(a))) for n, a in feed_arrays.items()}
+                program, n, np.ndim(a), shape=np.shape(a)))
+                for n, a in feed_arrays.items()}
             # Pin only explicitly-annotated params; None leaves let jit keep
             # whatever sharding GSPMD propagated onto the arrays (replicated
             # params stay replicated, derived accumulators keep their layout).
