@@ -74,6 +74,7 @@ from . import flags
 from . import trainer
 from . import image
 from . import utils
+from . import api
 from . import models
 from .trainer import infer
 from . import framework  # compat alias namespace

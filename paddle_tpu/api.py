@@ -1,0 +1,198 @@
+"""v1 SWIG-API facade: GradientMachine / Trainer / parameter access — the
+manual-training-loop surface (reference: paddle/api/PaddleAPI.h
+GradientMachine, Trainer; driven by v1_api_demo/gan/gan_trainer.py:156-298,
+whose alternating D/G idiom needs a script to own the loop and coordinate
+several machines).
+
+TPU-native redesign: a machine is (V1Config program pair + PRIVATE Scope +
+Executor).  ``forward`` runs a pruned forward slice under jit; ``train``
+runs the backward+optimizer program appended lazily on first use (its
+optimizer state initializes from a throwaway scope so existing parameter
+values are never clobbered); parameter sharing between machines is a
+name-keyed scope copy — the copy_shared_parameters idiom works because the
+v1 DSL names an explicitly-named layer's parameters deterministically
+(``_<layer>.w0``, trainer_config_helpers._v1_named_attr).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .core.executor import Executor
+from .core.program import program_guard
+from .core.scope import Scope
+
+PASS_TRAIN = "train"
+PASS_TEST = "test"
+
+__all__ = ["GradientMachine", "Trainer", "copy_shared_parameters",
+           "PASS_TRAIN", "PASS_TEST"]
+
+
+def _parse_config_args(config_args: Union[str, dict, None]) -> dict:
+    """Accept the v1 parse_config string form ("mode=x,data=y") or a dict."""
+    if not config_args:
+        return {}
+    if isinstance(config_args, dict):
+        return dict(config_args)
+    out = {}
+    for item in str(config_args).split(","):
+        if not item.strip():
+            continue
+        k, _, v = item.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+class GradientMachine:
+    """One network + its own parameter store.
+
+    Reference frame: api.GradientMachine.createFromConfigProto builds a
+    machine per parsed config; forward/backward and parameter buffers are
+    script-visible (PaddleAPI.h:714-785).  Here the machine wraps a
+    V1Config; every machine owns a private Scope so several machines (the
+    GAN's three) coexist with independent parameters.
+    """
+
+    def __init__(self, cfg, executor: Optional[Executor] = None):
+        self.cfg = cfg
+        self.scope = Scope()
+        self.exe = executor or Executor()
+        self._train_loss = None
+        # forward slice: prune to declared outputs so PASS_TEST forwards
+        # never execute optimizer writes appended later
+        self._eval_prog = cfg.main_program.prune(cfg.outputs)
+        self.exe.run(cfg.startup_program, feed={}, fetch_list=[],
+                     scope=self.scope)
+        # the v1 "parameters" = everything the startup pass initializes
+        # (weights, biases, batch-norm moving stats) — optimizer
+        # accumulators appended later are NOT parameters
+        self._param_names = sorted(self.scope.keys())
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def createFromConfig(cls, path: str, config_args=None,
+                         executor: Optional[Executor] = None):
+        """Build a machine from a v1 config file; ``config_args`` follows
+        parse_config's "k=v,k=v" string (or a dict)."""
+        from .trainer_config_helpers import load_v1_config
+        cfg = load_v1_config(path, **_parse_config_args(config_args))
+        return cls(cfg, executor=executor)
+
+    create_from_config = createFromConfig
+
+    # -- feeds --------------------------------------------------------------
+    def _as_feed(self, feed) -> Dict[str, np.ndarray]:
+        """Dict feeds pass through; positional lists map by input_order
+        (the Arguments slot-index analog)."""
+        if isinstance(feed, dict):
+            return feed
+        order = self.cfg.input_order or sorted(self.cfg.data_layers)
+        if len(feed) != len(order):
+            raise ValueError(
+                f"positional feed has {len(feed)} slots; config declares "
+                f"{len(order)} inputs {order}")
+        return dict(zip(order, feed))
+
+    # -- forward / training -------------------------------------------------
+    def forward(self, feed, pass_type: str = PASS_TEST) -> List[np.ndarray]:
+        """Run the forward slice; returns the config's declared outputs.
+        PASS_TEST freezes dropout/batch-norm test behavior (except
+        use_global_stats=False layers, which pin batch stats — v1
+        semantics) and never touches parameters."""
+        return self.exe.run(self._eval_prog, feed=self._as_feed(feed),
+                            fetch_list=[o.name for o in self.cfg.outputs],
+                            scope=self.scope,
+                            is_test=(pass_type == PASS_TEST))
+
+    def get_loss(self, feed, pass_type: str = PASS_TEST) -> float:
+        """Mean of the first output (the cost) — the get_training_loss
+        idiom (gan_trainer.py:161-166)."""
+        return float(np.mean(self.forward(feed, pass_type)[0]))
+
+    def _ensure_train(self):
+        if self._train_loss is not None:
+            return
+        self._train_loss = self.cfg.minimize_outputs()
+        # minimize appended optimizer-state initializers to the startup
+        # program; realize ONLY the new entries via a throwaway scope so
+        # current parameter values (possibly trained/copied) survive
+        tmp = Scope()
+        self.exe.run(self.cfg.startup_program, feed={}, fetch_list=[],
+                     scope=tmp)
+        for k in tmp.keys():
+            if not self.scope.has(k):
+                self.scope.set(k, tmp.get(k))
+
+    def train_batch(self, feed) -> float:
+        """One forward/backward/optimizer step; returns the batch cost.
+        The Trainer.trainOneDataBatch analog."""
+        self._ensure_train()
+        (loss,) = self.exe.run(self.cfg.main_program,
+                               feed=self._as_feed(feed),
+                               fetch_list=[self._train_loss],
+                               scope=self.scope)
+        return float(np.mean(loss))
+
+    # -- parameter access ---------------------------------------------------
+    def getParameterNames(self) -> List[str]:
+        return list(self._param_names)
+
+    def getParameter(self, name: str) -> np.ndarray:
+        return np.asarray(self.scope.get(name))
+
+    def setParameter(self, name: str, value) -> None:
+        cur = self.scope.get(name)
+        value = np.asarray(value, dtype=np.asarray(cur).dtype)
+        if value.shape != tuple(np.shape(cur)):
+            raise ValueError(
+                f"setParameter({name!r}): shape {value.shape} != "
+                f"{tuple(np.shape(cur))}")
+        self.scope.set(name, value)
+
+    def getParameters(self) -> Dict[str, np.ndarray]:
+        return {n: self.getParameter(n) for n in self._param_names}
+
+
+def copy_shared_parameters(src: GradientMachine, dst: GradientMachine):
+    """Copy every src parameter whose name exists in dst (the GAN demo's
+    helper, gan_trainer.py:49-69, made a framework citizen)."""
+    src_names = set(src.getParameterNames())
+    for name in dst.getParameterNames():
+        if name in src_names:
+            dst.setParameter(name, src.getParameter(name))
+
+
+class Trainer:
+    """Thin pass-structured driver over a machine (api.Trainer.create):
+    start/finish hooks keep the v1 call shape; the work is
+    trainOneDataBatch -> machine.train_batch."""
+
+    def __init__(self, machine: GradientMachine):
+        self.machine = machine
+        self.pass_id = 0
+        self._in_pass = False
+
+    @classmethod
+    def create(cls, cfg_or_machine, machine: Optional[GradientMachine] = None):
+        m = machine if machine is not None else cfg_or_machine
+        if not isinstance(m, GradientMachine):
+            m = GradientMachine(m)
+        return cls(m)
+
+    def startTrain(self):
+        pass
+
+    def finishTrain(self):
+        pass
+
+    def startTrainPass(self):
+        self._in_pass = True
+
+    def finishTrainPass(self):
+        self._in_pass = False
+        self.pass_id += 1
+
+    def trainOneDataBatch(self, batch_size: int, feed) -> float:
+        return self.machine.train_batch(feed)

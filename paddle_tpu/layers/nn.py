@@ -34,6 +34,7 @@ __all__ = [
     "flash_attention", "moe", "conv3d", "pool3d", "multiplex", "crop",
     "spp", "prelu", "sampling_id",
     "log_loss", "hinge_loss", "huber_loss", "square_error_cost", "rank_loss",
+    "lambda_rank",
     "margin_rank_loss", "squared_l2_distance", "squared_l2_norm",
     "kldiv_loss", "modified_huber_loss", "bilinear_tensor_product",
 ]
@@ -339,7 +340,8 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
 
 def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                param_attr=None, bias_attr=None, data_layout="NCHW",
-               moving_mean_name=None, moving_variance_name=None, name=None):
+               moving_mean_name=None, moving_variance_name=None, name=None,
+               use_global_stats=None):
     """fluid/layers/nn.py:875 — running stats are persistable vars updated by
     the op's MeanOut/VarianceOut writes."""
     from ..initializer import ConstantInitializer
@@ -367,7 +369,8 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                               "SavedMean": [saved_mean],
                               "SavedVariance": [saved_var]},
                      attrs={"momentum": momentum, "epsilon": epsilon,
-                            "is_test": is_test})
+                            "is_test": is_test,
+                            "use_global_stats": use_global_stats})
     return helper.append_activation(out, act)
 
 
@@ -789,6 +792,24 @@ def rank_loss(label, left, right, name=None):
                      inputs={"Label": [label], "Left": [left],
                              "Right": [right]},
                      outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def lambda_rank(score, label, ndcg_num=5, max_sort_size=-1, name=None):
+    """Listwise LambdaRank (v1 lambda_cost; CostLayer.h:252 LambdaCost).
+    score/label: lod_level-1 sequences of per-document scores, padded
+    [B, M(,1)] with an @LEN companion per query group.  Returns per-group
+    NDCG@ndcg_num [B, 1]; its gradient w.r.t. score is the lambda
+    direction (ops/loss_ops.py), so minimizing drives NDCG up exactly as
+    the reference's layer did."""
+    helper = LayerHelper("lambda_rank", name=name)
+    out = helper.create_variable_for_type_inference(
+        "float32", (score.shape[0], 1))
+    helper.append_op(type="lambda_rank",
+                     inputs={"Score": [score], "Label": [label]},
+                     outputs={"Out": [out]},
+                     attrs={"ndcg_num": ndcg_num,
+                            "max_sort_size": max_sort_size})
     return out
 
 

@@ -233,9 +233,16 @@ def _batch_norm(ctx, ins, attrs):
     eps = attrs.get("epsilon", 1e-5)
     momentum = attrs.get("momentum", 0.9)
     is_test = attrs.get("is_test", False) or ctx.is_test
+    # v1 use_global_stats tri-state (BatchNormBaseLayer.cpp): True forces
+    # running stats even in training, False forces batch stats even at
+    # PASS_TEST (the GAN configs rely on this); None keeps is_test routing.
+    # Running stats still update only on training passes.
+    use_global = attrs.get("use_global_stats")
+    if use_global is None:
+        use_global = is_test
     axes = tuple(i for i in range(x.ndim) if i != 1)
     bshape = (1, -1) + (1,) * (x.ndim - 2)
-    if is_test:
+    if use_global:
         use_mean = mean.astype(jnp.float32)
         use_var = var.astype(jnp.float32)
         mean_out, var_out = mean, var
@@ -252,10 +259,15 @@ def _batch_norm(ctx, ins, attrs):
         use_var = jnp.maximum(m2 - lax.square(use_mean), 0.0)
         use_mean_sg = lax.stop_gradient(use_mean)
         use_var_sg = lax.stop_gradient(use_var)
-        mean_out = (momentum * mean
-                    + (1.0 - momentum) * use_mean_sg.astype(mean.dtype))
-        var_out = (momentum * var
-                   + (1.0 - momentum) * use_var_sg.astype(var.dtype))
+        if is_test:
+            # batch stats forced by use_global_stats=False, but a test pass
+            # never advances the moving averages
+            mean_out, var_out = mean, var
+        else:
+            mean_out = (momentum * mean
+                        + (1.0 - momentum) * use_mean_sg.astype(mean.dtype))
+            var_out = (momentum * var
+                       + (1.0 - momentum) * use_var_sg.astype(var.dtype))
     inv = lax.rsqrt(use_var + eps)
     # fold into a per-channel scale/shift so the big tensor gets ONE fused
     # multiply-add in its own dtype (no fp32 round trip through HBM)

@@ -20,6 +20,7 @@ objects.
 """
 from __future__ import annotations
 
+import copy
 import math
 from typing import Optional
 
@@ -314,12 +315,18 @@ class L2Regularization:
 # ---------------------------------------------------------------------------
 # layers
 # ---------------------------------------------------------------------------
-def data_layer(name, size, height=None, width=None, depth=None, **kw):
+def data_layer(name, size, height=None, width=None, depth=None,
+               is_seq=False, lod_level=None, **kw):
     """v1 data_layer: flat ``size`` input.  Image configs pass height/width
     via img_conv_layer's num_channels; sequence configs treat size as the
     vocab.  The var records ``v1_size`` so embedding/conv can recover
-    semantics."""
-    v = L.data(name, shape=[size], dtype="float32")
+    semantics.  ``is_seq``/``lod_level`` mark a dense-vector-sequence input
+    (the role the v1 DataProvider's ``dense_vector_sequence`` declaration
+    played — config-side here because providers are plain readers): the
+    feed becomes padded [B, T, size] + ``name@LEN``, e.g. per-query
+    document lists for lambda_cost."""
+    lod = 1 if is_seq else int(lod_level or 0)
+    v = L.data(name, shape=[size], dtype="float32", lod_level=lod)
     v.v1_size = size
     _state.data_layers[name] = v
     return v
@@ -352,6 +359,23 @@ def _apply_layer_attr(out, layer_attr):
     return out
 
 
+def _v1_named_attr(attr, pname):
+    """v1 deterministic parameter naming (config_parser.py: an explicitly
+    named layer owns parameters ``_<layer>.w<i>`` / ``_<layer>.wbias``) —
+    what api.GradientMachine parameter sharing keys on across separately
+    built machines (the GAN trainer's copy_shared_parameters idiom).
+    Clones the attr (configs reuse one ParamAttr across layers); explicit
+    attr names and disabled (False) attrs pass through untouched."""
+    if attr is False or pname is None:
+        return attr
+    attr = ParamAttr._to_attr(attr)
+    if attr is None or attr.name is not None:
+        return attr
+    attr = copy.copy(attr)
+    attr.name = pname
+    return attr
+
+
 def fc_layer(input, size, act=None, name=None, param_attr=None,
              bias_attr=None, layer_attr=None, **kw):
     inputs = input if isinstance(input, (list, tuple)) else [input]
@@ -361,6 +385,16 @@ def fc_layer(input, size, act=None, name=None, param_attr=None,
             v = L.reshape(v, [-1, int(np.prod(v.shape[1:]))])
         flat.append(v)
     nfd = 2 if flat[0].lod_level else 1
+    if name is not None:
+        if isinstance(param_attr, (list, tuple)):
+            param_attr = [_v1_named_attr(a, f"_{name}.w{i}")
+                          for i, a in enumerate(param_attr)]
+        elif len(flat) > 1:
+            param_attr = [_v1_named_attr(param_attr, f"_{name}.w{i}")
+                          for i in range(len(flat))]
+        else:
+            param_attr = _v1_named_attr(param_attr, f"_{name}.w0")
+        bias_attr = _v1_named_attr(bias_attr, f"_{name}.wbias")
     out = L.fc(flat if len(flat) > 1 else flat[0], size=size,
                num_flatten_dims=nfd, act=_act_name(act), name=name,
                param_attr=param_attr, bias_attr=bias_attr)
@@ -380,7 +414,10 @@ def img_conv_layer(input, filter_size, num_filters, name=None,
     f = L.conv2d_transpose if trans else L.conv2d
     return f(input, num_filters=num_filters, filter_size=fs, stride=st,
              padding=pd, groups=groups, act=_act_name(act), name=name,
-             param_attr=param_attr, bias_attr=bias_attr)
+             param_attr=_v1_named_attr(param_attr, f"_{name}.w0"
+                                       if name else None),
+             bias_attr=_v1_named_attr(bias_attr, f"_{name}.wbias"
+                                      if name else None))
 
 
 def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
@@ -415,7 +452,13 @@ def batch_norm_layer(input, act=None, name=None, num_channels=None,
         input = _as_image(input, num_channels)
     return L.batch_norm(input, act=_act_name(act),
                         momentum=moving_average_fraction,
-                        param_attr=param_attr, bias_attr=bias_attr,
+                        param_attr=_v1_named_attr(param_attr, f"_{name}.w0"
+                                                  if name else None),
+                        bias_attr=_v1_named_attr(bias_attr, f"_{name}.wbias"
+                                                 if name else None),
+                        moving_mean_name=f"_{name}.w1" if name else None,
+                        moving_variance_name=f"_{name}.w2" if name else None,
+                        use_global_stats=use_global_stats,
                         name=name)
 
 

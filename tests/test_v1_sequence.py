@@ -164,9 +164,11 @@ def test_recurrent_group_matches_manual_scan(rng):
     ov, = exe.run(pt.default_main_program(),
                   feed={"x": xv, "x@LEN": np.array([T, T])},
                   fetch_list=[out])
-    w1 = np.asarray(pt.global_scope().get("h.w_0"))
-    w2 = np.asarray(pt.global_scope().get("h.w_1"))
-    b = np.asarray(pt.global_scope().get("h.b_0"))
+    # v1 deterministic parameter names for a named layer (round 5:
+    # _<layer>.w<i>/.wbias, the reference config_parser convention)
+    w1 = np.asarray(pt.global_scope().get("_h.w0"))
+    w2 = np.asarray(pt.global_scope().get("_h.w1"))
+    b = np.asarray(pt.global_scope().get("_h.wbias"))
     h = np.zeros((B, H), "float32")
     for t in range(T):
         h = np.tanh(xv[:, t] @ w1 + h @ w2 + b)
