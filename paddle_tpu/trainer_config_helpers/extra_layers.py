@@ -641,9 +641,17 @@ def hsigmoid(input, label, num_classes=None, param_attr=None,
 
 def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, name=None,
                 **kw):
-    raise NotImplementedError(
-        "lambda_cost (listwise LambdaRank) needs per-query ragged lists; "
-        "use rank_cost pairs or the mq2007 pairwise pipeline instead")
+    """v1 lambda_cost (layers.py:6008; CostLayer.h:252 LambdaCost):
+    listwise LambdaRank.  ``input`` is the model's per-document score
+    sequence, ``score`` the relevance-label sequence; per-query groups are
+    the padded lod_level-1 representation.  The layer value is mean
+    NDCG@NDCG_num over the batch's query groups; its backward is the
+    lambda gradient (see ops/loss_ops.py), so a training step moves NDCG
+    UP — matching the reference layer's semantics, where the printed cost
+    is NDCG and rises during training."""
+    out = L.lambda_rank(input, score, ndcg_num=NDCG_num,
+                        max_sort_size=max_sort_size, name=name)
+    return track_layer(name, L.mean(out))
 
 
 def cross_entropy_over_beam(input, name=None, **kw):

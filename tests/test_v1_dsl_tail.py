@@ -258,12 +258,11 @@ def test_multiplex_eos_sampling(rng):
 
 
 def test_unsupported_raise_with_guidance():
-    from paddle_tpu.trainer_config_helpers import (cross_entropy_over_beam,
-                                                   lambda_cost)
+    # round 5: lambda_cost is now implemented (test_lambda_rank.py);
+    # cross_entropy_over_beam remains the one declared-subsumed cost
+    from paddle_tpu.trainer_config_helpers import cross_entropy_over_beam
     with pytest.raises(NotImplementedError, match="decoder"):
         cross_entropy_over_beam(input=None)
-    with pytest.raises(NotImplementedError, match="rank_cost"):
-        lambda_cost(input=None, score=None)
 
 
 def test_default_decorators_feed_optimizer(tmp_path):
