@@ -131,11 +131,16 @@ class Env:
 class LoweringContext:
     def __init__(self, program: Program, base_key, is_test: bool = False,
                  amp: bool = False, mesh=None,
-                 pipeline_microbatches: Optional[int] = None):
+                 pipeline_microbatches: Optional[int] = None,
+                 compute_dtype=None):
         self.program = program
         self.base_key = base_key      # traced PRNG key folding in the step
         self.is_test = is_test
         self.amp = amp
+        # precision-instrument mode: run_op upcasts floating op outputs so
+        # in-graph f32 constants (fill_constant, zeros inits) do not leak
+        # f32 back into an otherwise-f64 step (job_checkgrad)
+        self.compute_dtype = compute_dtype
         # mesh set by ShardedExecutor: op lowerings may consult it to place
         # sharding constraints (moe) or lower staged regions (pipeline)
         self.mesh = mesh
@@ -244,6 +249,10 @@ def run_op(op: Operator, env: Env, ctx: LoweringContext):
                 f"{len(names)} outputs {names}")
         for n, v in zip(names, vals):
             if v is not None:
+                if ctx.compute_dtype is not None and hasattr(v, "dtype") \
+                        and jnp.issubdtype(v.dtype, jnp.floating) \
+                        and v.dtype != jnp.dtype(ctx.compute_dtype):
+                    v = v.astype(ctx.compute_dtype)
                 env.set(n, v)
 
 
@@ -362,11 +371,18 @@ class Executor:
     def __init__(self, place: Optional[Place] = None, use_jit: bool = True,
                  check_nan_inf: bool = False, amp: bool = False,
                  auto_layout: bool = False,
-                 compiler_options: Optional[Dict[str, object]] = None):
+                 compiler_options: Optional[Dict[str, object]] = None,
+                 compute_dtype: Optional[str] = None):
         self.place = place or TPUPlace()
         self.use_jit = use_jit
         self.check_nan_inf = check_nan_inf
         self.amp = amp                # bf16 compute, fp32 master weights
+        # precision-instrument mode (job_checkgrad): upcast every floating
+        # feed/state to this dtype at step entry (e.g. "float64" under
+        # jax.experimental.enable_x64 on CPU) so finite differences and
+        # autodiff compare at double precision; persistable state keeps its
+        # declared dtype across steps via the existing dtype-restore pass
+        self.compute_dtype = compute_dtype
         # XLA-chosen parameter layouts (see _AutoLayoutStep).  Opt-in: a few
         # % on conv nets, but best used with a single compiled step variant
         # (run the same fetch_list every call) — some PJRT backends reject
@@ -640,18 +656,26 @@ class Executor:
         has_backward = any(op.type == "backward"
                            for op in program.global_block().ops)
 
+        compute_dtype = self.compute_dtype
+
         def fn(feed_arrays, state, step):
             base_key = jax.random.fold_in(
                 jax.random.PRNGKey(program.random_seed), step)
             env = Env(program.global_block())
             env.local.update(state)
             env.local.update(feed_arrays)
+            if compute_dtype is not None:
+                cd = jnp.dtype(compute_dtype)
+                env.local = {k: v.astype(cd) if hasattr(v, "dtype")
+                             and jnp.issubdtype(v.dtype, jnp.floating)
+                             else v for k, v in env.local.items()}
             if amp and not has_backward:
                 # pure-inference AMP: whole net computes in bf16
                 env.local = {k: _to_bf16(v) for k, v in env.local.items()}
             ctx = LoweringContext(program, base_key, is_test=is_test,
                                   amp=amp, mesh=lowering_mesh,
-                                  pipeline_microbatches=microbatches)
+                                  pipeline_microbatches=microbatches,
+                                  compute_dtype=compute_dtype)
             interpret_block_with_backward(program.global_block(), env, ctx)
             fetches = [env.get(n) if env.has(n) else None for n in fetch_names]
             if check_nan:

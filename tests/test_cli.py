@@ -57,8 +57,17 @@ def test_cli_time(tmp_path):
 def test_cli_checkgrad():
     r = _run("--config", CONF, "--job", "checkgrad")
     assert r.returncode == 0, r.stderr + r.stdout
-    final = _json_lines(r.stdout)[-1]
+    recs = _json_lines(r.stdout)
+    final = recs[-1]
     assert final["checkgrad"] == "PASS"
+    # the probe loop actually ran, at the f64-instrument tolerance: per-
+    # parameter comparison lines with tight numeric/autodiff agreement
+    probes = [x for x in recs if "autodiff" in x]
+    assert len(probes) >= 3, recs
+    for p in probes:
+        assert p["ok"]
+        assert abs(p["numeric"] - p["autodiff"]) <= 1e-3 * max(
+            1.0, abs(p["numeric"]), abs(p["autodiff"]))
 
 
 def test_cli_start_pass_resume(tmp_path):
