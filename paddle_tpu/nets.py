@@ -21,7 +21,7 @@ def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
 def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
                    conv_filter_size=3, conv_act=None, param_attr=None,
                    conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
-                   pool_stride=1, pool_type="max"):
+                   pool_stride=1, pool_type="max", pool_ceil_mode=False):
     tmp = input
     if not isinstance(conv_padding, (list, tuple)):
         conv_padding = [conv_padding] * len(conv_num_filter)
@@ -40,7 +40,7 @@ def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
             if conv_batchnorm_drop_rate[i] > 0:
                 tmp = layers.dropout(tmp, conv_batchnorm_drop_rate[i])
     return layers.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
-                         pool_stride=pool_stride)
+                         pool_stride=pool_stride, ceil_mode=pool_ceil_mode)
 
 
 def sequence_conv_pool(input, num_filters, filter_size, act="sigmoid",

@@ -86,6 +86,10 @@ class _ConfigState:
         self.evaluators = []
         self.input_order = None
         self.defaults = {}      # default_momentum/default_decay_rate values
+        # loader-declared sequence inputs (the v1 DataProvider's
+        # *_sequence declarations, which configs never carried themselves):
+        # data_layer names listed here build as lod_level-1 vars
+        self.sequence_inputs = set()
 
 
 _state = _ConfigState()
@@ -325,6 +329,8 @@ def data_layer(name, size, height=None, width=None, depth=None,
     played — config-side here because providers are plain readers): the
     feed becomes padded [B, T, size] + ``name@LEN``, e.g. per-query
     document lists for lambda_cost."""
+    if not is_seq and lod_level is None and name in _state.sequence_inputs:
+        is_seq = True
     lod = 1 if is_seq else int(lod_level or 0)
     v = L.data(name, shape=[size], dtype="float32", lod_level=lod)
     v.v1_size = size
@@ -478,6 +484,10 @@ def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
         conv_batchnorm_drop_rate=conv_batchnorm_drop_rate,
         pool_stride=pool_stride,
         pool_type=pool_type.ptype if pool_type is not None else "max",
+        # v1 PoolLayer sizes outputs with ceil (img_pool_layer's default
+        # here too); light_mnist's 4-stage chain needs it to keep spatial
+        # dims >= 1 (28 -> ... -> 1 instead of collapsing to 0)
+        pool_ceil_mode=True,
         param_attr=param_attr)
 
 
@@ -753,16 +763,19 @@ text_conv_pool = sequence_conv_pool
 __all__.append("text_conv_pool")
 
 
-def load_v1_config(path, **config_args):
+def load_v1_config(path, sequence_inputs=(), **config_args):
     """Evaluate a v1 config file (the config_parser.parse_config role,
     config_parser.py:126) against a fresh program pair.  Python-2-era
     configs work: ``xrange`` is aliased and the ``paddle`` import is
-    shimmed."""
+    shimmed.  ``sequence_inputs`` names data layers that the original
+    DataProvider declared as sequences (e.g. dense_vector_sequence) —
+    those build as lod_level-1 padded inputs."""
     import paddle_tpu as pt
 
     global _state
     _state = _ConfigState()
     _state.args = dict(config_args)
+    _state.sequence_inputs = set(sequence_inputs)
     _install_import_shim()
     main, startup = pt.Program(), pt.Program()
     ns = {k: globals()[k] for k in __all__

@@ -122,6 +122,35 @@ def test_gan_alternating_training(rng):
                                   dis_m.getParameter("_dis_hidden.w0"))
 
 
+def test_gan_conf_image_trains(rng):
+    """The conv/deconv GAN config (gan_conf_image.py, data=mnist) also
+    trains through the facade: one D step + one G step, losses finite,
+    both networks' weights move."""
+    conf = "/root/reference/v1_api_demo/gan/gan_conf_image.py"
+    dis_m = api.GradientMachine.createFromConfig(
+        conf, "mode=discriminator_training,data=mnist")
+    gen_m = api.GradientMachine.createFromConfig(
+        conf, "mode=generator_training,data=mnist")
+    api.copy_shared_parameters(gen_m, dis_m)
+
+    B = 4
+    sample = rng.rand(B, 28 * 28).astype("f4") * 2 - 1
+    noise = rng.normal(size=(B, 100)).astype("f4")
+    d_name = next(n for n in dis_m.getParameterNames()
+                  if n.startswith("_dis_") and n.endswith(".w0"))
+    g_name = next(n for n in gen_m.getParameterNames()
+                  if n.startswith("_gen_") and n.endswith(".w0"))
+    d_before = dis_m.getParameter(d_name).copy()
+    g_before = gen_m.getParameter(g_name).copy()
+    d_loss = dis_m.train_batch({"sample": sample,
+                                "label": np.ones((B, 1), "int64")})
+    g_loss = gen_m.train_batch({"noise": noise,
+                                "label": np.ones((B, 1), "int64")})
+    assert np.isfinite(d_loss) and np.isfinite(g_loss)
+    assert not np.allclose(d_before, dis_m.getParameter(d_name))
+    assert not np.allclose(g_before, gen_m.getParameter(g_name))
+
+
 def test_trainer_pass_bookkeeping(rng):
     m = api.GradientMachine.createFromConfig(
         GAN_CONF, "mode=discriminator_training,data=uniform")
