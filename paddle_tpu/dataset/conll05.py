@@ -1,20 +1,170 @@
-"""CoNLL-05 SRL reader (reference: v2/dataset/conll05.py; synthetic
-tagged sequences)."""
+"""CoNLL-2005 SRL dataset (reference: python/paddle/v2/dataset/conll05.py).
+
+Official format: the public ``conll05st-tests.tar.gz`` carries parallel
+line streams ``test.wsj.words.gz`` (one token per line, blank line ends a
+sentence) and ``test.wsj.props.gz`` (per line: the target verb column
+followed by one bracket-tagged column per predicate).  Parsing converts
+each predicate's bracket column — ``(A0*``, ``*``, ``*)`` — into a BIO
+tag sequence and emits one (sentence, predicate, BIO labels) item per
+predicate, then the reader expands each item into the 9-slot SRL feature
+tuple (words, 5 predicate-context columns, predicate id, region mark,
+labels) the book demo trains on.
+
+Offline (no cached archive) ``train``/``test`` fall back to synthetic
+learnable sequences so hermetic tests run; the real-format parsing paths
+(`corpus_reader`, `reader_creator`) are exercised against a synthesized
+official-layout tarball in tests/test_dataset_tail.py.
+"""
 from __future__ import annotations
+
+import gzip
+import os
+import tarfile
 
 import numpy as np
 
+from .common import DATA_HOME
+
+DATA_URL = "http://www.cs.upc.edu/~srlconll/conll05st-tests.tar.gz"
+DATA_MD5 = "387719152ae52d60422c016e92a742fc"
+WORDS_NAME = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+PROPS_NAME = "conll05st-release/test.wsj/props/test.wsj.props.gz"
+
+UNK_IDX = 0
 WORD_VOCAB, NUM_TAGS = 1000, 9
 
 
+def load_dict(filename):
+    """One entry per line -> {token: line_index} (the dict-file format of
+    the published wordDict/verbDict/targetDict)."""
+    d = {}
+    with open(filename) as f:
+        for i, line in enumerate(f):
+            d[line.strip()] = i
+    return d
+
+
+def _bracket_to_bio(column):
+    """One predicate's bracket column -> BIO tags.  ``(TAG*`` opens TAG
+    (multi-token until ``*)``), ``(TAG*)`` is a single-token span, bare
+    ``*`` is O outside spans / I-TAG inside."""
+    tags = []
+    cur, inside = "O", False
+    for tok in column:
+        if tok == "*":
+            tags.append("I-" + cur if inside else "O")
+        elif tok == "*)":
+            tags.append("I-" + cur)
+            inside = False
+        elif "(" in tok and ")" in tok:
+            cur = tok[1:tok.index("*")]
+            tags.append("B-" + cur)
+            inside = False
+        elif "(" in tok:
+            cur = tok[1:tok.index("*")]
+            tags.append("B-" + cur)
+            inside = True
+        else:
+            raise RuntimeError(f"unexpected SRL bracket label {tok!r}")
+    return tags
+
+
+def corpus_reader(data_path, words_name=WORDS_NAME, props_name=PROPS_NAME):
+    """Iterate (sentence words, predicate, BIO labels) triples from an
+    official-layout archive — one triple per predicate column."""
+
+    def reader():
+        with tarfile.open(data_path) as tf:
+            with gzip.GzipFile(fileobj=tf.extractfile(words_name)) as wf, \
+                    gzip.GzipFile(fileobj=tf.extractfile(props_name)) as pf:
+                words, cols = [], []
+                for wline, pline in zip(wf, pf):
+                    word = wline.decode().strip()
+                    fields = pline.decode().split()
+                    if not fields:                     # sentence boundary
+                        if words:
+                            verbs = [row[0] for row in cols
+                                     if row[0] != "-"]
+                            n_preds = len(cols[0]) - 1 if cols else 0
+                            for p in range(n_preds):
+                                col = [c[p + 1] for c in cols]
+                                yield (list(words), verbs[p],
+                                       _bracket_to_bio(col))
+                        words, cols = [], []
+                    else:
+                        words.append(word)
+                        cols.append(fields)
+
+    return reader
+
+
+def reader_creator(corpus_reader, word_dict, predicate_dict, label_dict):
+    """Expand each (sentence, predicate, labels) into the 9-slot SRL
+    feature tuple: word ids, ctx_n2/n1/0/p1/p2 predicate-window columns
+    (broadcast over the sentence), predicate id, +/-2-window region mark,
+    label ids (reference conll05.py:127-178 semantics)."""
+
+    def reader():
+        for sentence, predicate, labels in corpus_reader():
+            n = len(sentence)
+            v = labels.index("B-V")
+            mark = [0] * n
+            ctx = {}
+            for off, key, pad in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                                  (0, "0", None), (1, "p1", "eos"),
+                                  (2, "p2", "eos")):
+                i = v + off
+                if 0 <= i < n:
+                    mark[i] = 1
+                    ctx[key] = sentence[i]
+                else:
+                    ctx[key] = pad
+            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
+            bcast = {k: [word_dict.get(w, UNK_IDX)] * n
+                     for k, w in ctx.items()}
+            pred_idx = [predicate_dict.get(predicate, UNK_IDX)] * n
+            label_idx = [label_dict[t] for t in labels]
+            yield (word_idx, bcast["n2"], bcast["n1"], bcast["0"],
+                   bcast["p1"], bcast["p2"], pred_idx, mark, label_idx)
+
+    return reader
+
+
+def _cached_archive():
+    p = os.path.join(DATA_HOME, "conll05st", "conll05st-tests.tar.gz")
+    return p if os.path.exists(p) else None
+
+
 def get_dict():
-    word_dict = {f"w{i}": i for i in range(WORD_VOCAB)}
-    verb_dict = {f"v{i}": i for i in range(50)}
-    label_dict = {f"t{i}": i for i in range(NUM_TAGS)}
+    """Word/verb/label dictionaries.  With a cached archive the dicts are
+    built from the corpus itself (the published dict files are a separate
+    download); offline they are the synthetic vocabulary."""
+    arch = _cached_archive()
+    if arch is None:
+        word_dict = {f"w{i}": i for i in range(WORD_VOCAB)}
+        verb_dict = {f"v{i}": i for i in range(50)}
+        label_dict = {f"t{i}": i for i in range(NUM_TAGS)}
+        return word_dict, verb_dict, label_dict
+    words, verbs, tags = set(), set(), set()
+    for sentence, predicate, labels in corpus_reader(arch)():
+        words.update(sentence)
+        verbs.add(predicate)
+        tags.update(labels)
+    # reserved ids first: <unk> takes UNK_IDX (0) and the bos/eos boundary
+    # paddings get their own entries, so edge-of-sentence context features
+    # never alias a real corpus word
+    word_dict = {"<unk>": UNK_IDX, "bos": 1, "eos": 2}
+    for w in sorted(words - set(word_dict)):
+        word_dict[w] = len(word_dict)
+    verb_dict = {w: i for i, w in enumerate(sorted(verbs))}
+    label_dict = {t: i for i, t in enumerate(sorted(tags))}
     return word_dict, verb_dict, label_dict
 
 
 def _gen(seed, n):
+    """Synthetic learnable tagging fallback (shape-compatible 2-tuples for
+    the book test's simplified pipeline)."""
+
     def reader():
         r = np.random.RandomState(seed)
         for _ in range(n):
@@ -22,6 +172,7 @@ def _gen(seed, n):
             words = r.randint(0, WORD_VOCAB, L).tolist()
             tags = [w % NUM_TAGS for w in words]      # learnable tagging
             yield words, tags
+
     return reader
 
 
@@ -30,4 +181,11 @@ def train():
 
 
 def test():
-    return _gen(61, 200)
+    """Real corpus when the official archive is cached (9-slot SRL
+    tuples); synthetic fallback otherwise."""
+    arch = _cached_archive()
+    if arch is None:
+        return _gen(61, 200)
+    word_dict, verb_dict, label_dict = get_dict()
+    return reader_creator(corpus_reader(arch), word_dict, verb_dict,
+                          label_dict)

@@ -18,7 +18,8 @@ def _idx_images(path):
     with op(path, "rb") as f:
         magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
         data = np.frombuffer(f.read(), np.uint8).reshape(n, rows * cols)
-        return data.astype("float32") / 255.0
+        # v2 normalization (reference mnist.py:66): pixels in [-1, 1]
+        return data.astype("float32") / 255.0 * 2.0 - 1.0
 
 
 def _idx_labels(path):

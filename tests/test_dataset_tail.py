@@ -385,3 +385,87 @@ def test_wmt14_tgz_parser(tmp_path, monkeypatch):
     assert trg == [0, 3, 4] and nxt == [3, 4, 1]
     # oov maps to UNK_IDX
     assert samples[1][0] == [0, 3, 2, 1]
+
+
+def test_mnist_idx_gz_parser(tmp_path, rng):
+    """Official MNIST idx3/idx1 gzip format (mnist.py reader_from_files):
+    big-endian magic+dims headers, raw u8 payload."""
+    import gzip
+    import struct
+
+    from paddle_tpu.dataset import mnist
+
+    imgs = (rng.rand(5, 28, 28) * 255).astype("uint8")
+    labs = rng.randint(0, 10, 5).astype("uint8")
+    ip = tmp_path / "train-images-idx3-ubyte.gz"
+    lp = tmp_path / "train-labels-idx1-ubyte.gz"
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 5, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 5))
+        f.write(labs.tobytes())
+    rows = list(mnist.reader_from_files(str(ip), str(lp))())
+    assert len(rows) == 5
+    x, y = rows[3]
+    assert x.shape == (784,) and x.dtype == np.float32
+    # v2 mnist normalization: pixel / 255 * 2 - 1 in [-1, 1]
+    np.testing.assert_allclose(
+        x, imgs[3].reshape(-1).astype("f4") / 255.0 * 2.0 - 1.0, atol=1e-6)
+    assert y == int(labs[3])
+
+
+def test_conll05_props_parser(tmp_path):
+    """Official conll05st layout: parallel words.gz/props.gz streams,
+    bracket columns -> BIO, one item per predicate, 9-slot SRL tuples
+    (reference conll05.py:53-178 semantics)."""
+    import gzip
+    import io
+    import tarfile
+
+    from paddle_tpu.dataset import conll05
+
+    words = "The\ncat\nchased\na\nmouse\n.\n\n"
+    # two predicate columns: 'chased' (col 1) and a fake second 'saw'
+    props_rows = [
+        "-    *        (A0*",
+        "-    (A0*)    *)",
+        "chased (V*)   *",
+        "saw  (A1*     (V*)",
+        "-    *)       (A1*)",
+        "-    *        *",
+        "",
+    ]
+    props = "\n".join(" ".join(r.split()) for r in props_rows) + "\n"
+    arch = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(arch, "w:gz") as tf:
+        for name, text in ((conll05.WORDS_NAME, words),
+                           (conll05.PROPS_NAME, props)):
+            blob = io.BytesIO()
+            with gzip.GzipFile(fileobj=blob, mode="wb") as gz:
+                gz.write(text.encode())
+            info = tarfile.TarInfo(name)
+            info.size = len(blob.getvalue())
+            tf.addfile(info, io.BytesIO(blob.getvalue()))
+
+    items = list(conll05.corpus_reader(str(arch))())
+    assert len(items) == 2                       # one per predicate column
+    sent, pred, labels = items[0]
+    assert sent == ["The", "cat", "chased", "a", "mouse", "."]
+    assert pred == "chased"
+    assert labels == ["O", "B-A0", "B-V", "B-A1", "I-A1", "O"]
+    sent2, pred2, labels2 = items[1]
+    assert labels2 == ["B-A0", "I-A0", "O", "B-V", "B-A1", "O"]
+
+    wd = {w: i + 1 for i, w in enumerate(sorted(set(sent)))}
+    vd = {"chased": 0}
+    ld = {t: i for i, t in enumerate(
+        sorted({t for it in items for t in it[2]}))}
+    rows = list(conll05.reader_creator(
+        conll05.corpus_reader(str(arch)), wd, vd, ld)())
+    assert len(rows) == 2
+    w_idx, n2, n1, c0, p1, p2, pidx, mark, lab = rows[0]
+    assert len(w_idx) == 6 and len(lab) == 6
+    # predicate window around 'chased' (index 2): marks on 0..4
+    assert mark == [1, 1, 1, 1, 1, 0]
+    assert c0 == [wd["chased"]] * 6 and pidx == [0] * 6
