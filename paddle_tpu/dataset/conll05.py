@@ -18,12 +18,9 @@ official-layout tarball in tests/test_dataset_tail.py.
 from __future__ import annotations
 
 import gzip
-import os
 import tarfile
 
 import numpy as np
-
-from .common import DATA_HOME
 
 DATA_URL = "http://www.cs.upc.edu/~srlconll/conll05st-tests.tar.gz"
 DATA_MD5 = "387719152ae52d60422c016e92a742fc"
@@ -73,6 +70,13 @@ def corpus_reader(data_path, words_name=WORDS_NAME, props_name=PROPS_NAME):
     """Iterate (sentence words, predicate, BIO labels) triples from an
     official-layout archive — one triple per predicate column."""
 
+    def flush(words, cols):
+        verbs = [row[0] for row in cols if row[0] != "-"]
+        n_preds = len(cols[0]) - 1 if cols else 0
+        for p in range(n_preds):
+            col = [c[p + 1] for c in cols]
+            yield list(words), verbs[p], _bracket_to_bio(col)
+
     def reader():
         with tarfile.open(data_path) as tf:
             with gzip.GzipFile(fileobj=tf.extractfile(words_name)) as wf, \
@@ -83,17 +87,13 @@ def corpus_reader(data_path, words_name=WORDS_NAME, props_name=PROPS_NAME):
                     fields = pline.decode().split()
                     if not fields:                     # sentence boundary
                         if words:
-                            verbs = [row[0] for row in cols
-                                     if row[0] != "-"]
-                            n_preds = len(cols[0]) - 1 if cols else 0
-                            for p in range(n_preds):
-                                col = [c[p + 1] for c in cols]
-                                yield (list(words), verbs[p],
-                                       _bracket_to_bio(col))
+                            yield from flush(words, cols)
                         words, cols = [], []
                     else:
                         words.append(word)
                         cols.append(fields)
+                if words:       # no trailing blank line: flush the tail
+                    yield from flush(words, cols)
 
     return reader
 
@@ -130,16 +130,21 @@ def reader_creator(corpus_reader, word_dict, predicate_dict, label_dict):
     return reader
 
 
-def _cached_archive():
-    p = os.path.join(DATA_HOME, "conll05st", "conll05st-tests.tar.gz")
-    return p if os.path.exists(p) else None
+def _archive(download=False):
+    """md5-verified official archive via the shared cache probe (a
+    populated cache must not silently change what a DEFAULT reader
+    yields — real data only on explicit request, common.cached_path)."""
+    from .common import cached_path
+    return cached_path(DATA_URL, "conll05st", DATA_MD5,
+                       do_download=download)
 
 
-def get_dict():
-    """Word/verb/label dictionaries.  With a cached archive the dicts are
-    built from the corpus itself (the published dict files are a separate
-    download); offline they are the synthetic vocabulary."""
-    arch = _cached_archive()
+def get_dict(download=False):
+    """Word/verb/label dictionaries.  With the official archive
+    (explicitly requested) the dicts are built from the corpus itself
+    (the published dict files are a separate download); by default they
+    are the synthetic vocabulary."""
+    arch = _archive(download)
     if arch is None:
         word_dict = {f"w{i}": i for i in range(WORD_VOCAB)}
         verb_dict = {f"v{i}": i for i in range(50)}
@@ -180,12 +185,13 @@ def train():
     return _gen(60, 1000)
 
 
-def test():
-    """Real corpus when the official archive is cached (9-slot SRL
-    tuples); synthetic fallback otherwise."""
-    arch = _cached_archive()
+def test(download=False):
+    """Synthetic 2-tuples by default (matches train()); pass
+    ``download=True`` for the official corpus as 9-slot SRL tuples —
+    explicit opt-in, because the schemas differ."""
+    arch = _archive(download)
     if arch is None:
         return _gen(61, 200)
-    word_dict, verb_dict, label_dict = get_dict()
+    word_dict, verb_dict, label_dict = get_dict(download)
     return reader_creator(corpus_reader(arch), word_dict, verb_dict,
                           label_dict)

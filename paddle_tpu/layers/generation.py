@@ -29,6 +29,7 @@ Usage::
 from __future__ import annotations
 
 import contextlib
+import itertools
 
 from ..core import unique_name
 from ..layer_helper import LayerHelper
@@ -36,9 +37,37 @@ from ..layer_helper import LayerHelper
 __all__ = ["BeamSearchDecoder"]
 
 
+# ---------------------------------------------------------------------------
+# per-step beam hooks (reference: RecurrentGradientMachine.h:71-130 exposes
+# beam drill-down callbacks for inspection/pruning).  Hooks live in a
+# registry so the op attr stays a JSON-serializable name.
+# ---------------------------------------------------------------------------
+_STEP_HOOKS = {}
+_HOOK_COUNTER = itertools.count()
+
+
+def register_beam_hook(name, fn):
+    """Register a traceable per-step hook.  Called inside the compiled
+    decode scan as ``fn(t, info)`` with ``info = {"scores": [B,K,V]
+    candidate log-probs, "tokens": [B,K] current tokens, "finished":
+    [B,K] bool}``; must return ``None`` or an additive [B,K,V] bias
+    applied before top-k (``-inf`` entries prune candidates, e.g. forcing
+    an early EOS).  jnp ops only — it runs under jit."""
+    _STEP_HOOKS[name] = fn
+    return name
+
+
+def get_beam_hook(name):
+    if name not in _STEP_HOOKS:
+        raise KeyError(
+            f"beam step hook {name!r} is not registered in this process; "
+            f"call register_beam_hook(name, fn) before running the decoder")
+    return _STEP_HOOKS[name]
+
+
 class BeamSearchDecoder:
     def __init__(self, beam_size, bos_id, eos_id, max_len, vocab_size,
-                 length_penalty=0.0, name=None):
+                 length_penalty=0.0, step_hook=None, name=None):
         self.helper = LayerHelper("beam_search", name=name)
         self.program = self.helper.main_program
         self.beam_size = beam_size
@@ -47,6 +76,13 @@ class BeamSearchDecoder:
         self.max_len = max_len
         self.vocab_size = vocab_size
         self.length_penalty = length_penalty
+        if callable(step_hook):
+            # names come from a process-local counter, NOT unique_name
+            # (which callers reset between model builds): a retained
+            # program's hook attr must never silently rebind
+            step_hook = register_beam_hook(
+                f"__beam_hook_{next(_HOOK_COUNTER)}", step_hook)
+        self.step_hook = step_hook      # registry name or None
         self.memories = {}      # step name -> [init var, update name]
         self.contexts = {}      # step name -> parent var
         self.token_var = None
@@ -134,6 +170,7 @@ class BeamSearchDecoder:
                 "max_len": self.max_len,
                 "vocab_size": self.vocab_size,
                 "length_penalty": self.length_penalty,
+                "step_hook": self.step_hook,
             })
         self.outputs = (ids, scores, lens)
 

@@ -25,6 +25,10 @@ def _beam_search(ctx, ins, attrs):
     T = int(attrs["max_len"])
     V = int(attrs["vocab_size"])
     lp = float(attrs.get("length_penalty", 0.0))
+    hook = None
+    if attrs.get("step_hook"):
+        from ..layers.generation import get_beam_hook
+        hook = get_beam_hook(attrs["step_hook"])
 
     ctx_names = attrs.get("ctx_step_names", [])
     init_in = ins.get("InitStates", [])
@@ -69,6 +73,13 @@ def _beam_search(ctx, ins, attrs):
         frozen = jnp.full((B, K, V), NEG_INF).at[:, :, eos].set(0.0)
         logp = jnp.where(finished[..., None], frozen, logp)
         total = cum[..., None] + logp                      # [B,K,V]
+        if hook is not None:
+            # RecurrentGradientMachine drill-down analog: the hook sees the
+            # candidate frontier and may bias/prune it (-inf) before top-k
+            bias = hook(t, {"scores": total, "tokens": tokens,
+                            "finished": finished})
+            if bias is not None:
+                total = total + bias
         # first step: all K beams are identical copies of bos — keep only
         # beam 0's candidates so the frontier isn't K duplicates
         first = (t == 0)

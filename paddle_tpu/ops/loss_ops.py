@@ -224,3 +224,34 @@ def _lambda_rank(ctx, ins, attrs):
 
     f.defvjp(fwd, bwd)
     return {"Out": f(o.astype(jnp.float32))[:, None]}
+
+
+@register_op("cross_entropy_over_beam")
+def _cross_entropy_over_beam(ctx, ins, attrs):
+    """Beam-level training cost (CrossEntropyOverBeam.cpp:19-120): per
+    expansion step, cross-entropy of the gold candidate among the beam's
+    candidate scores; summed over steps.  TPU-native static-shape form:
+    each step is (scores [B,K], candidate ids [B,K], gold id [B]).  When
+    the gold is IN the beam the softmax runs over exactly the K candidate
+    paths (reference in-beam case, bitwise comparable); when it fell off,
+    the reference appends the gold as an extra path with its true path
+    score — statically approximated here by a virtual (K+1)-th slot scored
+    min(scores)-4 (a just-below-the-frontier path), which preserves the
+    training signal (push gold up, beam down) with static shapes.
+    """
+    total = None
+    for s, c, g in zip(ins["Scores"], ins["Cands"], ins["Gold"]):
+        s = s.reshape(s.shape[0], -1).astype(jnp.float32)
+        c = c.reshape(c.shape[0], -1)
+        g = g.reshape(-1).astype(c.dtype)
+        K = s.shape[1]
+        match = c == g[:, None]
+        in_beam = match.any(axis=1)
+        pos = jnp.argmax(match, axis=1)
+        extra = jnp.where(in_beam, -1e30, jnp.min(s, axis=1) - 4.0)
+        aug = jnp.concatenate([s, extra[:, None]], axis=1)
+        logp = jax.nn.log_softmax(aug, axis=1)
+        idx = jnp.where(in_beam, pos, K)
+        ce = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        total = ce if total is None else total + ce
+    return {"Out": total[:, None]}

@@ -27,7 +27,8 @@ __all__ = [
     "hsigmoid", "huber_classification_cost", "huber_regression_cost",
     "img_conv3d_layer", "img_pool3d_layer", "interpolation_layer",
     "kmax_seq_score_layer", "l2_distance_layer", "layer_support",
-    "linear_comb_layer", "lstm_step_layer", "maxout_layer",
+    "linear_comb_layer", "convex_comb_layer", "LayerType", "LayerOutput",
+    "BeamInput", "lstm_step_layer", "maxout_layer",
     "multi_binary_label_cross_entropy", "multibox_loss_layer",
     "multiplex_layer", "nce_layer", "out_prod_layer", "pad_layer",
     "prelu_layer", "printer_layer", "priorbox_layer", "rank_cost",
@@ -245,6 +246,134 @@ def linear_comb_layer(weights, vectors, size=None, name=None, **kw):
     w = L.reshape(weights, [-1, M, 1])
     out = L.reduce_sum(L.elementwise_mul(v, w), dim=1)
     return track_layer(name, out)
+
+
+# layers.py:5346 — convex_comb_layer is the historical alias
+convex_comb_layer = linear_comb_layer
+
+
+class LayerType:
+    """v1 layer-type enumeration (layers.py:155-314).  The values are the
+    v1 config-proto type strings — protocol constants, reproduced exactly
+    (several are NOT the lowercased member name: POOL_LAYER='pool',
+    RANK_COST='rank-cost', CROSS_ENTROPY='multi-class-cross-entropy')."""
+
+    DATA = "data"
+    MIXED_LAYER = "mixed"
+    LSTMEMORY = "lstmemory"
+    GRUMEMORY = "gated_recurrent"
+    SEQUENCE_LAST_INSTANCE = "seqlastins"
+    SEQUENCE_FIRST_INSTANCE = "seqfirstins"
+    SEQUENCE_RESHAPE = "seqreshape"
+    POOLING_MAX = "max"
+    POOLING_AVG = "average"
+    FC_LAYER = "fc"
+    COST = "cost"
+    COSINE_SIM_VEC = "cos_vm"
+    COSINE_SIM = "cos"
+    L2_DISTANCE = "l2_distance"
+    HSIGMOID = "hsigmoid"
+    CONV_LAYER = "conv"
+    CONVTRANS_LAYER = "convt"
+    EXCONV_LAYER = "exconv"
+    EXCONVTRANS_LAYER = "exconvt"
+    CUDNNCONV_LAYER = "cudnn_conv"
+    CUDNNCONVTRANS_LAYER = "cudnn_convt"
+    POOL_LAYER = "pool"
+    POOL3D_LAYER = "pool3d"
+    BATCH_NORM_LAYER = "batch_norm"
+    NORM_LAYER = "norm"
+    SUM_TO_ONE_NORM_LAYER = "sum_to_one_norm"
+    ROW_L2_NORM_LAYER = "row_l2_norm"
+    ADDTO_LAYER = "addto"
+    CONCAT_LAYER = "concat"
+    CONCAT_PROJ_LAYER = "concat2"
+    SEQUENCE_CONCAT_LAYER = "seqconcat"
+    LSTM_STEP_LAYER = "lstm_step"
+    GRU_STEP_LAYER = "gru_step"
+    GET_OUTPUT_LAYER = "get_output"
+    EXPAND_LAYER = "expand"
+    INTERPOLATION_LAYER = "interpolation"
+    BILINEAR_INTERP_LAYER = "bilinear_interp"
+    POWER_LAYER = "power"
+    SCALING_LAYER = "scaling"
+    TRANS_LAYER = "trans"
+    ROTATE_LAYER = "rotate"
+    DOT_PROD_LAYER = "dot_prod"
+    OUT_PROD_LAYER = "out_prod"
+    FEATURE_MAP_EXPAND_LAYER = "featmap_expand"
+    MEMORY = "memory"
+    MAXID_LAYER = "maxid"
+    EOSID_LAYER = "eos_id"
+    RECURRENT_LAYER = "recurrent"
+    CONV_SHIFT_LAYER = "conv_shift"
+    TENSOR_LAYER = "tensor"
+    SEL_FC_LAYER = "selective_fc"
+    SAMPLING_ID_LAYER = "sampling_id"
+    SLOPE_INTERCEPT_LAYER = "slope_intercept"
+    LINEAR_COMBINATION_LAYER = "convex_comb"
+    BLOCK_EXPAND = "blockexpand"
+    MAXOUT = "maxout"
+    SPP_LAYER = "spp"
+    PAD_LAYER = "pad"
+    MULTIPLEX_LAYER = "multiplex"
+    ROW_CONV_LAYER = "row_conv"
+    PRINT_LAYER = "print"
+    PRIORBOX_LAYER = "priorbox"
+    MULTIBOX_LOSS_LAYER = "multibox_loss"
+    DETECTION_OUTPUT_LAYER = "detection_output"
+    ROI_POOL_LAYER = "roi_pool"
+    CTC_LAYER = "ctc"
+    WARP_CTC_LAYER = "warp_ctc"
+    CRF_LAYER = "crf"
+    CRF_DECODING_LAYER = "crf_decoding"
+    NCE_LAYER = "nce"
+    CONV3D_LAYER = "conv3d"
+    DECONV3D_LAYER = "deconv3d"
+    RANK_COST = "rank-cost"
+    LAMBDA_COST = "lambda_cost"
+    HUBER_REGRESSION = "huber_regression"
+    HUBER_CLASSIFICATION = "huber_classification"
+    CROSS_ENTROPY = "multi-class-cross-entropy"
+    CROSS_ENTROPY_WITH_SELFNORM = "multi_class_cross_entropy_with_selfnorm"
+    CROSS_ENTROPY_OVER_BEAM = "cross_entropy_over_beam"
+    SOFT_BIN_CLASS_CROSS_ENTROPY = "soft_binary_class_cross_entropy"
+    MULTI_BIN_LABEL_CROSS_ENTROPY = "multi_binary_label_cross_entropy"
+    SUM_COST = "sum_cost"
+    SMOOTH_L1 = "smooth_l1"
+    PRELU = "prelu"
+    SWITCH_ORDER_LAYER = "switch_order"
+    CROP_LAYER = "crop"
+    SUB_NESTED_SEQ = "sub_nested_seq"
+    CLIP_LAYER = "clip"
+    SEQ_SLICE = "seq_slice"
+    KMAX_SEQ_SCORE = "kmax_seq_score"
+    SCALE_SHIFT_LAYER = "scale_shift"
+    RESIZE = "resize"
+    SUB_SEQ_LAYER = "subseq"
+    SCALE_SUB_REGION_LAYER = "scale_sub_region"
+    FACTORIZATION_MACHINE = "factorization_machine"
+
+    @staticmethod
+    def is_layer_type(type_name):
+        return isinstance(type_name, str)
+
+
+# The DSL's layer outputs ARE program Variables (layers.py:315 LayerOutput
+# tracked name/type/parents; here the Variable carries name/shape/dtype and
+# the program records producers) — exporting the class keeps isinstance
+# checks in user configs meaningful.
+from ..core.program import Variable as LayerOutput  # noqa: E402
+
+
+class BeamInput:
+    """Input triple for cross_entropy_over_beam (layers.py:6355):
+    per-candidate scores, selected candidate ids, and the gold index."""
+
+    def __init__(self, candidate_scores, selected_candidates, gold):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
 
 
 def interpolation_layer(input, weight, name=None, **kw):
@@ -655,10 +784,27 @@ def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, name=None,
 
 
 def cross_entropy_over_beam(input, name=None, **kw):
-    raise NotImplementedError(
-        "cross_entropy_over_beam trained the v1 beam in-graph; the "
-        "static-shape scan decoder (layers.generation.BeamSearchDecoder) "
-        "plus per-step cross_entropy subsumes this training scheme")
+    """Beam-level training cost (layers.py:6377; CrossEntropyOverBeam.h:95).
+    ``input``: list of BeamInput(candidate_scores [B,K], selected_candidates
+    [B,K] int ids, gold [B] int id), one per beam expansion step.  Returns
+    the mean summed cross-entropy of the gold path against each step's beam
+    frontier (ops/loss_ops.py for the in-beam/off-beam semantics).  The
+    end-to-end demonstration that beam-level training works lives in
+    tests/test_generation.py::test_cross_entropy_over_beam_trains."""
+    from ..layer_helper import LayerHelper
+    if not isinstance(input, (list, tuple)):
+        input = [input]
+    helper = LayerHelper("cross_entropy_over_beam", name=name)
+    scores = [b.candidate_scores for b in input]
+    out = helper.create_variable_for_type_inference(
+        "float32", (scores[0].shape[0], 1))
+    helper.append_op(
+        type="cross_entropy_over_beam",
+        inputs={"Scores": scores,
+                "Cands": [b.selected_candidates for b in input],
+                "Gold": [b.gold for b in input]},
+        outputs={"Out": [out]})
+    return track_layer(name, L.mean(out))
 
 
 # -- detection --------------------------------------------------------------

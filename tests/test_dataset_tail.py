@@ -450,6 +450,20 @@ def test_conll05_props_parser(tmp_path):
 
     items = list(conll05.corpus_reader(str(arch))())
     assert len(items) == 2                       # one per predicate column
+    # tail flush: the same archive WITHOUT the trailing blank line must
+    # still yield the final sentence
+    arch2 = tmp_path / "no-trailing-newline.tar.gz"
+    with tarfile.open(arch2, "w:gz") as tf:
+        for name, text in ((conll05.WORDS_NAME, words.rstrip("\n") + "\n"),
+                           (conll05.PROPS_NAME,
+                            props.rstrip("\n").rsplit("\n", 1)[0] + "\n")):
+            blob = io.BytesIO()
+            with gzip.GzipFile(fileobj=blob, mode="wb") as gz:
+                gz.write(text.encode())
+            info = tarfile.TarInfo(name)
+            info.size = len(blob.getvalue())
+            tf.addfile(info, io.BytesIO(blob.getvalue()))
+    assert len(list(conll05.corpus_reader(str(arch2))())) == 2
     sent, pred, labels = items[0]
     assert sent == ["The", "cat", "chased", "a", "mouse", "."]
     assert pred == "chased"
