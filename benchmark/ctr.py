@@ -1,0 +1,128 @@
+"""Sparse/CTR training benchmark (BASELINE.json flagship config #4:
+DeepFM / wide-deep CTR with high-dim sparse tables — the workload the
+reference served with SparseRemoteParameterUpdater + SparseRowMatrix
+(RemoteParameterUpdater.h:265, math/SparseRowMatrix.h:206); here the
+embedding is a vocab-shardable jax table, gathers ride XLA, and the
+question is what actually bounds a step at 10M-row scale).
+
+Measures rows/s for wide_deep with a 10M-row embedding table (plus
+1M/100k/10k auxiliary fields, criteo-ish 13 dense features) under three
+optimizers that isolate the suspected bottleneck — the dense optimizer
+moment sweep over the big tables:
+
+  sgd        — no optimizer state: the only table traffic is gather +
+               scatter-add grads (update touches rows... but XLA applies
+               dense w - lr*g over the full table: still a full sweep)
+  adam       — dense fused sweep: reads w,m,v + writes w,m,v every step
+  adam_lazy  — Adam(lazy_mode=True): gather/scatter moment update on the
+               touched rows only (re-validating the round-4 negative
+               result at 10M-row scale, where the dense sweep costs
+               ~2 GB/step of HBM traffic and lazy SHOULD win)
+
+Methodology: pinned compiled-window form — one `Executor.run_steps(K)`
+dispatch per timed window, feeds staged on device once, median of 3
+windows, completion forced by a scalar fetch (axon block_until_ready
+returns early).  Writes benchmark/ctr_results.json.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as pt                      # noqa: E402
+from paddle_tpu import layers, models        # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "ctr_results.json")
+
+VOCABS = [10_000_000, 1_000_000, 100_000, 10_000]
+EMB_DIM = 16
+DENSE_D = 13
+BATCH = 4096
+
+
+def _build(optimizer):
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    pt.unique_name.reset()
+    ids = [layers.data(f"id{i}", shape=[1], dtype="int64")
+           for i in range(len(VOCABS))]
+    dense = layers.data("dense", shape=[DENSE_D], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="float32")
+    pred = models.wide_deep(ids, dense, VOCABS, emb_dim=EMB_DIM)
+    loss = layers.mean(layers.log_loss(pred, label))
+    optimizer.minimize(loss)
+    return loss
+
+
+def _feeds(rng):
+    f = {f"id{i}": rng.randint(0, v, (BATCH, 1))
+         for i, v in enumerate(VOCABS)}
+    f["dense"] = rng.rand(BATCH, DENSE_D).astype("float32")
+    f["label"] = (rng.rand(BATCH, 1) < 0.3).astype("float32")
+    return f
+
+
+def bench_variant(name, optimizer, iters=100, reps=3):
+    import jax
+
+    rng = np.random.RandomState(0)
+    loss = _build(optimizer)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    feeds = {k: jax.device_put(v) for k, v in _feeds(rng).items()}
+    # warmup compiles the SAME scan length as the timed windows
+    (lv,) = exe.run_steps(iters, feed=feeds, fetch_list=[loss],
+                          return_numpy=False)
+    if not np.isfinite(float(np.asarray(lv)[-1])):
+        raise FloatingPointError(f"{name}: non-finite warmup loss")
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        (lv,) = exe.run_steps(iters, feed=feeds, fetch_list=[loss],
+                              return_numpy=False)
+        last = float(np.asarray(lv)[-1])     # completion barrier
+        times.append(time.perf_counter() - t0)
+    if not np.isfinite(last):
+        raise FloatingPointError(f"{name}: non-finite timed loss")
+    med = float(np.median(times)) / iters
+    row = {"variant": name, "ms_per_step": round(med * 1e3, 3),
+           "rows_per_sec": round(BATCH / med),
+           "spread_pct": round(100 * (max(times) - min(times))
+                               / np.median(times), 2)}
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main():
+    import jax
+
+    # analytic accounting for the expected regimes, printed next to data:
+    # dense Adam sweep traffic/step = 3 reads + 3 writes of every table
+    table_bytes = 4 * sum(v * (EMB_DIM + 1) for v in VOCABS)
+    rows = {"device": str(jax.devices()[0]),
+            "batch": BATCH, "vocabs": VOCABS, "emb_dim": EMB_DIM,
+            "table_bytes": table_bytes,
+            "expected_dense_sweep_ms_at_675GBps":
+                round(6 * table_bytes / 675e9 * 1e3, 2),
+            "variants": []}
+    for name, opt in [
+        ("sgd", pt.optimizer.SGD(learning_rate=0.1)),
+        ("adam_dense", pt.optimizer.Adam(learning_rate=1e-3)),
+        ("adam_lazy", pt.optimizer.Adam(learning_rate=1e-3,
+                                        lazy_mode=True)),
+    ]:
+        rows["variants"].append(bench_variant(name, opt))
+    with open(OUT, "w") as f:
+        json.dump(rows, f, indent=1)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()
