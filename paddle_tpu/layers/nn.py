@@ -1237,7 +1237,7 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
 
 
 def flash_attention(q, k, v, causal=False, block_q=1024, block_k=1024,
-                    sequence_parallel=True, name=None):
+                    sequence_parallel=True, interpret=False, name=None):
     """Fused O(T)-memory attention (Pallas kernel on TPU; exact).  q/k/v:
     [B, T, H, D] or [BH, T, D].  The long-context path the reference never
     had.  Under a ``ShardedExecutor`` whose mesh has sp>1, eligible
@@ -1253,7 +1253,10 @@ def flash_attention(q, k, v, causal=False, block_q=1024, block_k=1024,
                      outputs={"Out": [out]},
                      attrs={"causal": causal, "block_q": block_q,
                             "block_k": block_k,
-                            "sequence_parallel": sequence_parallel})
+                            "sequence_parallel": sequence_parallel,
+                            # Pallas-interpreter mode: lets CPU tests run
+                            # the EXACT fused-kernel code path
+                            "interpret": interpret})
     return out
 
 

@@ -471,10 +471,12 @@ def _flash_attention_op(ctx, ins, attrs):
         out = ring_attention_sharded(
             q4, k4, v4, ctx.mesh, causal=causal,
             block_q=attrs.get("block_q", 1024),
-            block_k=attrs.get("block_k", 1024))
+            block_k=attrs.get("block_k", 1024),
+            interpret=attrs.get("interpret", False))
         return {"Out": out[:, :, 0, :] if q.ndim == 3 else out}
     return {"Out": flash_attention(
         q, k, v,
         causal=causal,
         block_q=attrs.get("block_q", 1024),   # swept best at 16k, D=64
-        block_k=attrs.get("block_k", 1024))}
+        block_k=attrs.get("block_k", 1024),
+        interpret=attrs.get("interpret", False))}

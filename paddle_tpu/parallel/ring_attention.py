@@ -47,14 +47,18 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     hop instead of the [T_local, T_local] score matrix, composing the two
     long-context mechanisms (ring over ICI x flash in VMEM).
     """
+    T_loc = q.shape[1]
+    divisible = (T_loc % min(block_q, T_loc) == 0
+                 and T_loc % min(block_k, T_loc) == 0)
     if use_flash is None:
         import jax as _jax
         from ..ops.pallas_kernels import _HAVE_PALLAS
-        T_loc = q.shape[1]
         use_flash = (_HAVE_PALLAS and _jax.default_backend() == "tpu"
-                     and T_loc % min(block_q, T_loc) == 0
-                     and T_loc % min(block_k, T_loc) == 0)
-    if use_flash or interpret:
+                     and divisible)
+    # non-divisible local blocks always fall back to the exact jnp path —
+    # same policy as the device-global wrapper, so forcing the kernel via
+    # use_flash/interpret degrades instead of raising mid-training
+    if (use_flash or interpret) and divisible:
         return _ring_attention_flash(q, k, v, axis_name, causal, scale,
                                      block_q, block_k, interpret)
     return _ring_attention_jnp(q, k, v, axis_name, causal, scale)
@@ -162,7 +166,8 @@ def _ring_attention_jnp(q, k, v, axis_name, causal, scale):
 
 def ring_attention_sharded(q, k, v, mesh, causal=False, axis_name: str = "sp",
                            scale=None, block_q: int = 1024,
-                           block_k: int = 1024):
+                           block_k: int = 1024, use_flash=None,
+                           interpret: bool = False):
     """Global-array entry point: partial-manual shard_map over ONLY the sp
     axis (dp/tp stay GSPMD-managed, mirroring pipeline_program.py), with
     :func:`ring_attention` inside.  q,k,v: global [B, T, H, D]; returns the
@@ -177,7 +182,8 @@ def ring_attention_sharded(q, k, v, mesh, causal=False, axis_name: str = "sp",
     spec = P(None, axis_name)
     body = functools.partial(ring_attention, axis_name=axis_name,
                              causal=causal, scale=scale, block_q=block_q,
-                             block_k=block_k)
+                             block_k=block_k, use_flash=use_flash,
+                             interpret=interpret)
     return jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names=frozenset({axis_name}), check_vma=False)(q, k, v)
