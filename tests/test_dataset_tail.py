@@ -483,3 +483,51 @@ def test_conll05_props_parser(tmp_path):
     # predicate window around 'chased' (index 2): marks on 0..4
     assert mark == [1, 1, 1, 1, 1, 0]
     assert c0 == [wd["chased"]] * 6 and pidx == [0] * 6
+
+
+def test_cifar_imikolov_uci_parsers_hermetic(tmp_path, rng):
+    """HTTP-free duplicates of the core format-parser checks that
+    otherwise live only in test_dataset_real.py (which some CI setups
+    deselect wholesale over its localhost download tests): cifar pickle
+    tar, imikolov ngram tgz, uci_housing whitespace table."""
+    import pickle
+    import tarfile as tar_mod
+
+    from paddle_tpu.dataset import cifar, imikolov, uci_housing
+
+    # cifar
+    arch = tmp_path / "cifar-10-python.tar.gz"
+    with tar_mod.open(arch, "w:gz") as tf:
+        batch = {"data": (rng.rand(4, 3072) * 255).astype("uint8"),
+                 "labels": [int(x) for x in rng.randint(0, 10, 4)]}
+        blob = pickle.dumps(batch)
+        info = tar_mod.TarInfo("cifar-10-batches-py/data_batch_1")
+        info.size = len(blob)
+        tf.addfile(info, io.BytesIO(blob))
+    samples = list(cifar._tar_reader(str(arch), "data_batch", "labels")())
+    assert len(samples) == 4 and samples[0][0].shape == (3, 32, 32)
+
+    # imikolov
+    arch2 = tmp_path / "simple-examples.tgz"
+    txt = b"the cat sat\n"
+    with tar_mod.open(arch2, "w:gz") as tf:
+        info = tar_mod.TarInfo(imikolov.TRAIN_FILE)
+        info.size = len(txt)
+        tf.addfile(info, io.BytesIO(txt))
+    with tar_mod.open(arch2) as tf:
+        freq = imikolov.word_count(tf.extractfile(imikolov.TRAIN_FILE))
+    word_idx = {w: i for i, w in enumerate(sorted(freq))}
+    word_idx["<unk>"] = len(word_idx)
+    grams = list(imikolov._real_reader(
+        imikolov.TRAIN_FILE, word_idx, 3, imikolov.DataType.NGRAM,
+        str(arch2))())
+    assert len(grams) == 3 and all(len(g) == 3 for g in grams)
+
+    # uci_housing
+    raw = rng.rand(10, 14).astype("float32") * 10
+    f = tmp_path / "housing.data"
+    with open(f, "w") as fh:
+        for row in raw:
+            fh.write(" ".join(f"{v:.4f}" for v in row) + "\n")
+    train_rows, test_rows = uci_housing.load_data(str(f))
+    assert train_rows.shape[0] == 8 and test_rows.shape[0] == 2
