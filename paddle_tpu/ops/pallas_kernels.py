@@ -477,6 +477,6 @@ def _flash_attention_op(ctx, ins, attrs):
     return {"Out": flash_attention(
         q, k, v,
         causal=causal,
-        block_q=attrs.get("block_q", 1024),   # swept best at 16k, D=64
+        block_q=attrs.get("block_q", 1024),   # swept best at 16k AND 32k
         block_k=attrs.get("block_k", 1024),
         interpret=attrs.get("interpret", False))}
