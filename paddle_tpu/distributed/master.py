@@ -54,14 +54,17 @@ class Master:
     def set_dataset(self, chunks: List):
         """Partition chunks into tasks (service.go partition :106)."""
         with self._lock:
-            self.todo = []
-            for i in range(0, len(chunks), self.chunks_per_task):
-                self.todo.append(Task(self._next_id,
-                                      chunks[i:i + self.chunks_per_task],
-                                      self.epoch))
-                self._next_id += 1
-            self.done = []
-            self.pending = {}
+            self._set_dataset_locked(chunks)
+
+    def _set_dataset_locked(self, chunks: List):
+        self.todo = []
+        for i in range(0, len(chunks), self.chunks_per_task):
+            self.todo.append(Task(self._next_id,
+                                  chunks[i:i + self.chunks_per_task],
+                                  self.epoch))
+            self._next_id += 1
+        self.done = []
+        self.pending = {}
 
     # -- trainer RPCs ------------------------------------------------------
     def get_task(self) -> Optional[Task]:
@@ -107,6 +110,24 @@ class Master:
                 self.done.append(t)     # dropped from training this pass
             else:
                 self.todo.append(t)
+
+    def task_returned(self, task_id: int):
+        """Politely hand an in-flight task back (a reader stopped early,
+        not a crash): requeue WITHOUT burning the failure budget."""
+        with self._lock:
+            ent = self.pending.pop(task_id, None)
+            if ent:
+                self.todo.append(ent[0])
+
+    def set_dataset_if_empty(self, chunks: List) -> bool:
+        """Atomic queue priming for concurrent trainers: the first caller
+        partitions the dataset, later callers no-op (a client-side
+        stats-then-set would race and re-issue in-flight tasks)."""
+        with self._lock:
+            if self.todo or self.pending or self.done:
+                return False
+            self._set_dataset_locked(chunks)
+            return True
 
     def request_save_model(self, trainer_id: str,
                            block_dur_s: float = 60.0) -> bool:
@@ -171,8 +192,9 @@ class MasterServer:
     locked.
     """
 
-    METHODS = ("get_task", "task_finished", "task_failed", "set_dataset",
-               "stats", "ping", "request_save_model")
+    METHODS = ("get_task", "task_finished", "task_failed", "task_returned",
+               "set_dataset", "set_dataset_if_empty", "stats", "ping",
+               "request_save_model")
 
     def __init__(self, master: Master, host: str = "127.0.0.1",
                  port: int = 0):
@@ -214,6 +236,8 @@ class MasterServer:
             return dataclasses.asdict(t) if t is not None else None
         if method == "set_dataset":
             return self.master.set_dataset(params["chunks"])
+        if method == "set_dataset_if_empty":
+            return self.master.set_dataset_if_empty(params["chunks"])
         if method == "stats":
             return self.master.stats()
         if method == "request_save_model":
@@ -291,8 +315,14 @@ class MasterClient:
     def task_failed(self, task_id: int):
         return self._call("task_failed", task_id=task_id)
 
+    def task_returned(self, task_id: int):
+        return self._call("task_returned", task_id=task_id)
+
     def set_dataset(self, chunks: List):
         return self._call("set_dataset", chunks=chunks)
+
+    def set_dataset_if_empty(self, chunks: List) -> bool:
+        return self._call("set_dataset_if_empty", chunks=chunks)
 
     def stats(self) -> dict:
         return self._call("stats")

@@ -8,6 +8,7 @@ double-buffering (the PyDataProvider2 async pool role,
 PyDataProvider2.cpp:195) is provided by ``buffered`` / ``xmap_readers`` over
 ``paddle_tpu.distributed.queue`` (native-backed when available).
 """
+from . import creator
 from . import decorator
 from .decorator import (batch, buffered, cache, chain, compose, firstn,
                         map_readers, native_buffered, shuffle, xmap_readers)
