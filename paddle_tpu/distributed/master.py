@@ -354,16 +354,42 @@ class TaskQueueClient:
         self.chunk_reader = chunk_reader
 
     def reader(self):
-        def _r():
-            while True:
-                task = self.master.get_task()
-                if task is None:
-                    return
+        return task_loop_reader(self.master, self.chunk_reader,
+                                swallow_failures=True)
+
+
+def task_loop_reader(client, chunk_reader: Callable,
+                     swallow_failures: bool = False):
+    """The shared task-pull loop (go/master client semantics) used by
+    both in-process ``TaskQueueClient`` and ``reader.creator.cloud_reader``:
+    finish on success; FAIL (budget-burning) on real exceptions; RETURN
+    without burning the budget on polite early-stop (GeneratorExit from
+    ``firstn``/loop breaks — the task requeues immediately for peers).
+    ``swallow_failures`` keeps iterating past bad chunks (the elastic
+    in-process behavior) instead of re-raising."""
+
+    def _r():
+        while True:
+            task = client.get_task()
+            if task is None:
+                return
+            try:
+                for chunk in task.chunks:
+                    yield from chunk_reader(chunk)
+            except GeneratorExit:
+                # best-effort: finalization must not raise or stall hard
+                # if the master died (the task times out and requeues
+                # anyway, at the cost of one budget tick)
                 try:
-                    for chunk in task.chunks:
-                        yield from self.chunk_reader(chunk)
+                    client.task_returned(task.task_id)
                 except Exception:
-                    self.master.task_failed(task.task_id)
+                    pass
+                raise
+            except Exception:
+                client.task_failed(task.task_id)
+                if swallow_failures:
                     continue
-                self.master.task_finished(task.task_id)
-        return _r
+                raise
+            client.task_finished(task.task_id)
+
+    return _r

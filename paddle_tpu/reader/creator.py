@@ -56,7 +56,15 @@ def _expand_paths(paths: Union[str, Sequence[str]]) -> List[str]:
     files: List[str] = []
     for p in paths:
         hit = sorted(glob.glob(p))
-        files.extend(hit if hit else [p])
+        if hit:
+            files.extend(hit)
+        elif glob.has_magic(p):
+            # a zero-match PATTERN is a setup error: failing fast beats
+            # seeding the literal pattern as a chunk (which would burn
+            # the failure budget downstream and silently drop data)
+            raise FileNotFoundError(f"no files match pattern {p!r}")
+        else:
+            files.append(p)      # literal path: open() reports precisely
     return files
 
 
@@ -96,24 +104,13 @@ def cloud_reader(paths: Union[str, Sequence[str]], master_address: str,
     files = _expand_paths(paths)
 
     def reader():
+        from ..distributed.master import task_loop_reader
+
         client = MasterClient(master_address, timeout_s=timeout_s)
         try:
             if files:
                 client.set_dataset_if_empty(files)
-            while True:
-                task = client.get_task()
-                if task is None:
-                    return
-                try:
-                    for chunk in task.chunks:
-                        yield from _read_part(chunk)
-                except GeneratorExit:
-                    client.task_returned(task.task_id)
-                    raise
-                except BaseException:
-                    client.task_failed(task.task_id)
-                    raise
-                client.task_finished(task.task_id)
+            yield from task_loop_reader(client, _read_part)()
         finally:
             client.close()
 

@@ -16,7 +16,7 @@ import tempfile
 import numpy as np
 
 __all__ = ["dump_config", "make_model_diagram", "merge_model",
-           "load_merged_model", "plotcurve"]
+           "load_merged_model", "plotcurve", "load_torch_state_dict"]
 
 
 def dump_config(config_path, config_args=None, as_json=True):
@@ -125,3 +125,56 @@ def plotcurve(log_lines, key="cost", output_path=None):
         fig.savefig(output_path)
         plt.close(fig)
     return np.asarray(ids), np.asarray(vals)
+
+
+def load_torch_state_dict(state_dict, name_map, scope=None,
+                          transpose_linear=True):
+    """Import torch weights into scope parameters (the
+    utils/torch2paddle.py role — that script converted torch-serialized
+    models into v1 parameter files; here the unit of exchange is the
+    modern ``state_dict``).
+
+    ``name_map``: {torch_key: paddle_param_name} or
+    {torch_key: (paddle_param_name, transpose_bool)} for explicit
+    control.  Without an explicit flag, a 2-D tensor transposes when its
+    shape only matches the target transposed (torch nn.Linear stores
+    [out, in]; fc expects [in, out]); a SQUARE 2-D tensor is ambiguous
+    and requires the explicit form (silently guessing would import
+    numerically wrong weights).  Shapes are validated; dtypes cast to
+    the existing parameter's.  Returns the imported parameter names.
+    """
+    from .core.scope import global_scope
+
+    scope = global_scope() if scope is None else scope
+    done = []
+    for tkey, spec in name_map.items():
+        if tkey not in state_dict:
+            raise KeyError(f"torch state_dict has no key {tkey!r}")
+        pname, transpose = (spec if isinstance(spec, (tuple, list))
+                            else (spec, None))
+        t = state_dict[tkey]
+        arr = np.asarray(t.detach().cpu().numpy()
+                         if hasattr(t, "detach") else t)
+        cur = np.asarray(scope.get(pname))
+        if transpose:
+            arr = arr.T
+        elif transpose is None and arr.ndim == 2 \
+                and arr.shape[0] == arr.shape[1] \
+                and arr.shape == cur.shape and transpose_linear:
+            raise ValueError(
+                f"{tkey!r} -> {pname!r}: square 2-D weight "
+                f"{arr.shape} is transpose-ambiguous; map it as "
+                f"({pname!r}, True) for a torch Linear weight or "
+                f"({pname!r}, False) to import as-is")
+        if arr.shape != cur.shape:
+            if (transpose is None and transpose_linear and arr.ndim == 2
+                    and arr.T.shape == cur.shape):
+                arr = arr.T
+            else:
+                raise ValueError(
+                    f"{tkey!r} -> {pname!r}: shape {arr.shape} does not "
+                    f"match parameter {cur.shape}"
+                    + (" (even transposed)" if arr.ndim == 2 else ""))
+        scope.set(pname, arr.astype(cur.dtype))
+        done.append(pname)
+    return done

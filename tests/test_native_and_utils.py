@@ -184,7 +184,6 @@ def test_cloud_reader_exactly_once_and_failover(tmp_path):
     consumed exactly once, and a reader that dies mid-task requeues its
     chunk for the survivor (the reference's etcd+Go-master cloud_reader
     semantics, creator.py:91)."""
-    import time
 
     from paddle_tpu import reader
     from paddle_tpu.dataset import common
@@ -201,10 +200,11 @@ def test_cloud_reader_exactly_once_and_failover(tmp_path):
         # r1 completes its first task (chunk [0,1,2])...
         first = [next(r1) for _ in range(3)]
         assert first == [0, 1, 2]
-        # ...pulls one record of its second task (chunk [3,4,5]), dies
+        # ...pulls one record of its second task (chunk [3,4,5]), dies.
+        # Generator finalization RETURNS the task synchronously
+        # (task_returned — no failure-budget burn, no timeout wait)
         assert next(r1) == 3
-        del r1
-        time.sleep(0.7)          # master requeues the abandoned task
+        r1.close()
         got2 = sorted(r2)
         # survivor saw everything except r1's FINISHED chunk — including
         # the re-served abandoned one; nothing lost, no double-serve of
@@ -212,3 +212,61 @@ def test_cloud_reader_exactly_once_and_failover(tmp_path):
         assert got2 == list(range(3, 12))
     finally:
         srv.stop()
+
+
+def test_load_torch_state_dict_matches_torch_forward(rng):
+    """torch2paddle's role (utils/torch2paddle.py): a torch MLP's weights
+    import into the equivalent paddle_tpu network and the forward outputs
+    match torch exactly (linear weights auto-transposed)."""
+    torch = pytest.importorskip("torch")
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    tnet = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 4))
+    x = layers.data("x", shape=[8], dtype="float32")
+    h = layers.fc(x, size=16, act="relu",
+                  param_attr=pt.ParamAttr(name="w1"), bias_attr="b1")
+    out = layers.fc(h, size=4, param_attr=pt.ParamAttr(name="w2"),
+                    bias_attr="b2")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+
+    imported = pt.utils.load_torch_state_dict(
+        tnet.state_dict(),
+        {"0.weight": "w1", "0.bias": "b1",
+         "2.weight": "w2", "2.bias": "b2"})
+    assert sorted(imported) == ["b1", "b2", "w1", "w2"]
+
+    xv = rng.randn(5, 8).astype("float32")
+    (got,) = exe.run(feed={"x": xv}, fetch_list=[out], is_test=True)
+    with torch.no_grad():
+        want = tnet(torch.from_numpy(xv)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+    # shape mismatches fail loudly
+    with pytest.raises(ValueError, match="shape"):
+        pt.utils.load_torch_state_dict(tnet.state_dict(),
+                                       {"0.weight": "w2"})
+
+    # square linear weights are transpose-ambiguous: refused without an
+    # explicit flag, exact with one
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    sq = torch.nn.Linear(8, 8)
+    x2 = layers.data("x2", shape=[8], dtype="float32")
+    out2 = layers.fc(x2, size=8, param_attr=pt.ParamAttr(name="wsq"),
+                     bias_attr="bsq")
+    exe2 = pt.Executor()
+    exe2.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    with pytest.raises(ValueError, match="ambiguous"):
+        pt.utils.load_torch_state_dict(sq.state_dict(),
+                                       {"weight": "wsq"})
+    pt.utils.load_torch_state_dict(
+        sq.state_dict(), {"weight": ("wsq", True), "bias": "bsq"})
+    xv2 = rng.randn(3, 8).astype("float32")
+    (got2,) = exe2.run(feed={"x2": xv2}, fetch_list=[out2], is_test=True)
+    with torch.no_grad():
+        want2 = sq(torch.from_numpy(xv2)).numpy()
+    np.testing.assert_allclose(got2, want2, rtol=2e-5, atol=1e-5)
