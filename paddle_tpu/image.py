@@ -21,6 +21,7 @@ __all__ = [
     "load_image_bytes", "load_image", "resize_short", "to_chw",
     "center_crop", "random_crop", "left_right_flip", "simple_transform",
     "load_and_transform", "batch_images_from_tar",
+    "ImageClassificationDatasetCreater",
 ]
 
 
@@ -157,3 +158,103 @@ def batch_images_from_tar(data_file, dataset_name, img2label,
             meta.write(os.path.abspath(os.path.join(out_path, fn)) + "\n")
     os.replace(tmp, meta_file)
     return meta_file
+
+
+class ImageClassificationDatasetCreater:
+    """v1 image-dataset preparation (utils/preprocess_img.py
+    ImageClassificationDatasetCreater + preprocess_util.DatasetCreater):
+    turn a ``data_path/{train,test}/<label>/*.jpg`` directory tree into
+    the on-disk batch layout the v1 trainers consumed —
+    ``batches/{train,test}_batches/batch-%05d.pickle`` part files (each
+    one pickled list of (CHW float32 image, label_id) pairs, readable by
+    ``reader.creator.recordio``), ``train.list``/``test.list``,
+    ``labels.pkl`` and a ``batches.meta`` carrying the train-set mean
+    image for input centering.
+    """
+
+    def __init__(self, data_path, target_size, color=True,
+                 num_per_batch=1024, overwrite=False, seed=0):
+        self.data_path = data_path
+        self.target_size = target_size
+        self.color = color
+        self.num_per_batch = num_per_batch
+        self.overwrite = overwrite
+        self.seed = seed
+        self.batch_dir = os.path.join(data_path, "batches")
+
+    def _load(self, path):
+        im = load_image(path, is_color=self.color)
+        im = simple_transform(im, self.target_size, self.target_size,
+                              is_train=False, is_color=self.color)
+        # v1 convert_to_paddle_format: flattened CHW rows
+        return im.astype("float32").ravel()
+
+    _EXTS = ("jpg", "jpeg", "png", "bmp")
+
+    def _scan_split(self, split, label_ids):
+        root = os.path.join(self.data_path, split)
+        items = []
+        if not os.path.isdir(root):
+            return items
+        for label in sorted(os.listdir(root)):
+            d = os.path.join(root, label)
+            if not os.path.isdir(d):
+                continue
+            imgs = [fn for fn in sorted(os.listdir(d))
+                    if fn.rsplit(".", 1)[-1].lower() in self._EXTS]
+            if not imgs:
+                continue     # artifact dirs must not claim a label id
+            lid = label_ids.setdefault(label, len(label_ids))
+            items.extend((os.path.join(d, fn), lid) for fn in imgs)
+        return items
+
+    def create_batches(self):
+        """Build the batch layout; returns the batches directory.
+        ``batches.meta`` is written LAST and is the completion marker: a
+        partial tree from a crashed run (or overwrite=True) is cleared
+        and rebuilt instead of being served incomplete/stale."""
+        import pickle
+        import random
+        import shutil
+
+        meta_path = os.path.join(self.batch_dir, "batches.meta")
+        if os.path.exists(meta_path) and not self.overwrite:
+            return self.batch_dir
+        if os.path.isdir(self.batch_dir):
+            shutil.rmtree(self.batch_dir)     # stale parts must not linger
+        os.makedirs(self.batch_dir)
+        label_ids = {}
+        mean_acc, mean_n = None, 0
+        for split in ("train", "test"):
+            items = self._scan_split(split, label_ids)
+            if split == "train":
+                random.Random(self.seed).shuffle(items)
+            out_dir = os.path.join(self.batch_dir, f"{split}_batches")
+            os.makedirs(out_dir, exist_ok=True)
+            paths = []
+            for bi in range(0, len(items), self.num_per_batch):
+                batch = []
+                for path, lid in items[bi:bi + self.num_per_batch]:
+                    im = self._load(path)
+                    if split == "train":
+                        mean_acc = im if mean_acc is None else mean_acc + im
+                        mean_n += 1
+                    batch.append((im, lid))
+                p = os.path.abspath(os.path.join(
+                    out_dir,
+                    "batch-%05d.pickle" % (bi // self.num_per_batch)))
+                with open(p, "wb") as f:
+                    pickle.dump(batch, f)
+                paths.append(p)
+            with open(os.path.join(self.batch_dir, f"{split}.list"),
+                      "w") as f:
+                f.write("\n".join(paths) + ("\n" if paths else ""))
+        with open(os.path.join(self.batch_dir, "labels.pkl"), "wb") as f:
+            pickle.dump({v: k for k, v in label_ids.items()}, f)
+        meta = {"mean_image": (mean_acc / max(mean_n, 1))
+                if mean_acc is not None else None,
+                "image_size": self.target_size, "color": self.color,
+                "num_labels": len(label_ids)}
+        with open(meta_path, "wb") as f:
+            pickle.dump(meta, f)
+        return self.batch_dir

@@ -83,3 +83,43 @@ def test_plotcurve_parses_log(tmp_path):
         assert out.exists()
     except ImportError:
         pass
+
+
+def test_image_dataset_creater_end_to_end(tmp_path, rng):
+    """v1 preprocess_img role: a train/test label-directory tree becomes
+    batch part files + meta (mean image, labels); the parts feed
+    reader.creator.recordio into a training-ready pipeline."""
+    from PIL import Image
+
+    import paddle_tpu as pt
+    from paddle_tpu import reader
+    from paddle_tpu.image import ImageClassificationDatasetCreater
+
+    for split, n in (("train", 6), ("test", 2)):
+        for label in ("cat", "dog"):
+            d = tmp_path / split / label
+            d.mkdir(parents=True)
+            for i in range(n):
+                arr = (rng.rand(20, 24, 3) * 255).astype("uint8")
+                Image.fromarray(arr).save(d / f"im{i}.jpg")
+
+    c = ImageClassificationDatasetCreater(str(tmp_path), target_size=16,
+                                          num_per_batch=5)
+    out = c.create_batches()
+    import pickle
+    meta = pickle.load(open(os.path.join(out, "batches.meta"), "rb"))
+    assert meta["num_labels"] == 2 and meta["image_size"] == 16
+    assert meta["mean_image"].shape == (3 * 16 * 16,)
+    labels = pickle.load(open(os.path.join(out, "labels.pkl"), "rb"))
+    assert set(labels.values()) == {"cat", "dog"}
+
+    rows = list(reader.creator.recordio(
+        os.path.join(out, "train_batches", "batch-*.pickle"))())
+    assert len(rows) == 12
+    im, lid = rows[0]
+    assert im.shape == (3 * 16 * 16,) and lid in (0, 1)
+    test_rows = list(reader.creator.recordio(
+        os.path.join(out, "test_batches", "batch-*.pickle"))())
+    assert len(test_rows) == 4
+    # idempotent without overwrite
+    assert c.create_batches() == out
