@@ -173,7 +173,7 @@ def job_checkgrad(cfg, exe, feeds, args, eps=1e-4, rtol=1e-3):
     from paddle_tpu.backward import append_backward
     from paddle_tpu.core.program import grad_var_name, program_guard
 
-    if jax.config.jax_enable_x64:
+    if jax.config.jax_enable_x64 and jax.default_backend() == "cpu":
         exe = pt.Executor(compute_dtype="float64")
     else:                                  # pragma: no cover - fallback
         eps, rtol = 1e-3, 5e-2
