@@ -1,0 +1,210 @@
+"""Per-op A/B microbenchmark: XLA's conv emitter vs the hand-written
+Pallas 1x1-conv kernels (ops/pallas_conv.py) on ResNet-50's eligible
+1x1 shapes — the workload RESULTS.md round 5 identified as the binding
+constraint (1x1/gradient convs at ~51 TFLOP/s against a 57-115 TFLOP/s
+corrected-roofline ceiling).
+
+Per (shape, pass) row both implementations run the identical math:
+
+    fwd    out = conv1x1(x, w)
+    dgrad  dx  = d/dx sum(conv1x1(x, w) * g)     (isolated via jax.grad)
+    wgrad  dw  = d/dw sum(conv1x1(x, w) * g)     (the worst measured pass)
+    wgrad_fused  Pallas: wgrad + per-channel gout sum fused in the K
+                 stream; XLA: wgrad conv + the separate reduction XLA
+                 emits for the bias/BN-beta gradient
+
+Methodology: the pinned compiled-window scheme (RESULTS.md round 4) —
+each timed window is ONE dispatch of a lax.scan over ``--steps``
+iterations whose carry perturbs the weight by a data-dependent ~0 so no
+iteration hoists; median of ``--reps`` windows, spread reported.
+
+Run:    python benchmark/conv_kernel.py               (TPU, bf16)
+        python benchmark/conv_kernel.py --interpret   (CPU correctness
+                                                       pass, tiny shapes)
+Writes: benchmark/conv_kernel_results.json
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax                                   # noqa: E402
+import jax.numpy as jnp                      # noqa: E402
+from jax import lax                          # noqa: E402
+
+from paddle_tpu.ops.pallas_conv import pallas_matmul  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "conv_kernel_results.json")
+DN = ("NCHW", "OIHW", "NCHW")
+
+# ResNet-50 bs128: every 1x1 shape the routing gate accepts (the
+# 64-channel stage-1/2 blocks stay on XLA and are not measured)
+SHAPES = [
+    # (name, N, C, H, W, M, stride)
+    ("c512_m128_hw28", 128, 512, 28, 28, 128, 1),
+    ("c128_m512_hw28", 128, 128, 28, 28, 512, 1),
+    ("c1024_m256_hw14", 128, 1024, 14, 14, 256, 1),
+    ("c256_m1024_hw14", 128, 256, 14, 14, 1024, 1),
+    ("c2048_m512_hw7", 128, 2048, 7, 7, 512, 1),
+    ("c512_m2048_hw7", 128, 512, 7, 7, 2048, 1),
+    ("c1024_m2048_s2_hw14", 128, 1024, 14, 14, 2048, 2),
+]
+INTERPRET_SHAPES = [("tiny_c128_m256_hw16", 2, 128, 16, 16, 256, 1)]
+
+
+def _xla_conv(x, w, stride):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), [(0, 0), (0, 0)], dimension_numbers=DN)
+
+
+def _pallas_conv(x, w, stride, interpret):
+    from paddle_tpu.ops.pallas_conv import conv2d_1x1
+    return conv2d_1x1(x, w, (stride, stride), interpret=interpret)
+
+
+def _views(x, g, w, stride):
+    """The matmul views the Pallas per-pass rows operate on (relayout
+    included inside the timed fn, mirroring what XLA's conv does
+    internally)."""
+    xs = x[:, :, ::stride, ::stride] if stride != 1 else x
+    N, C, H, W = xs.shape
+    M = w.shape[0]
+    xm = jnp.transpose(xs.reshape(N, C, H * W), (0, 2, 1)).reshape(-1, C)
+    gm = jnp.transpose(g.reshape(N, M, H * W), (0, 2, 1)).reshape(-1, M)
+    return xm, gm, w.reshape(M, C)
+
+
+def make_step(impl, pas, stride, interpret):
+    """(x, w, g) -> scalar the scan carry chains on; one op per step."""
+    if impl == "xla":
+        if pas == "fwd":
+            def f(x, w, g):
+                return jnp.sum(_xla_conv(x, w, stride) * g)
+        elif pas == "dgrad":
+            def f(x, w, g):
+                dx = jax.grad(lambda x_: jnp.sum(
+                    _xla_conv(x_, w, stride) * g))(x)
+                return jnp.sum(dx * dx[..., :1, :1])
+        elif pas == "wgrad":
+            def f(x, w, g):
+                dw = jax.grad(lambda w_: jnp.sum(
+                    _xla_conv(x, w_, stride) * g))(w)
+                return jnp.sum(dw * dw[..., :1, :, :])
+        else:                                   # wgrad_fused A/B partner:
+            def f(x, w, g):                     # wgrad + separate bias sum
+                dw = jax.grad(lambda w_: jnp.sum(
+                    _xla_conv(x, w_, stride) * g))(w)
+                dsum = jnp.sum(g, axis=(0, 2, 3))
+                return jnp.sum(dw * dw[..., :1, :, :]) + jnp.sum(dsum)
+        return f
+
+    from paddle_tpu.ops.pallas_conv import _mm
+    if pas == "fwd":
+        def f(x, w, g):
+            return jnp.sum(_pallas_conv(x, w, stride, interpret) * g)
+    elif pas == "dgrad":
+        def f(x, w, g):
+            _, gm, wm = _views(x, g, w, stride)
+            dxm = pallas_matmul(gm, wm, False, False, 512, 512, 1024,
+                                interpret)
+            return jnp.sum(dxm * dxm[:1])
+    elif pas == "wgrad":
+        def f(x, w, g):
+            xm, gm, _ = _views(x, g, w, stride)
+            dw = _mm(gm, xm, True, False, 512, 512, 1024, interpret)
+            return jnp.sum(dw * dw[:1])
+    else:                                       # wgrad + fused dsum epilogue
+        def f(x, w, g):
+            xm, gm, _ = _views(x, g, w, stride)
+            dw, dsum = _mm(gm, xm, True, False, 512, 512, 1024, interpret,
+                           a_colsum=True)
+            return jnp.sum(dw * dw[:1]) + jnp.sum(dsum)
+    return f
+
+
+def run_row(name, N, C, H, W, M, stride, steps, reps, dtype, interpret):
+    OH, OW = (H - 1) // stride + 1, (W - 1) // stride + 1
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(N, C, H, W), dtype)
+    w = jnp.asarray(rng.randn(M, C, 1, 1) * 0.05, dtype)
+    g = jnp.asarray(rng.randn(N, M, OH, OW), dtype)
+    P = N * OH * OW
+    flops = 2.0 * P * C * M                       # per pass per step
+    row = {"shape": name, "P": P, "C": C, "M": M, "stride": stride,
+           "steps": steps, "passes": {}}
+    for pas in ("fwd", "dgrad", "wgrad", "wgrad_fused"):
+        times = {}
+        for impl in ("xla", "pallas"):
+            step = make_step(impl, pas, stride, interpret)
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def window(x, w, g, n):
+                def body(carry, _):
+                    xc, wc, gc = carry
+                    s = step(xc, wc, gc)
+                    # data-dependent ~0 perturbation on EVERY operand so
+                    # no pass's op is loop-invariant (dgrad reads only
+                    # (w, g), wgrad only (x, g) — perturbing w alone
+                    # would let XLA hoist those out of the scan)
+                    f = (1.0 - 1e-12 * s)
+                    return tuple(t * f.astype(t.dtype) for t in carry), s
+                _, ss = lax.scan(body, (x, w, g), None, length=n)
+                return ss[-1]
+
+            float(window(x, w, g, steps))          # compile + warm
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                float(window(x, w, g, steps))      # barrier
+                ts.append(time.perf_counter() - t0)
+            med = float(np.median(ts)) / steps
+            times[impl] = {
+                "ms": round(med * 1e3, 3),
+                "tflops": round(flops / med / 1e12, 1),
+                "spread_pct": round(100 * (max(ts) - min(ts))
+                                    / np.median(ts), 2)}
+        times["pallas_speedup"] = round(
+            times["xla"]["ms"] / times["pallas"]["ms"], 3)
+        row["passes"][pas] = times
+        print(json.dumps({"shape": name, "pass": pas, **times}),
+              flush=True)
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--interpret", action="store_true",
+                    help="CPU correctness pass on a tiny shape (timings "
+                         "meaningless; asserts nothing crashes end-to-end)")
+    args = ap.parse_args()
+    shapes = INTERPRET_SHAPES if args.interpret else SHAPES
+    steps = 2 if args.interpret else args.steps
+    reps = 1 if args.interpret else args.reps
+    dtype = jnp.dtype(args.dtype)
+    results = {"device": str(jax.devices()[0]), "dtype": str(dtype),
+               "steps": steps, "rows": []}
+    for spec in shapes:
+        results["rows"].append(
+            run_row(*spec, steps=steps, reps=reps, dtype=dtype,
+                    interpret=args.interpret))
+    if not args.interpret:
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+        print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -132,7 +132,7 @@ class LoweringContext:
     def __init__(self, program: Program, base_key, is_test: bool = False,
                  amp: bool = False, mesh=None,
                  pipeline_microbatches: Optional[int] = None,
-                 compute_dtype=None):
+                 compute_dtype=None, conv1x1_pallas=None):
         self.program = program
         self.base_key = base_key      # traced PRNG key folding in the step
         self.is_test = is_test
@@ -145,6 +145,9 @@ class LoweringContext:
         # sharding constraints (moe) or lower staged regions (pipeline)
         self.mesh = mesh
         self.pipeline_microbatches = pipeline_microbatches
+        # tri-state 1x1-conv Pallas routing (None = defer to the
+        # conv1x1_pallas flag); consulted by ops.nn_ops._conv2d
+        self.conv1x1_pallas = conv1x1_pallas
         self.op: Optional[Operator] = None
         self.env: Optional[Env] = None
         self._op_uid = 0
@@ -230,9 +233,19 @@ def run_op(op: Operator, env: Env, ctx: LoweringContext):
         # site, not just the jnp call inside the lowering
         shapes = {slot: [getattr(v, "shape", None) for v in vals]
                   for slot, vals in ins.items()}
-        e.add_note(
-            f"[paddle_tpu] while lowering op {op.type!r} "
-            f"(outputs {op.outputs}) with input shapes {shapes}")
+        note = (f"[paddle_tpu] while lowering op {op.type!r} "
+                f"(outputs {op.outputs}) with input shapes {shapes}")
+        if hasattr(e, "add_note"):        # PEP 678, python 3.11+
+            e.add_note(note)
+        else:                             # 3.10 shim: same __notes__ slot
+            try:
+                notes = getattr(e, "__notes__", None)
+                if notes is None:
+                    notes = e.__notes__ = []
+                notes.append(note)
+            except (AttributeError, TypeError):   # slotted exception:
+                e.args = (f"{e.args[0] if e.args else e}\n{note}",) \
+                    + e.args[1:]          # at least don't mask the error
         raise
     finally:
         ctx.op, ctx.env = prev_op, prev_env
@@ -372,7 +385,8 @@ class Executor:
                  check_nan_inf: bool = False, amp: bool = False,
                  auto_layout: bool = False,
                  compiler_options: Optional[Dict[str, object]] = None,
-                 compute_dtype: Optional[str] = None):
+                 compute_dtype: Optional[str] = None,
+                 conv1x1_pallas: Optional[bool] = None):
         self.place = place or TPUPlace()
         self.use_jit = use_jit
         self.check_nan_inf = check_nan_inf
@@ -392,6 +406,10 @@ class Executor:
         # limit_kib); the FLAGS-registry analog of the reference's gflags
         # runtime switches, but scoped to one executor
         self.compiler_options = dict(compiler_options or {})
+        # opt-in hand-written Pallas 1x1-conv kernels (ops/pallas_conv.py);
+        # None defers to the conv1x1_pallas flag, a per-op use_pallas attr
+        # (layers.conv2d(use_pallas=...)) overrides both
+        self.conv1x1_pallas = conv1x1_pallas
         self._cache: Dict = {}
         self._fmt_registry: Dict = {}  # state var name -> pinned Format
         self._step = 0
@@ -657,6 +675,7 @@ class Executor:
                            for op in program.global_block().ops)
 
         compute_dtype = self.compute_dtype
+        conv1x1_pallas_opt = self.conv1x1_pallas
 
         def fn(feed_arrays, state, step):
             base_key = jax.random.fold_in(
@@ -675,7 +694,8 @@ class Executor:
             ctx = LoweringContext(program, base_key, is_test=is_test,
                                   amp=amp, mesh=lowering_mesh,
                                   pipeline_microbatches=microbatches,
-                                  compute_dtype=compute_dtype)
+                                  compute_dtype=compute_dtype,
+                                  conv1x1_pallas=conv1x1_pallas_opt)
             interpret_block_with_backward(program.global_block(), env, ctx)
             fetches = [env.get(n) if env.has(n) else None for n in fetch_names]
             if check_nan:

@@ -274,18 +274,20 @@ class MasterClient:
         self._file = None
         self._lock = threading.Lock()
 
-    def _connect(self):
-        self._sock = socket.create_connection(self._addr,
-                                              timeout=self._timeout)
+    def _connect(self, timeout=None):
+        self._sock = socket.create_connection(
+            self._addr, timeout=self._timeout if timeout is None
+            else timeout)
         self._file = self._sock.makefile("rwb")
 
-    def _call(self, method, **params):
+    def _call(self, method, _retries=None, _timeout=None, **params):
+        retries = self._retries if _retries is None else _retries
         with self._lock:
             last = None
-            for _ in range(self._retries):
+            for _ in range(retries):
                 try:
                     if self._file is None:
-                        self._connect()
+                        self._connect(_timeout)
                     self._file.write((json.dumps(
                         {"method": method, "params": params}) +
                         "\n").encode())
@@ -300,7 +302,8 @@ class MasterClient:
                 except (OSError, ConnectionError, json.JSONDecodeError) as e:
                     last = e
                     self.close()
-                    time.sleep(self._retry_wait)
+                    if retries > 1:
+                        time.sleep(self._retry_wait)
             raise ConnectionError(
                 f"master at {self._addr} unreachable: {last}")
 
@@ -317,6 +320,34 @@ class MasterClient:
 
     def task_returned(self, task_id: int):
         return self._call("task_returned", task_id=task_id)
+
+    def task_returned_nowait(self, task_id: int):
+        """Single-attempt, <=2 s best-effort ``task_returned`` for
+        generator-close paths: the default retry loop (3 x 30 s connect
+        timeout) can stall a ``cloud_reader`` close ~90 s when the
+        master is dead, and the caller is about to discard the result
+        anyway — the task's lease times out and requeues regardless."""
+        sock, old = self._sock, None
+        if sock is not None:
+            try:                       # bound reads on a live socket too
+                old = sock.gettimeout()
+                sock.settimeout(2.0)
+            except OSError:
+                pass
+        try:
+            return self._call("task_returned", _retries=1, _timeout=2.0,
+                              task_id=task_id)
+        finally:
+            # restore the configured deadline on whatever socket is live
+            # afterwards — the original, or a 2 s-created reconnect —
+            # so later normal RPCs don't inherit the best-effort deadline
+            cur = self._sock
+            if cur is not None:
+                try:
+                    cur.settimeout(old if (cur is sock and old is not None)
+                                   else self._timeout)
+                except OSError:
+                    pass
 
     def set_dataset(self, chunks: List):
         return self._call("set_dataset", chunks=chunks)
@@ -379,9 +410,13 @@ def task_loop_reader(client, chunk_reader: Callable,
             except GeneratorExit:
                 # best-effort: finalization must not raise or stall hard
                 # if the master died (the task times out and requeues
-                # anyway, at the cost of one budget tick)
+                # anyway, at the cost of one budget tick).  Remote clients
+                # take the single-attempt <=2 s path — the default retry
+                # loop would hold the closing generator ~90 s.
+                ret = getattr(client, "task_returned_nowait",
+                              client.task_returned)
                 try:
-                    client.task_returned(task.task_id)
+                    ret(task.task_id)
                 except Exception:
                     pass
                 raise

@@ -74,3 +74,9 @@ define_flag("seq_bucket_multiple", 8,
 define_flag("init_model_path", "", "checkpoint dir to resume from "
             "(Flags.cpp:81)")
 define_flag("save_dir", "", "parameter save root (v1 --save_dir)")
+define_flag("conv1x1_pallas", False,
+            "route eligible 1x1 conv2d ops (groups=1, pad 0, dil 1, "
+            "128-divisible dims) to the hand-written Pallas dot kernels "
+            "(ops/pallas_conv.py) instead of XLA's conv emitter; "
+            "per-executor override: Executor(conv1x1_pallas=...), "
+            "per-layer override: layers.conv2d(use_pallas=...)")

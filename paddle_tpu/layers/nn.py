@@ -229,9 +229,14 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=1, param_attr=None, bias_attr=None, act=None,
-           use_cudnn=True, name=None):
+           use_cudnn=True, name=None, use_pallas=None):
     """fluid/layers/nn.py:562 (use_cudnn accepted+ignored: XLA owns conv
-    algorithm selection)."""
+    algorithm selection).
+
+    ``use_pallas``: tri-state per-layer override of the ``conv1x1_pallas``
+    routing (flags.py / Executor(conv1x1_pallas=...)): True forces the
+    hand-written Pallas dot kernel on eligible 1x1 shapes, False pins this
+    layer to XLA's emitter, None (default) defers to the executor/flag."""
     helper = LayerHelper("conv2d", param_attr=param_attr, bias_attr=bias_attr,
                          act=act, name=name)
     dtype = input.dtype
@@ -246,11 +251,14 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     ow = _conv_out(input.shape[3], fs[1], pd[1], st[1], dl[1])
     out = helper.create_variable_for_type_inference(
         dtype, (n, num_filters, oh, ow))
+    conv_attrs = {"strides": st, "paddings": pd, "dilations": dl,
+                  "groups": groups}
+    if use_pallas is not None:
+        conv_attrs["use_pallas"] = bool(use_pallas)
     helper.append_op(type="conv2d",
                      inputs={"Input": [input], "Filter": [w]},
                      outputs={"Output": [out]},
-                     attrs={"strides": st, "paddings": pd, "dilations": dl,
-                            "groups": groups})
+                     attrs=conv_attrs)
     if helper.kwargs.get("bias_attr") is not False:
         b = helper.create_parameter(
             ParamAttr._to_attr(bias_attr) or ParamAttr(),

@@ -44,6 +44,19 @@ def _conv2d_space_to_depth(x, w, pads):
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
 
 
+def _conv1x1_pallas_wanted(ctx, attrs) -> bool:
+    """Tri-state opt-in resolution for the hand-written 1x1 Pallas path:
+    per-op attr (layers.conv2d(use_pallas=...)) > per-executor setting
+    (Executor(conv1x1_pallas=...)) > process flag (conv1x1_pallas)."""
+    v = attrs.get("use_pallas")
+    if v is None:
+        v = getattr(ctx, "conv1x1_pallas", None)
+    if v is None:
+        from ..flags import get_flag
+        v = get_flag("conv1x1_pallas")
+    return bool(v)
+
+
 @register_op("conv2d", "depthwise_conv2d")
 def _conv2d(ctx, ins, attrs):
     """conv_op.cc / conv_cudnn_op: Input [N,C,H,W], Filter [M,C/g,kh,kw]."""
@@ -52,6 +65,18 @@ def _conv2d(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dil = _pair(attrs.get("dilations", [1, 1]))
     groups = int(attrs.get("groups", 1) or 1)
+    if _conv1x1_pallas_wanted(ctx, attrs):
+        from . import pallas_conv
+        interpret = bool(attrs.get("pallas_interpret", False))
+        # single-device only: GSPMD treats pallas_call as opaque, so under
+        # a >1-device mesh the routing would silently replicate the conv
+        single = ctx.mesh is None or getattr(ctx.mesh, "size", 1) == 1
+        if (single and pallas_conv._HAVE_PALLAS
+                and (interpret or jax.default_backend() == "tpu")
+                and pallas_conv.conv1x1_eligible(
+                    x.shape, w.shape, strides, pads, dil, groups)):
+            return {"Output": pallas_conv.conv2d_1x1(
+                x, w, strides, interpret=interpret)}
     if (strides == (2, 2) and dil == (1, 1) and groups == 1
             and x.shape[1] <= 4 and x.ndim == 4
             and (x.shape[2] + 2 * pads[0]) % 2 == 0

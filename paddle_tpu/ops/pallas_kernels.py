@@ -437,16 +437,35 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
 from ..core.registry import register_op  # noqa: E402
 
 
+_mesh_detect_warned = False
+
+
 def _in_manual_mesh_context() -> bool:
     """True when tracing inside a shard_map manual region (e.g. a
     pipeline stage body): entering another shard_map with a concrete mesh
     there is an error, so the sp routing must fall back to the
-    device-global kernel."""
+    device-global kernel.
+
+    Only the two JAX-API-drift failure shapes are swallowed (AxisType /
+    get_abstract_mesh moving between releases), and loudly, once: a
+    silent blanket except here would disable the nested-shard_map guard
+    without anyone noticing until a cryptic trace error deep in sp
+    routing."""
+    global _mesh_detect_warned
     try:
         from jax.sharding import AxisType
         am = jax.sharding.get_abstract_mesh()
         return any(t == AxisType.Manual for t in am.axis_types)
-    except Exception:
+    except (ImportError, AttributeError) as e:
+        if not _mesh_detect_warned:
+            _mesh_detect_warned = True
+            import warnings
+            warnings.warn(
+                f"paddle_tpu: manual-mesh detection failed "
+                f"({type(e).__name__}: {e}) — JAX API drift?  The "
+                f"nested-shard_map guard is disabled; flash_attention "
+                f"inside pipeline stage bodies may mis-route to ring "
+                f"attention.", RuntimeWarning, stacklevel=2)
         return False
 
 

@@ -109,3 +109,44 @@ def test_elastic_trainer_death_cross_process(tmp_path):
         assert sorted(out["chunks"]) == list(range(6))
     finally:
         srv.stop()
+
+
+def test_generator_close_prompt_when_master_dead():
+    """Closing a task-loop reader generator whose master has DIED must
+    return promptly: the GeneratorExit finalizer takes the single-attempt
+    <=2 s ``task_returned_nowait`` path instead of the full retry loop
+    (3 x 30 s connect timeout ~= 90 s stall)."""
+    from paddle_tpu.distributed.master import task_loop_reader
+
+    m = Master(chunks_per_task=1, timeout_s=30.0)
+    m.set_dataset([[1, 2], [3, 4], [5, 6]])
+    srv = _start(m)
+    c = MasterClient(srv.address)
+    gen = task_loop_reader(c, chunk_reader=lambda ch: iter(ch))()
+    assert next(gen) in (1, 3, 5)      # a task is now in flight
+    srv.stop()                         # master dies mid-task
+    t0 = time.time()
+    gen.close()                        # GeneratorExit -> best-effort return
+    elapsed = time.time() - t0
+    assert elapsed < 10.0, f"generator close stalled {elapsed:.1f}s"
+    c.close()
+
+
+def test_task_returned_nowait_succeeds_against_live_master():
+    """The fast path is not only for dead masters: against a live one it
+    really returns the task (re-queued immediately, no budget burn)."""
+    m = Master(chunks_per_task=1, timeout_s=30.0)
+    m.set_dataset([[1, 2]])
+    srv = _start(m)
+    try:
+        c = MasterClient(srv.address)
+        t = c.get_task()
+        assert t is not None
+        c.task_returned_nowait(t.task_id)
+        t2 = c.get_task()              # the returned task comes back
+        assert t2 is not None and t2.chunks == t.chunks
+        c.task_finished(t2.task_id)
+        assert c.stats()["done"] == 1
+        c.close()
+    finally:
+        srv.stop()
