@@ -74,15 +74,12 @@ def main():
     # bf16 compute + fp32 master weights.  auto_layout is unnecessary
     # under run_steps: inside one scan executable XLA keeps parameters in
     # compute layouts across iterations (measured equal, 2648 vs 2652).
-    # conv1x1_pallas routes the eligible 1x1 convs to the hand-written
-    # Pallas dot kernels — per-op A/B (benchmark/conv_kernel.py) projects
-    # the wgrad 1.30-1.45x over XLA's emitter on the deep-P shapes and
-    # the driver metric at ~2 744 img/s (was 2 652 measured); RESULTS.md
-    # round-6 provenance caveat applies — if the on-chip A/B falls below
-    # 1.2x, flip this back to None and record the negative result.  On
-    # non-TPU backends the routing is a static no-op (driver stays
-    # portable).
-    exe = pt.Executor(amp=True, conv1x1_pallas=True)
+    # conv1x1_pallas stays OFF here: the Pallas 1x1 kernels
+    # (ops/pallas_conv.py) are interpret-mode verified only — their
+    # Mosaic/TPU lowering has never executed on hardware.  Flip it on in
+    # the same commit as an on-chip per-op A/B (benchmark/conv_kernel.py)
+    # showing >=1.2x, together with the re-measured driver number.
+    exe = pt.Executor(amp=True)
     exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
 
     rng = np.random.RandomState(0)

@@ -137,6 +137,7 @@ def sweep(results):
     rows = []
     for T in (32768, 65536):
         steps = _steps_for(T)
+        qkv = _qkv(T)            # one host-RNG + device_put per T, not per row
         for kib in SWEEP_VMEM_KIB:
             opts = ({"xla_tpu_scoped_vmem_limit_kib": str(kib)}
                     if kib else None)
@@ -144,7 +145,6 @@ def sweep(results):
                 row = {"tokens": T, "block_q": bq, "block_k": bk,
                        "scoped_vmem_mb": (kib or 16 * 1024) // 1024}
                 try:
-                    qkv = _qkv(T)
                     run = make_step(T, bq, bk)
                     comp = jax.jit(run, static_argnames=("steps",)) \
                         .lower(qkv, steps).compile(compiler_options=opts)

@@ -280,32 +280,58 @@ class MasterClient:
             else timeout)
         self._file = self._sock.makefile("rwb")
 
-    def _call(self, method, _retries=None, _timeout=None, **params):
+    def _call(self, method, _retries=None, _timeout=None,
+              _sock_deadline=None, **params):
         retries = self._retries if _retries is None else _retries
         with self._lock:
-            last = None
-            for _ in range(retries):
-                try:
-                    if self._file is None:
-                        self._connect(_timeout)
-                    self._file.write((json.dumps(
-                        {"method": method, "params": params}) +
-                        "\n").encode())
-                    self._file.flush()
-                    line = self._file.readline()
-                    if not line:
-                        raise ConnectionError("master closed connection")
-                    resp = json.loads(line)
-                    if "error" in resp:
-                        raise RuntimeError(f"master: {resp['error']}")
-                    return resp["result"]
-                except (OSError, ConnectionError, json.JSONDecodeError) as e:
-                    last = e
-                    self.close()
-                    if retries > 1:
-                        time.sleep(self._retry_wait)
-            raise ConnectionError(
-                f"master at {self._addr} unreachable: {last}")
+            # The socket deadline is mutated (and restored) only while the
+            # lock is held, so a concurrent RPC can never observe the
+            # shortened timeout mid-read.
+            sock, old = self._sock, None
+            if _sock_deadline is not None and sock is not None:
+                try:               # bound reads on the live socket too
+                    old = sock.gettimeout()
+                    sock.settimeout(_sock_deadline)
+                except OSError:
+                    pass
+            try:
+                last = None
+                for _ in range(retries):
+                    try:
+                        if self._file is None:
+                            self._connect(_timeout)
+                        self._file.write((json.dumps(
+                            {"method": method, "params": params}) +
+                            "\n").encode())
+                        self._file.flush()
+                        line = self._file.readline()
+                        if not line:
+                            raise ConnectionError("master closed connection")
+                        resp = json.loads(line)
+                        if "error" in resp:
+                            raise RuntimeError(f"master: {resp['error']}")
+                        return resp["result"]
+                    except (OSError, ConnectionError,
+                            json.JSONDecodeError) as e:
+                        last = e
+                        self.close()
+                        if retries > 1:
+                            time.sleep(self._retry_wait)
+                raise ConnectionError(
+                    f"master at {self._addr} unreachable: {last}")
+            finally:
+                # restore the configured deadline on whatever socket is
+                # live afterwards — the original, or a short-deadline
+                # reconnect — so later RPCs don't inherit it
+                if _sock_deadline is not None:
+                    cur = self._sock
+                    if cur is not None:
+                        try:
+                            cur.settimeout(
+                                old if (cur is sock and old is not None)
+                                else self._timeout)
+                        except OSError:
+                            pass
 
     # -- Master duck-type --------------------------------------------------
     def get_task(self) -> Optional[Task]:
@@ -327,27 +353,8 @@ class MasterClient:
         timeout) can stall a ``cloud_reader`` close ~90 s when the
         master is dead, and the caller is about to discard the result
         anyway — the task's lease times out and requeues regardless."""
-        sock, old = self._sock, None
-        if sock is not None:
-            try:                       # bound reads on a live socket too
-                old = sock.gettimeout()
-                sock.settimeout(2.0)
-            except OSError:
-                pass
-        try:
-            return self._call("task_returned", _retries=1, _timeout=2.0,
-                              task_id=task_id)
-        finally:
-            # restore the configured deadline on whatever socket is live
-            # afterwards — the original, or a 2 s-created reconnect —
-            # so later normal RPCs don't inherit the best-effort deadline
-            cur = self._sock
-            if cur is not None:
-                try:
-                    cur.settimeout(old if (cur is sock and old is not None)
-                                   else self._timeout)
-                except OSError:
-                    pass
+        return self._call("task_returned", _retries=1, _timeout=2.0,
+                          _sock_deadline=2.0, task_id=task_id)
 
     def set_dataset(self, chunks: List):
         return self._call("set_dataset", chunks=chunks)

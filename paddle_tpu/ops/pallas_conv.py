@@ -51,6 +51,11 @@ try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     _HAVE_PALLAS = True
+    # renamed TPUCompilerParams -> CompilerParams across jax versions
+    # (this container's jax 0.4.37 has only the old name); resolve at
+    # import so the drift fails loudly here, not at first on-TPU trace
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
@@ -166,8 +171,14 @@ def _mm(a, b, ta, tb, block_m, block_n, block_k, interpret,
 
     kwargs = {}
     if not interpret:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"))
+        # The a_colsum epilogue writes csum_ref (mapped to block (0, i)
+        # for EVERY j) only under pl.when(j == 0): if Mosaic partitioned a
+        # "parallel" j across megacore, a core whose j-range excludes 0
+        # would copy its uninitialized VMEM output block over the result.
+        # Keep j sequential whenever the epilogue is on.
+        nsem = "arbitrary" if a_colsum else "parallel"
+        kwargs["compiler_params"] = _CompilerParams(
+            dimension_semantics=("parallel", nsem, "arbitrary"))
     res = pl.pallas_call(
         functools.partial(_mm_kernel, nk=nk, ta=ta, tb=tb,
                           out_stats=out_stats, a_colsum=a_colsum),

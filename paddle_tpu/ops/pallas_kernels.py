@@ -27,6 +27,11 @@ try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     _HAVE_PALLAS = True
+    # renamed TPUCompilerParams -> CompilerParams across jax versions;
+    # interpret-mode tests never touch it, so resolve at import to fail
+    # loudly here rather than at first on-TPU trace
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
@@ -109,7 +114,7 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     grid = (BH, Tq // block_q, nk)
     kwargs = {}
     if not interpret:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
+        kwargs["compiler_params"] = _CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
     return pl.pallas_call(
         functools.partial(_flash_kernel, block_k=block_k, num_k_blocks=nk,
@@ -256,7 +261,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
         delta = delta - g_lse.astype(jnp.float32).reshape(delta.shape)
     kwargs = {}
     if not interpret:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
+        kwargs["compiler_params"] = _CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
 
     dq = pl.pallas_call(

@@ -135,10 +135,14 @@ def _bn_conv_program(use_pallas):
     return loss
 
 
-def test_executor_routing_end_to_end(rng):
+def test_executor_routing_end_to_end(rng, monkeypatch):
     """Same program trained 3 steps through XLA's conv emitter and through
     the Pallas route (interpret mode): losses must track, proving the
-    opt-in switch routes the forward AND the autodiff gradients."""
+    opt-in switch routes the forward AND the autodiff gradients.  A
+    counting wrapper on ``conv2d_1x1`` proves the route was actually
+    taken — nn_ops has four silent fall-through gates, and without the
+    probe a routing regression would make this test pass vacuously
+    (both runs on XLA, trivially equal losses)."""
     feeds = {"img": rng.rand(4, 128, 8, 8).astype("float32") * 0.1,
              "label": rng.randint(0, 10, (4, 1))}
 
@@ -157,10 +161,19 @@ def test_executor_routing_end_to_end(rng):
     for op in prog.global_block().ops:
         if op.type == "conv2d":
             op.attrs["pallas_interpret"] = True   # CPU test: interpret mode
+
+    from paddle_tpu.ops import pallas_conv
+    calls = []
+    real = pallas_conv.conv2d_1x1
+    monkeypatch.setattr(
+        pallas_conv, "conv2d_1x1",
+        lambda *a, **kw: (calls.append(1), real(*a, **kw))[1])
     exe = pt.Executor(conv1x1_pallas=True)
     exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
     pallas = [float(exe.run(prog, feed=feeds, fetch_list=[loss])[0])
               for _ in range(3)]
+    assert calls, "conv2d never routed to the Pallas kernel (silent " \
+                  "fall-through in nn_ops._conv2d)"
     np.testing.assert_allclose(base, pallas, rtol=2e-4, atol=2e-5)
 
 
