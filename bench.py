@@ -99,6 +99,12 @@ def main():
     except Exception:
         pass                       # headline metric still reports
 
+    pipe_row = None
+    try:
+        pipe_row = _input_pipeline_speedup()
+    except Exception:
+        pass                       # headline metric still reports
+
     line = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_s, 2),
@@ -106,15 +112,41 @@ def main():
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
         "window_spread": round(spread, 4),
     }
+    extra = []
     if tok_s is not None:
-        line["extra_metrics"] = [{
+        extra.append({
             "metric": "seq2seq_attn_train_tokens_per_sec_per_chip",
             "value": round(tok_s, 1),
             "unit": "tokens/s",
             "vs_baseline": None,   # reference unpublished (BASELINE.md)
             "window_spread": round(tok_spread, 4),
-        }]
+        })
+    if pipe_row is not None:
+        extra.append({
+            "metric": "input_pipeline_wide_deep_train_steps_per_sec",
+            "value": pipe_row["pipelined_steps_per_s"],
+            "unit": "steps/s",
+            # vs the naive synchronous Trainer.train loop, same run
+            "vs_baseline": pipe_row["speedup"],
+            "window_spread": pipe_row["pipelined_spread"],
+        })
+    if extra:
+        line["extra_metrics"] = extra
     print(json.dumps(line))
+
+
+def _input_pipeline_speedup():
+    """End-to-end input-pipeline A/B on the wide_deep CTR ingestion
+    workload (benchmark/input_pipeline.py): naive synchronous
+    Trainer.train loop vs the pipelined run_pipelined path, median of
+    paired alternating windows measured in THIS run."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmark.input_pipeline import WORKLOADS, run_workload
+
+    WORKLOADS["wide_deep"]["full"]["reps"] = 4   # keep the driver fast
+    return run_workload("wide_deep", quiet=True)  # ONE JSON line contract
 
 
 def _seq2seq_tokens_per_sec(batch=64):

@@ -178,9 +178,20 @@ HEADLINE = [("alexnet", 128), ("googlenet", 128), ("smallnet", 64),
             ("lstm_h512", 64), ("lstm_h512", 128), ("seq2seq", 64)]
 
 
+def run_input_pipeline(smoke=False):
+    """Delegate to benchmark/input_pipeline.py (naive vs pipelined
+    Trainer.train A/B); one JSON line per workload, same as run_config."""
+    from benchmark.input_pipeline import WORKLOADS, run_workload
+    return [run_workload(w, smoke=smoke) for w in sorted(WORKLOADS)]
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default=None)
+    ap.add_argument("--model", default=None,
+                    help="model config, or 'input_pipeline' for the "
+                         "naive-vs-pipelined input A/B")
+    ap.add_argument("--smoke", action="store_true",
+                    help="input_pipeline only: seconds-fast path check")
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--iters", type=int, default=None,
                     help="steps per timed window (default: 60 for the "
@@ -196,6 +207,9 @@ def main():
                     action="store_false")
     ap.add_argument("--all", action="store_true")
     args = ap.parse_args()
+    if args.model == "input_pipeline":
+        run_input_pipeline(smoke=args.smoke)
+        return
     if args.all:
         for name, batch in HEADLINE:
             try:

@@ -26,8 +26,10 @@ Public API intentionally mirrors the reference's fluid Python surface
 ``Program``, ``default_main_program`` ...
 """
 
+from . import compat
 from . import core
 from .core import (
+    stack_feeds,
     Program,
     Block,
     Operator,
@@ -91,4 +93,5 @@ __all__ = [
     "load_persistables", "save_inference_model", "load_inference_model",
     "DataFeeder", "ParamAttr", "profiler", "parallel", "distributed",
     "reader", "dataset", "trainer", "models", "infer", "image", "utils",
+    "compat", "stack_feeds",
 ]

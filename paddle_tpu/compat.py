@@ -1,0 +1,104 @@
+"""JAX cross-version compatibility shims.
+
+The public JAX surface this framework leans on moved between the 0.4.x
+line and newer releases:
+
+* ``jax.shard_map`` (new, with ``axis_names=``/``check_vma=`` partial-manual
+  kwargs) vs ``jax.experimental.shard_map.shard_map`` (old, with
+  ``auto=``/``check_rep=`` spelled from the opposite direction);
+* ``jax.lax.axis_size`` (new) vs the ``lax.psum(1, axis)`` constant-folding
+  idiom (old);
+* ``jax.lax.pvary`` (new varying-manual-axes type system) with no old
+  counterpart — on old JAX replication is inferred, so it is the identity;
+* ``jax.sharding.AxisType`` + ``get_abstract_mesh`` (new) vs the axis-env
+  trace state (old) for detecting a surrounding shard_map manual region.
+
+Everything that needs one of these APIs imports it from here, so exactly
+one module knows which JAX it is running on.  Resolution happens at call
+time (not import time): the shims stay importable even if a future JAX
+moves the surface again, failing only at the call site with a clear error.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+__all__ = ["shard_map", "axis_size", "pvary", "manual_axes"]
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None, check_vma=None):
+    """``jax.shard_map`` surface on every supported JAX.
+
+    ``axis_names``: the mesh axes the body is manual over (new-API
+    spelling); every other mesh axis stays auto/GSPMD-managed.  On old JAX
+    this maps to ``auto = mesh.axis_names - axis_names``.  ``check_vma``
+    maps to old ``check_rep`` (same role: verify replication/varying
+    claims; both sides accept False to opt out).
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = frozenset(axis_names)
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as esm  # old JAX
+    # No ``auto=``: old partial-auto lowers lax.axis_index to a PartitionId
+    # instruction the SPMD partitioner rejects ("meaning is ambiguous").
+    # Going full-manual instead is always numerically correct — axes the
+    # body never names are simply replicated through it (in_specs leaving
+    # them unmentioned), at the cost of redundant compute over those axes
+    # on multi-device meshes.  Only the old-JAX fallback pays this.
+    kw = {}
+    if check_vma is not None:
+        kw["check_rep"] = bool(check_vma)
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def axis_size(axis_name) -> int:
+    """Size of a bound mesh axis inside shard_map/pmap.
+
+    Old JAX: ``lax.psum`` of a non-tracer constant folds to the axis size
+    without emitting a collective — the pre-``lax.axis_size`` idiom.
+    Raises ``NameError`` for an unbound axis name on both paths.
+    """
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pvary(x, axis_names):
+    """Mark ``x`` device-varying over ``axis_names`` (new shard_map type
+    system).  Old JAX infers replication and has no varying-manual-axes
+    types, so there the identity is exactly right — autodiff inside a
+    shard_map body never inserts the psum-of-replicated-cotangents the
+    new system needs ``pvary`` to elide."""
+    fn = getattr(lax, "pvary", None)
+    if fn is not None:
+        return fn(x, axis_names)
+    return x
+
+
+def manual_axes() -> Optional[frozenset]:
+    """Mesh axes currently bound manual (i.e. we are tracing inside a
+    shard_map body): frozenset of names, empty when outside.  Returns
+    ``None`` when no known JAX API can answer — callers should treat that
+    as "unknown" and degrade loudly, not assume "outside"."""
+    try:  # new JAX: abstract mesh carries per-axis Manual/Auto types
+        from jax.sharding import AxisType
+        am = jax.sharding.get_abstract_mesh()
+        return frozenset(n for n, t in zip(am.axis_names, am.axis_types)
+                         if t == AxisType.Manual)
+    except (ImportError, AttributeError):
+        pass
+    try:  # old JAX: shard_map binds its axes in the trace-state axis env
+        from jax._src import core as _core
+        env = _core.get_axis_env()
+        return frozenset(env.axis_sizes)
+    except (ImportError, AttributeError):
+        pass
+    return None

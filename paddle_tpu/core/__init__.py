@@ -12,7 +12,7 @@ from .registry import register_op, get_op_impl, has_op, registered_ops
 from .scope import Scope, global_scope, scope_guard, reset_global_scope
 from .executor import (
     Executor, Place, CPUPlace, TPUPlace, CUDAPlace,
-    Env, LoweringContext, interpret_ops, run_op,
+    Env, LoweringContext, interpret_ops, run_op, stack_feeds,
 )
 
 __all__ = [
@@ -24,5 +24,5 @@ __all__ = [
     "register_op", "get_op_impl", "has_op", "registered_ops",
     "Scope", "global_scope", "scope_guard", "reset_global_scope",
     "Executor", "Place", "CPUPlace", "TPUPlace", "CUDAPlace",
-    "Env", "LoweringContext", "interpret_ops", "run_op",
+    "Env", "LoweringContext", "interpret_ops", "run_op", "stack_feeds",
 ]

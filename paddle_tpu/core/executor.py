@@ -372,6 +372,37 @@ def _to_bf16(v):
 
 
 # ---------------------------------------------------------------------------
+# Feed staging helpers (host side of the input pipeline)
+# ---------------------------------------------------------------------------
+def stack_feeds(feeds: Sequence[Dict[str, object]]) -> Dict[str, np.ndarray]:
+    """Stack K same-signature host feed dicts along a new leading axis —
+    the form ``run_steps(feeds_stacked=True)`` accepts, turning K host
+    batches into ONE device-side scan dispatch.
+
+    Every dict must carry the same keys with same-shaped values; the
+    result's entries have shape ``[K, ...]``.  ``np.stack`` copies, so
+    feeds built in reusable staging buffers (``DataFeeder(staging_slots=
+    ...)``) are safe to reuse once stacked.
+    """
+    if not feeds:
+        raise ValueError("stack_feeds: need at least one feed dict")
+    keys = feeds[0].keys()
+    for f in feeds[1:]:
+        if f.keys() != keys:
+            raise ValueError(
+                f"stack_feeds: feed keys differ: {sorted(keys)} vs "
+                f"{sorted(f.keys())}")
+    return {k: np.stack([np.asarray(f[k]) for f in feeds]) for k in keys}
+
+
+def _feed_signature(feed: Dict[str, object]):
+    return tuple(sorted(
+        (k, tuple(np.shape(v)),
+         str(getattr(v, "dtype", None) or np.asarray(v).dtype))
+        for k, v in feed.items()))
+
+
+# ---------------------------------------------------------------------------
 # Executor
 # ---------------------------------------------------------------------------
 class Executor:
@@ -585,6 +616,100 @@ class Executor:
             fetches = [np.asarray(f) if f is not None else None
                        for f in fetches]
         return fetches
+
+    def run_pipelined(self, feed_iter,
+                      program: Optional[Program] = None,
+                      fetch_list: Optional[Sequence] = None,
+                      scope: Optional[Scope] = None,
+                      steps_per_dispatch: int = 4,
+                      prefetch_depth: int = 2,
+                      return_numpy: bool = True,
+                      is_test: bool = False):
+        """Pipelined driver: generator over per-step fetch lists for a
+        stream of host feed dicts, with host batch assembly and
+        ``jax.device_put`` staging overlapped with device compute.
+
+        ``feed_iter`` yields host feed dicts (e.g. ``DataFeeder.feed``
+        output).  A staging worker thread groups consecutive
+        same-signature feeds into runs of ``steps_per_dispatch``, stacks
+        each run along a new leading axis (:func:`stack_feeds`) and ships
+        it to the device; up to ``prefetch_depth`` staged dispatches wait
+        in a bounded queue while the device executes the current one
+        (JAX's async dispatch returns control to this generator before
+        the step finishes, so the worker fills the queue during compute).
+        Full runs dispatch as ONE compiled K-step scan
+        (``run_steps(feeds_stacked=True)`` — the chunked-dispatch data
+        path); leftovers (tail of the stream, or a padding-bucket
+        signature change) dispatch per step through :meth:`run`, which
+        bounds compilation to two variants per feed signature.
+
+        Step math is identical to calling :meth:`run` once per feed in
+        order — same step-counter threading, same PRNG key derivation,
+        same donated-state updates — so fetches are bit-identical to the
+        sequential loop (tests/test_input_pipeline.py asserts this).
+
+        The stream's lifecycle follows :mod:`paddle_tpu.reader.pipeline`
+        rules: an exception in ``feed_iter`` re-raises here, and
+        abandoning this generator early stops and joins the staging
+        worker.
+        """
+        from ..reader.pipeline import prefetch as _prefetch
+        if self.check_nan_inf:
+            raise ValueError(
+                "run_pipelined: check_nan_inf needs per-step host "
+                "inspection; use run() for NaN hunts")
+        from .program import default_main_program
+        program = program or default_main_program()
+        K = int(steps_per_dispatch)
+        if K < 1:
+            raise ValueError(
+                f"run_pipelined: steps_per_dispatch must be >= 1, got {K}")
+
+        def staged():
+            """Chunks of the feed stream, already device-resident."""
+            def ship_scan(pend):
+                dev = {k: jax.device_put(v)
+                       for k, v in stack_feeds(pend).items()}
+                return ("scan", dev, len(pend))
+
+            def ship_singles(pend):
+                for feed in pend:
+                    yield ("single",
+                           {k: v if isinstance(v, jax.Array)
+                            else jax.device_put(np.asarray(v))
+                            for k, v in feed.items()}, 1)
+
+            pend, sig = [], None
+            for feed in feed_iter:
+                fsig = _feed_signature(feed)
+                if pend and fsig != sig:
+                    yield from ship_singles(pend)
+                    pend = []
+                sig = fsig
+                pend.append(feed)
+                if len(pend) == K:
+                    if K > 1:
+                        yield ship_scan(pend)
+                    else:      # K=1: plain overlap, no scan stacking
+                        yield from ship_singles(pend)
+                    pend = []
+            yield from ship_singles(pend)
+
+        staged_reader = _prefetch(staged,
+                                  buffer_size=max(1, int(prefetch_depth)),
+                                  num_workers=1)
+        for kind, dev, n in staged_reader():
+            if kind == "scan":
+                outs = self.run_steps(
+                    n, program, feed=dev, fetch_list=fetch_list,
+                    scope=scope, return_numpy=return_numpy,
+                    is_test=is_test, feeds_stacked=True)
+                for i in range(n):
+                    yield [o[i] if o is not None else None for o in outs]
+            else:
+                yield self.run(program, feed=dev, fetch_list=fetch_list,
+                               scope=scope, return_numpy=return_numpy,
+                               is_test=is_test)
 
     def _build_steps(self, program: Program, multi, feeds_stacked: bool):
         """jit wrapper for the K-step scan fn (ShardedExecutor overrides

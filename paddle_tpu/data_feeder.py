@@ -5,6 +5,14 @@ Sequence inputs (``lod_level > 0``) arrive as per-row Python lists of
 variable length; they are padded to the batch max (optionally rounded up to a
 bucket multiple so XLA recompiles rarely) and a ``name@LEN`` int32 vector is
 emitted — the TPU-native replacement for LoD offsets.
+
+Padding runs through a vectorized fast path: per-row Python assignment
+loops are replaced by one boolean-mask scatter over the whole batch
+(``arr[mask] = concat(rows)``), and with ``staging_slots > 0`` the output
+arrays come from a reusable staging-buffer pool keyed on (name, shape,
+dtype) so steady-state feeding allocates nothing.  The original per-row
+implementations are kept as ``*_reference`` for the byte-identity tests
+(tests/test_data_feeder_padding.py).
 """
 from __future__ import annotations
 
@@ -21,12 +29,53 @@ def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+class _StagingCache:
+    """Pool of reusable host staging buffers keyed on (name, shape, dtype).
+
+    ``slots`` buffers rotate per key, so up to ``slots`` feed results for
+    the same variable may be alive at once (a pipelined trainer keeps the
+    current batch staging to device while the next one is being padded).
+    Consumers must copy or ship a buffer before ``slots`` further feeds of
+    the same variable."""
+
+    def __init__(self, slots: int):
+        self.slots = max(1, int(slots))
+        self._pool: Dict[tuple, dict] = {}
+
+    def get(self, name: str, shape, dtype) -> np.ndarray:
+        k = (name, tuple(shape), np.dtype(dtype).str)
+        entry = self._pool.setdefault(k, {"bufs": [], "next": 0})
+        bufs: List[np.ndarray] = entry["bufs"]
+        if len(bufs) < self.slots:
+            buf = np.empty(shape, dtype)
+            bufs.append(buf)
+            return buf
+        i = entry["next"]
+        entry["next"] = (i + 1) % self.slots
+        return bufs[i]
+
+
 class DataFeeder:
     def __init__(self, feed_list: Sequence[Variable], place=None,
-                 program=None, seq_bucket_multiple: int = 8):
+                 program=None, seq_bucket_multiple: int = 8,
+                 staging_slots: int = 0):
         self.feed_list = list(feed_list)
         self.place = place
         self.seq_bucket_multiple = seq_bucket_multiple
+        # staging_slots > 0 turns on buffer reuse: feed() output arrays are
+        # only valid until `staging_slots` further feed() calls (ship or
+        # copy them first — np.stack / jax.device_put both do)
+        self._staging = _StagingCache(staging_slots) if staging_slots > 0 \
+            else None
+
+    def _out_buffer(self, name: str, shape, dtype,
+                    zero: bool = False) -> np.ndarray:
+        if self._staging is None:
+            return np.zeros(shape, dtype) if zero else np.empty(shape, dtype)
+        buf = self._staging.get(name, shape, dtype)
+        if zero:
+            buf.fill(0)
+        return buf
 
     def feed(self, minibatch: Sequence[Sequence]) -> Dict[str, np.ndarray]:
         """minibatch: list of rows, each row a tuple matching feed_list."""
@@ -36,12 +85,19 @@ class DataFeeder:
             f"feed rows have {len(cols)} fields, expected {len(self.feed_list)}"
         for var, col in zip(self.feed_list, cols):
             if var.lod_level == 0:
-                arr = np.asarray(col)
+                dt = np.dtype(var.dtype)
+                if self._staging is not None and \
+                        isinstance(col[0], np.ndarray) and col[0].dtype == dt:
+                    arr = self._staging.get(var.name,
+                                            (len(col),) + col[0].shape, dt)
+                    np.stack(col, out=arr)
+                else:
+                    arr = np.asarray(col)
                 want = var.shape
                 if want is not None and len(want) == arr.ndim + 1 and \
                         want[-1] == 1:
                     arr = arr[..., None]       # label [B] -> [B,1]
-                out[var.name] = arr.astype(var.dtype)
+                out[var.name] = arr.astype(dt, copy=False)
             elif var.lod_level == 1:
                 arr, lens = self._pad_rows(col, var)
                 if var.shape is not None and len(var.shape) == arr.ndim + 1 \
@@ -60,9 +116,106 @@ class DataFeeder:
                     "capability (max LoD depth 2)")
         return out
 
+    # -- lod 1 ---------------------------------------------------------------
+    def _pad_rows(self, col, var):
+        """Pad variable-length rows; C++ fast path (native feeder_module,
+        the PyDataProvider2 analog) first, then the vectorized numpy path."""
+        dt = np.dtype(var.dtype)
+        if dt in (np.dtype("int64"), np.dtype("float32")):
+            from .native import get_native
+            native = get_native()
+            if native is not None:
+                try:
+                    return native.pad_batch(list(col),
+                                            self.seq_bucket_multiple,
+                                            dt.name)
+                except ValueError:
+                    # bad input (inconsistent row dims etc.) — surface the
+                    # native path's diagnostic rather than letting the numpy
+                    # fallback fail with an unrelated broadcast error
+                    raise
+                except Exception:
+                    pass
+        return self._pad_rows_vectorized(col, var)
+
+    def _pad_rows_vectorized(self, col, var):
+        """One mask scatter instead of B row assignments: rows concatenate
+        to [sum_lens, ...] and land in the padded [B, T, ...] buffer through
+        ``arr[mask]`` where mask[b, t] = t < len(row b)."""
+        dt = np.dtype(var.dtype)
+        rows = [np.asarray(r, dtype=dt) for r in col]
+        lens = np.fromiter((r.shape[0] for r in rows), np.int32, len(rows))
+        T = _round_up(int(lens.max()) if len(lens) else 1,
+                      self.seq_bucket_multiple)
+        feat_shape = rows[0].shape[1:] if rows and rows[0].ndim > 1 else ()
+        arr = self._out_buffer(var.name, (len(rows), T) + feat_shape, dt,
+                               zero=True)
+        if rows:
+            mask = np.arange(T, dtype=np.int32)[None, :] < lens[:, None]
+            arr[mask] = np.concatenate(rows, axis=0) if len(rows) > 1 \
+                else rows[0]
+        return arr, lens
+
+    def _pad_rows_reference(self, col, var):
+        """Original per-row loop, kept as the oracle for the byte-identity
+        tests of the vectorized path."""
+        lens = np.asarray([len(r) for r in col], np.int32)
+        T = _round_up(int(lens.max()) if len(lens) else 1,
+                      self.seq_bucket_multiple)
+        first = np.asarray(col[0])
+        feat_shape = first.shape[1:] if first.ndim > 1 else ()
+        arr = np.zeros((len(col), T) + feat_shape, dtype=var.dtype)
+        for i, row in enumerate(col):
+            arr[i, :len(row)] = np.asarray(row, dtype=var.dtype)
+        return arr, lens
+
+    # -- lod 2 ---------------------------------------------------------------
     def _pad_nested(self, col, var):
         """Nested rows (list of subsequences of tokens/vectors) ->
-        [B, S, T, ...] + @LEN [B] + @LEN2 [B, S] (LoD level-2 padding)."""
+        [B, S, T, ...] + @LEN [B] + @LEN2 [B, S] (LoD level-2 padding).
+
+        Vectorized like :meth:`_pad_rows_vectorized`: the subsequences pad
+        into [N, T, ...] with one mask scatter (N = total subsequences),
+        then one fancy-index assignment scatters them to their (b, s)
+        slots."""
+        dt = np.dtype(var.dtype)
+        B = len(col)
+        lens = np.fromiter((len(r) for r in col), np.int32, B)
+        S = int(lens.max()) if B else 1
+        subs = [np.asarray(sub, dtype=dt) for row in col for sub in row]
+        sub_lens = np.fromiter((s.shape[0] for s in subs), np.int32,
+                               len(subs))
+        T = int(sub_lens.max()) if len(sub_lens) else 1
+        if len(lens) and (lens == 0).any():
+            # reference rule: a row with NO subsequences counts as length 1
+            T = max(T, 1)
+        T = _round_up(T, self.seq_bucket_multiple)
+        feat_shape = ()
+        for s in subs:
+            if s.shape[0]:
+                feat_shape = s.shape[1:]
+                break
+        arr = self._out_buffer(var.name, (B, S, T) + feat_shape, dt,
+                               zero=True)
+        lens2 = self._out_buffer(var.name + "@LEN2", (B, S), np.int32,
+                                 zero=True)
+        if subs:
+            b_idx = np.repeat(np.arange(B, dtype=np.int32), lens)
+            s_idx = np.concatenate(
+                [np.arange(n, dtype=np.int32) for n in lens]) \
+                if len(lens) else np.zeros(0, np.int32)
+            lens2[b_idx, s_idx] = sub_lens
+            padded = np.zeros((len(subs), T) + feat_shape, dt)
+            mask = np.arange(T, dtype=np.int32)[None, :] < sub_lens[:, None]
+            nonempty = [s for s in subs if s.shape[0]]
+            if nonempty:
+                padded[mask] = np.concatenate(nonempty, axis=0) \
+                    if len(nonempty) > 1 else nonempty[0]
+            arr[b_idx, s_idx] = padded
+        return arr, lens, lens2
+
+    def _pad_nested_reference(self, col, var):
+        """Original per-(row, subsequence) loop — oracle for the tests."""
         B = len(col)
         lens = np.asarray([len(r) for r in col], np.int32)
         S = _round_up(int(lens.max()) if B else 1, 1)
@@ -86,32 +239,3 @@ class DataFeeder:
                 if len(sub):
                     arr[b, s, :len(sub)] = np.asarray(sub, dtype=var.dtype)
         return arr, lens, lens2
-
-    def _pad_rows(self, col, var):
-        """Pad variable-length rows; C++ fast path (native feeder_module,
-        the PyDataProvider2 analog) with a numpy fallback."""
-        dt = np.dtype(var.dtype)
-        if dt in (np.dtype("int64"), np.dtype("float32")):
-            from .native import get_native
-            native = get_native()
-            if native is not None:
-                try:
-                    return native.pad_batch(list(col),
-                                            self.seq_bucket_multiple,
-                                            dt.name)
-                except ValueError:
-                    # bad input (inconsistent row dims etc.) — surface the
-                    # native path's diagnostic rather than letting the numpy
-                    # fallback fail with an unrelated broadcast error
-                    raise
-                except Exception:
-                    pass
-        lens = np.asarray([len(r) for r in col], np.int32)
-        T = _round_up(int(lens.max()) if len(lens) else 1,
-                      self.seq_bucket_multiple)
-        first = np.asarray(col[0])
-        feat_shape = first.shape[1:] if first.ndim > 1 else ()
-        arr = np.zeros((len(col), T) + feat_shape, dtype=var.dtype)
-        for i, row in enumerate(col):
-            arr[i, :len(row)] = np.asarray(row, dtype=var.dtype)
-        return arr, lens

@@ -451,27 +451,27 @@ def _in_manual_mesh_context() -> bool:
     there is an error, so the sp routing must fall back to the
     device-global kernel.
 
-    Only the two JAX-API-drift failure shapes are swallowed (AxisType /
-    get_abstract_mesh moving between releases), and loudly, once: a
+    Detection is version-shimmed in :mod:`paddle_tpu.compat`
+    (AxisType/get_abstract_mesh on new JAX, the trace-state axis env on
+    old).  Only the nothing-worked case degrades, and loudly, once: a
     silent blanket except here would disable the nested-shard_map guard
     without anyone noticing until a cryptic trace error deep in sp
     routing."""
     global _mesh_detect_warned
-    try:
-        from jax.sharding import AxisType
-        am = jax.sharding.get_abstract_mesh()
-        return any(t == AxisType.Manual for t in am.axis_types)
-    except (ImportError, AttributeError) as e:
-        if not _mesh_detect_warned:
-            _mesh_detect_warned = True
-            import warnings
-            warnings.warn(
-                f"paddle_tpu: manual-mesh detection failed "
-                f"({type(e).__name__}: {e}) — JAX API drift?  The "
-                f"nested-shard_map guard is disabled; flash_attention "
-                f"inside pipeline stage bodies may mis-route to ring "
-                f"attention.", RuntimeWarning, stacklevel=2)
-        return False
+    from ..compat import manual_axes
+    axes = manual_axes()
+    if axes is not None:
+        return bool(axes)
+    if not _mesh_detect_warned:
+        _mesh_detect_warned = True
+        import warnings
+        warnings.warn(
+            "paddle_tpu: manual-mesh detection failed on this JAX "
+            "(compat.manual_axes knows no working API) — JAX API "
+            "drift?  The nested-shard_map guard is disabled; "
+            "flash_attention inside pipeline stage bodies may "
+            "mis-route to ring attention.", RuntimeWarning, stacklevel=2)
+    return False
 
 
 @register_op("flash_attention")

@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import compat
+
 
 def _in_spmd(axis_name) -> bool:
     try:
@@ -69,7 +71,7 @@ def broadcast(x, axis_name="dp", src=0):
         idx = lax.axis_index(axis_name)
     except NameError:
         return x
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     return lax.ppermute(x, axis_name, [(src, i) for i in range(n)])
 
 

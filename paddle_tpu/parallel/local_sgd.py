@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import compat
+
 __all__ = ["make_local_sgd_step"]
 
 
@@ -40,7 +42,7 @@ def make_local_sgd_step(loss_fn, mesh, sync_every: int, learning_rate: float,
     [B, ...] and are split B/n per replica on dim 0.  Each call consumes
     ``sync_every`` microbatches sliced from the leading batch dim.
     """
-    from jax.experimental.shard_map import shard_map
+    from ..compat import shard_map
 
     grad_fn = jax.value_and_grad(loss_fn)
 
@@ -50,7 +52,8 @@ def make_local_sgd_step(loss_fn, mesh, sync_every: int, learning_rate: float,
         # inside the body yields each replica's LOCAL gradient (the new
         # shard_map autodiff would otherwise psum cotangents of replicated
         # values on every step — the exact collective local SGD elides)
-        params = jax.tree.map(lambda p: lax.pvary(p, (axis_name,)), params)
+        params = jax.tree.map(
+            lambda p: compat.pvary(p, (axis_name,)), params)
         xs = x.reshape((K, x.shape[0] // K) + x.shape[1:])
         ys = y.reshape((K, y.shape[0] // K) + y.shape[1:])
 

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import compat
+
 __all__ = ["moe_dispatch", "moe_ffn", "load_balancing_loss"]
 
 
@@ -27,7 +29,7 @@ def _axis_size(axis_name):
     if axis_name is None:
         return 1
     try:
-        return lax.axis_size(axis_name)
+        return compat.axis_size(axis_name)
     except NameError:
         return 1
 

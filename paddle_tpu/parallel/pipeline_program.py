@@ -50,6 +50,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import compat
+
 __all__ = ["lower_pipeline_region"]
 
 
@@ -227,7 +229,7 @@ def lower_pipeline_region(ops: Sequence, env, ctx) -> None:
         return outs.reshape((B,) + tuple(y_aval.shape[1:]))
 
     caps_specs = jax.tree.map(lambda _: P(), caps_tuple)
-    y = jax.shard_map(
+    y = compat.shard_map(
         region_fn, mesh=mesh, in_specs=(caps_specs, P()), out_specs=P(),
         axis_names=frozenset({"pp"}), check_vma=False)(caps_tuple, x_val)
     env.set(out_name, y)

@@ -19,6 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import compat
+
 
 def _block_attn(q, k, v, bias=None):
     """Stable block attention returning (out_unnorm, m, l)."""
@@ -68,7 +70,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale, block_q,
                           block_k, interpret):
     from ..ops.pallas_kernels import flash_attention_with_lse
 
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, T, H, D = q.shape
     scale = scale if scale is not None else D ** -0.5
@@ -119,7 +121,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale, block_q,
 
 
 def _ring_attention_jnp(q, k, v, axis_name, causal, scale):
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
@@ -184,7 +186,7 @@ def ring_attention_sharded(q, k, v, mesh, causal=False, axis_name: str = "sp",
                              causal=causal, scale=scale, block_q=block_q,
                              block_k=block_k, use_flash=use_flash,
                              interpret=interpret)
-    return jax.shard_map(
+    return compat.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names=frozenset({axis_name}), check_vma=False)(q, k, v)
 

@@ -10,9 +10,11 @@ PyDataProvider2.cpp:195) is provided by ``buffered`` / ``xmap_readers`` over
 """
 from . import creator
 from . import decorator
+from . import pipeline
 from .decorator import (batch, buffered, cache, chain, compose, firstn,
                         map_readers, native_buffered, shuffle, xmap_readers)
+from .pipeline import interleave, prefetch
 
 __all__ = ["batch", "buffered", "cache", "chain", "compose", "firstn",
-           "map_readers", "native_buffered", "shuffle", "xmap_readers",
-           "decorator"]
+           "interleave", "map_readers", "native_buffered", "prefetch",
+           "shuffle", "xmap_readers", "decorator", "pipeline"]

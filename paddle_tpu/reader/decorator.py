@@ -13,7 +13,12 @@ def native_buffered(reader, size=4):
     PyDataProvider2 async-pool analog, PyDataProvider2.cpp:511).  The worker
     thread pulls from the Python reader under the GIL and parks results in a
     C++ bounded queue; falls back to the Python ``buffered`` when the native
-    toolchain is unavailable."""
+    toolchain is unavailable.
+
+    Lifecycle: a reader exception ends the batch stream and re-raises in
+    the consumer (the native callback must not raise into C++); abandoning
+    the generator early closes the batcher in the ``finally``, which stops
+    and joins its worker."""
     from ..native import get_native
     native = get_native()
     if native is None:
@@ -21,17 +26,23 @@ def native_buffered(reader, size=4):
 
     def new_reader():
         it = iter(reader())
+        err = []
 
         def next_item():
             try:
                 return (next(it),)      # wrap: None payloads stay distinct
             except StopIteration:
                 return None
+            except BaseException as e:  # don't raise across the C++ rim:
+                err.append(e)           # surface it from the consumer side
+                return None
         b = native.AsyncBatcher(next_item, capacity=size)
         try:
             while True:
                 item = b.next_batch()
                 if item is None:
+                    if err:
+                        raise err[0]
                     return
                 yield item[0]
         finally:
@@ -84,27 +95,17 @@ def compose(*readers, check_alignment=True):
 
 
 def buffered(reader, size):
-    """Async prefetch through a bounded queue on a daemon thread
-    (the PyDataProvider2 double-buffer pool role)."""
-    end = object()
+    """Async prefetch through a bounded queue on a worker thread
+    (the PyDataProvider2 double-buffer pool role).
 
-    def read_worker(r, q):
-        try:
-            for d in r:
-                q.put(d)
-        finally:
-            q.put(end)
-
-    def data_reader():
-        r = reader()
-        q = _queue.Queue(maxsize=size)
-        t = threading.Thread(target=read_worker, args=(r, q), daemon=True)
-        t.start()
-        e = q.get()
-        while e is not end:
-            yield e
-            e = q.get()
-    return data_reader
+    Now a thin wrapper over :mod:`paddle_tpu.reader.pipeline`'s engine,
+    which fixes this decorator's historical lifecycle bugs: a worker
+    exception re-raises in the consumer (it used to truncate the stream
+    silently), abandoning the generator early stops the worker instead of
+    leaving it blocked on a full queue forever, and teardown joins the
+    thread.  Order-preserving (single worker)."""
+    from .pipeline import prefetch
+    return prefetch(reader, buffer_size=size, num_workers=1)
 
 
 def batch(reader, batch_size, drop_last=True):

@@ -69,7 +69,7 @@ class SGD:
     def train(self, reader: Callable, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
               feeding=None, feed_list: Optional[Sequence[Variable]] = None,
-              steps_per_dispatch: int = 1):
+              steps_per_dispatch: int = 1, pipeline=False):
         """reader yields batches (lists of rows); feeding maps data-layer
         names to row positions (v2 trainer.py feeding) or pass feed_list.
 
@@ -79,9 +79,22 @@ class SGD:
         loop.  Iteration events still fire per batch (after the dispatch
         that contained them); differently-shaped batches (bucketed
         padding) fall back to per-batch dispatch automatically.
+
+        ``pipeline`` turns on the asynchronous input pipeline
+        (``Executor.run_pipelined``): batch decode, padding and
+        ``device_put`` staging move onto worker threads overlapped with
+        device compute, and same-shape runs dispatch as compiled K-step
+        scans.  Pass ``True`` for defaults or a dict with any of
+        ``steps_per_dispatch`` (default 8, or the ``steps_per_dispatch``
+        argument when > 1), ``num_workers`` (reader prefetch workers,
+        default 1; 0 folds decode into the staging thread, right when
+        host cores are scarce; more than 1 reorders batches), ``buffer_size``
+        (decoded-batch queue bound, default 4) and ``prefetch_depth``
+        (staged dispatches in flight, default 2).  Step math is identical
+        to the per-batch loop; only event timing changes (events for a
+        dispatch fire after it completes).
         """
         event_handler = event_handler or (lambda e: None)
-        feeder = self._feeder(feeding, feed_list)
         if not self._initialized:
             self.exe.run(default_startup_program(), feed={}, fetch_list=[])
             self._initialized = True
@@ -93,6 +106,37 @@ class SGD:
             event_handler(events.EndIteration(
                 pass_id, batch_id, float(out[0]), metrics))
 
+        if pipeline:
+            opts = dict(pipeline) if isinstance(pipeline, dict) else {}
+            K = int(opts.get("steps_per_dispatch",
+                             steps_per_dispatch if steps_per_dispatch > 1
+                             else 8))
+            workers = int(opts.get("num_workers", 1))
+            buf = int(opts.get("buffer_size", 4))
+            depth = int(opts.get("prefetch_depth", 2))
+            # feed() results live at most until their chunk is stacked /
+            # shipped — K pending plus in-flight slack bounds liveness
+            feeder = self._feeder(feeding, feed_list, staging_slots=K + 2)
+            from .reader.pipeline import prefetch
+            for pass_id in range(num_passes):
+                event_handler(events.BeginPass(pass_id))
+                # num_workers=0: no reader prefetch stage — decode runs in
+                # run_pipelined's staging thread (one host thread total;
+                # right when host cores are scarce)
+                src = prefetch(reader, buffer_size=buf,
+                               num_workers=workers) if workers > 0 \
+                    else reader
+                feed_iter = (feeder.feed(b) for b in src())
+                for batch_id, out in enumerate(self.exe.run_pipelined(
+                        feed_iter, self.main_program, fetch_list=fetch,
+                        steps_per_dispatch=K, prefetch_depth=depth)):
+                    event_handler(events.BeginIteration(pass_id, batch_id))
+                    emit_end(pass_id, batch_id, out)
+                event_handler(events.EndPass(pass_id))
+            return
+
+        feeder = self._feeder(feeding, feed_list)
+
         def flush(pass_id, first_id, chunk):
             if len(chunk) == 1:
                 event_handler(events.BeginIteration(pass_id, first_id))
@@ -100,8 +144,8 @@ class SGD:
                                    fetch_list=fetch)
                 emit_end(pass_id, first_id, out)
                 return
-            stacked = {k: np.stack([f[k] for f in chunk])
-                       for k in chunk[0]}
+            from .core.executor import stack_feeds
+            stacked = stack_feeds(chunk)
             outs = self.exe.run_steps(
                 len(chunk), self.main_program, feed=stacked,
                 fetch_list=fetch, feeds_stacked=True)
@@ -158,7 +202,7 @@ class SGD:
         return [t / count for t in totals]
 
     # -- helpers -----------------------------------------------------------
-    def _feeder(self, feeding, feed_list):
+    def _feeder(self, feeding, feed_list, staging_slots: int = 0):
         if feed_list is None:
             gb = self.main_program.global_block()
             data_vars = [v for v in gb.vars.values() if v.is_data]
@@ -167,7 +211,7 @@ class SGD:
                 feed_list = [gb.var(n) for n in order]
             else:
                 feed_list = data_vars
-        return DataFeeder(feed_list)
+        return DataFeeder(feed_list, staging_slots=staging_slots)
 
     def save_parameter_to_tar(self, f=None, dirname=None):
         from . import io

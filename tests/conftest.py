@@ -32,6 +32,36 @@ def fresh_state():
     yield
 
 
+@pytest.fixture(autouse=True)
+def no_leaked_pipeline_threads():
+    """Fail any test that leaks a live input-pipeline worker thread.
+
+    The reader/executor pipeline engine guarantees its workers die with
+    their consumer (paddle_tpu/reader/pipeline.py); this enforces the
+    guarantee for every test, with a short grace period for the workers'
+    stop-event poll to fire after generator close/GC."""
+    yield
+    import gc
+    import threading
+    import time
+
+    from paddle_tpu.reader.pipeline import THREAD_NAME_PREFIX
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t.name.startswith(THREAD_NAME_PREFIX) and t.is_alive()]
+
+    if leaked():
+        gc.collect()           # close abandoned pipeline generators
+        deadline = time.monotonic() + 2.0
+        while leaked() and time.monotonic() < deadline:
+            time.sleep(0.05)
+    threads = leaked()
+    assert not threads, (
+        f"test leaked live input-pipeline worker threads: "
+        f"{[t.name for t in threads]}")
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)

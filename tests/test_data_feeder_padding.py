@@ -1,0 +1,171 @@
+"""DataFeeder padding: the vectorized fast path must be byte-identical to
+the original per-row reference implementation on randomized inputs, across
+lod 0/1/2, the [B] -> [B,1] label reshape, and seq_bucket_multiple
+rounding; plus staging-buffer reuse semantics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.data_feeder import DataFeeder, _round_up
+
+
+def _var(name, dtype, lod_level=0, shape=None):
+    return layers.data(name, shape=shape if shape is not None else [1],
+                       dtype=dtype, lod_level=lod_level)
+
+
+def _assert_same(a, b):
+    assert a.dtype == b.dtype, (a.dtype, b.dtype)
+    assert a.shape == b.shape, (a.shape, b.shape)
+    assert (np.asarray(a) == np.asarray(b)).all()
+
+
+# ---------------------------------------------------------------------------
+# lod 1
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("dtype", ["int64", "float32", "int32", "float64"])
+@pytest.mark.parametrize("mult", [1, 4, 8])
+def test_pad_rows_vectorized_matches_reference_randomized(rng, dtype, mult):
+    v = _var("w", dtype, lod_level=1)
+    fd = DataFeeder([v], seq_bucket_multiple=mult)
+    for trial in range(30):
+        B = rng.randint(1, 10)
+        col = [list(rng.randint(0, 100, rng.randint(1, 13)).astype(dtype))
+               for _ in range(B)]
+        a_vec, l_vec = fd._pad_rows_vectorized(col, v)
+        a_ref, l_ref = fd._pad_rows_reference(col, v)
+        _assert_same(a_vec, a_ref)
+        _assert_same(l_vec, l_ref)
+        assert a_vec.shape[1] % mult == 0   # bucket rounding
+
+
+def test_pad_rows_vector_features_match_reference(rng):
+    v = _var("f", "float32", lod_level=1)
+    fd = DataFeeder([v], seq_bucket_multiple=8)
+    for _ in range(20):
+        B = rng.randint(1, 8)
+        col = [[list(rng.rand(4)) for _ in range(rng.randint(1, 7))]
+               for _ in range(B)]
+        a_vec, l_vec = fd._pad_rows_vectorized(col, v)
+        a_ref, l_ref = fd._pad_rows_reference(col, v)
+        _assert_same(a_vec, a_ref)
+        _assert_same(l_vec, l_ref)
+
+
+def test_pad_rows_zero_length_row():
+    v = _var("w", "int64", lod_level=1)
+    fd = DataFeeder([v], seq_bucket_multiple=4)
+    col = [[1, 2, 3], [], [7]]
+    a_vec, l_vec = fd._pad_rows_vectorized(col, v)
+    a_ref, l_ref = fd._pad_rows_reference(col, v)
+    _assert_same(a_vec, a_ref)
+    assert list(l_vec) == [3, 0, 1]
+
+
+def test_native_path_agrees_with_vectorized(rng):
+    from paddle_tpu.native import get_native
+    if get_native() is None:
+        pytest.skip("native toolchain unavailable")
+    v = _var("w", "int64", lod_level=1)
+    fd = DataFeeder([v], seq_bucket_multiple=8)
+    col = [list(rng.randint(0, 1000, rng.randint(1, 40)))
+           for _ in range(16)]
+    a_nat, l_nat = fd._pad_rows(col, v)          # native first
+    a_vec, l_vec = fd._pad_rows_vectorized(col, v)
+    _assert_same(np.asarray(a_nat), a_vec)
+    _assert_same(np.asarray(l_nat, np.int32), l_vec)
+
+
+# ---------------------------------------------------------------------------
+# lod 2
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mult", [1, 8])
+def test_pad_nested_matches_reference_randomized(rng, mult):
+    v = _var("n", "int64", lod_level=2)
+    fd = DataFeeder([v], seq_bucket_multiple=mult)
+    for _ in range(30):
+        B = rng.randint(1, 7)
+        col = [[list(rng.randint(0, 50, rng.randint(0, 6)))
+                for _ in range(rng.randint(1, 5))] for _ in range(B)]
+        a, l1, l2 = fd._pad_nested(col, v)
+        ra, rl1, rl2 = fd._pad_nested_reference(col, v)
+        _assert_same(a, ra)
+        _assert_same(l1, rl1)
+        _assert_same(l2, rl2)
+
+
+def test_pad_nested_empty_row_matches_reference():
+    # a row with NO subsequences counts as length 1 (reference rule) —
+    # the vectorized path must not collapse T to 0
+    v = _var("n", "int64", lod_level=2)
+    fd = DataFeeder([v], seq_bucket_multiple=8)
+    col = [[], [[]]]
+    a, l1, l2 = fd._pad_nested(col, v)
+    ra, rl1, rl2 = fd._pad_nested_reference(col, v)
+    _assert_same(a, ra)
+    _assert_same(l1, rl1)
+    _assert_same(l2, rl2)
+    assert a.shape == (2, 1, 8)
+
+
+def test_feed_lod2_emits_len_companions(rng):
+    v = _var("n", "int64", lod_level=2)
+    fd = DataFeeder([v], seq_bucket_multiple=4)
+    col = [[[1, 2], [3]], [[4, 5, 6]]]
+    out = fd.feed([(row,) for row in col])
+    assert set(out) == {"n", "n@LEN", "n@LEN2"}
+    assert out["n"].shape == (2, 2, 4)
+    assert list(out["n@LEN"]) == [2, 1]
+    assert out["n@LEN2"].tolist() == [[2, 1], [3, 0]]
+
+
+# ---------------------------------------------------------------------------
+# lod 0 + label reshape
+# ---------------------------------------------------------------------------
+def test_feed_label_reshape_and_dtype(rng):
+    x = _var("x", "float32", shape=[5])
+    y = _var("y", "int64", shape=[1])
+    fd = DataFeeder([x, y])
+    rows = [(rng.rand(5).astype("float32"), int(i % 3)) for i in range(6)]
+    out = fd.feed(rows)
+    assert out["x"].shape == (6, 5) and out["x"].dtype == np.float32
+    assert out["y"].shape == (6, 1) and out["y"].dtype == np.int64
+    assert list(out["y"][:, 0]) == [0, 1, 2, 0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# staging-buffer cache
+# ---------------------------------------------------------------------------
+def test_staging_buffers_rotate_and_stay_correct(rng):
+    x = _var("x", "float32", shape=[4])
+    fd = DataFeeder([x], staging_slots=2)
+    rows = [[(rng.rand(4).astype("float32"),) for _ in range(3)]
+            for _ in range(4)]
+    outs = [fd.feed(r)["x"] for r in rows]
+    # slots=2: call 3 reuses call 1's buffer, call 4 reuses call 2's
+    assert outs[2] is outs[0] or outs[2].base is (outs[0].base or outs[0])
+    expected3 = np.stack([r[0] for r in rows[3]])
+    assert np.array_equal(outs[3], expected3)
+    # the two live slots hold the two most recent feeds
+    expected2 = np.stack([r[0] for r in rows[2]])
+    assert np.array_equal(outs[2], expected2)
+
+
+def test_staging_padded_buffers_are_rezeroed(rng):
+    # float64: numpy path, no native; shape=[] avoids the [...,1] reshape
+    v = _var("w", "float64", lod_level=1, shape=[])
+    fd = DataFeeder([v], seq_bucket_multiple=8, staging_slots=1)
+    long_row = [(list(rng.rand(8)),)]
+    short_row = [([0.5],)]
+    fd.feed(long_row)
+    out = fd.feed(short_row)["w"]             # same buffer, reused
+    assert out.shape == (1, 8)
+    assert out[0, 0] == 0.5 and (out[0, 1:] == 0).all()
+
+
+def test_round_up():
+    assert _round_up(5, 8) == 8
+    assert _round_up(8, 8) == 8
+    assert _round_up(0, 8) == 0
+    assert _round_up(7, 1) == 7

@@ -1,0 +1,272 @@
+"""Asynchronous input pipeline: reader engine lifecycle, run_pipelined
+parity with the sequential executor, and the Trainer pipeline= path."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.executor import stack_feeds
+from paddle_tpu.reader import buffered, interleave, native_buffered, prefetch
+from paddle_tpu.reader.pipeline import THREAD_NAME_PREFIX
+
+
+# ---------------------------------------------------------------------------
+# reader.pipeline engine
+# ---------------------------------------------------------------------------
+def _range_reader(n):
+    return lambda: iter(range(n))
+
+
+def test_prefetch_single_worker_preserves_order():
+    assert list(prefetch(_range_reader(200), buffer_size=4)()) == \
+        list(range(200))
+
+
+def test_prefetch_multi_worker_yields_every_item():
+    out = list(prefetch(_range_reader(500), buffer_size=8, num_workers=4)())
+    assert sorted(out) == list(range(500))
+
+
+def test_prefetch_mapper_runs_in_parallel_workers():
+    seen_threads = set()
+
+    def mapper(x):
+        seen_threads.add(threading.current_thread().name)
+        return x * 3
+
+    out = list(prefetch(_range_reader(300), buffer_size=8, num_workers=3,
+                        mapper=mapper)())
+    assert sorted(out) == [3 * i for i in range(300)]
+    assert all(n.startswith(THREAD_NAME_PREFIX) for n in seen_threads)
+
+
+def test_prefetch_propagates_reader_exception():
+    def bad():
+        yield from range(5)
+        raise RuntimeError("decode failed")
+
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(prefetch(lambda: bad(), buffer_size=2, num_workers=2)())
+
+
+def test_prefetch_early_abandon_stops_workers():
+    g = prefetch(_range_reader(10 ** 9), buffer_size=4, num_workers=3)()
+    assert next(g) is not None
+    g.close()        # conftest's leak fixture asserts the workers died
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline and any(
+            t.name.startswith(THREAD_NAME_PREFIX)
+            for t in threading.enumerate()):
+        time.sleep(0.02)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith(THREAD_NAME_PREFIX)]
+
+
+def test_prefetch_rejects_bad_worker_count():
+    with pytest.raises(ValueError):
+        prefetch(_range_reader(3), num_workers=0)
+
+
+def test_interleave_covers_all_shards():
+    shards = [lambda i=i: iter(range(i * 100, i * 100 + 10))
+              for i in range(5)]
+    expect = sorted(sum((list(range(i * 100, i * 100 + 10))
+                         for i in range(5)), []))
+    assert sorted(interleave(shards, buffer_size=8)()) == expect
+    assert sorted(interleave(shards, buffer_size=8, num_workers=2)()) == \
+        expect
+
+
+def test_interleave_worker_mixes_its_shards():
+    # one worker owning every shard must still cycle them round-robin
+    shards = [lambda i=i: iter([(i, j) for j in range(3)]) for i in range(3)]
+    out = list(interleave(shards, buffer_size=16, num_workers=1)())
+    assert [s for s, _ in out[:3]] == [0, 1, 2]  # first round touches all
+
+
+def test_interleave_propagates_shard_exception():
+    def bad():
+        yield 1
+        raise ValueError("shard 1 corrupt")
+
+    shards = [_range_reader(50), lambda: bad()]
+    with pytest.raises(ValueError, match="shard 1 corrupt"):
+        list(interleave(shards, buffer_size=4)())
+
+
+def test_buffered_reraises_and_preserves_order():
+    assert list(buffered(_range_reader(100), 4)()) == list(range(100))
+
+    def bad():
+        yield 1
+        raise OSError("disk gone")
+
+    with pytest.raises(OSError, match="disk gone"):
+        list(buffered(lambda: bad(), 4)())
+
+
+def test_native_buffered_propagates_exception():
+    def bad():
+        yield from range(3)
+        raise RuntimeError("reader broke")
+
+    r = native_buffered(lambda: bad(), size=2)
+    got = []
+    with pytest.raises(RuntimeError, match="reader broke"):
+        for x in r():
+            got.append(x)
+    assert got == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# stack_feeds
+# ---------------------------------------------------------------------------
+def test_stack_feeds_shapes_and_validation():
+    feeds = [{"x": np.full((2, 3), i, np.float32), "y": np.array([i])}
+             for i in range(4)]
+    st = stack_feeds(feeds)
+    assert st["x"].shape == (4, 2, 3) and st["y"].shape == (4, 1)
+    assert (st["x"][2] == 2).all()
+    with pytest.raises(ValueError):
+        stack_feeds([])
+    with pytest.raises(ValueError, match="keys differ"):
+        stack_feeds([{"x": np.zeros(2)}, {"z": np.zeros(2)}])
+
+
+# ---------------------------------------------------------------------------
+# Executor.run_pipelined
+# ---------------------------------------------------------------------------
+def _build_cls_net(seed_layers=True):
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    h = layers.fc(x, size=16, act="relu")
+    if seed_layers:
+        h = layers.dropout(h, dropout_prob=0.3)  # step-keyed RNG must match
+    pred = layers.fc(h, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _fresh():
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    pt.unique_name.reset()
+
+
+def _batches(rng, n, batch=16, feat=8):
+    return [{"x": rng.rand(batch, feat).astype("float32"),
+             "y": rng.randint(0, 3, (batch, 1))} for _ in range(n)]
+
+
+def test_run_pipelined_matches_sequential_run_bitwise():
+    batches = _batches(np.random.RandomState(7), 11)
+
+    _fresh()
+    loss = _build_cls_net()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    seq = [exe.run(pt.default_main_program(), feed=f, fetch_list=[loss])[0]
+           for f in batches]
+
+    _fresh()
+    loss2 = _build_cls_net()
+    exe2 = pt.Executor()
+    exe2.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    pip = [o[0] for o in exe2.run_pipelined(
+        iter(batches), pt.default_main_program(), fetch_list=[loss2],
+        steps_per_dispatch=4)]
+
+    assert len(pip) == len(seq)
+    for i, (a, b) in enumerate(zip(seq, pip)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            f"step {i}: sequential {a} != pipelined {b}"
+
+
+def test_run_pipelined_handles_signature_changes():
+    # two padding buckets alternating: scans must split at the boundary
+    rng = np.random.RandomState(3)
+    batches = []
+    for width in (8, 16, 8, 8, 8, 16, 16):
+        batches.append({"x": rng.rand(4, width).astype("float32")})
+
+    _fresh()
+    x = layers.data("x", shape=[-1], dtype="float32")
+    out = layers.reduce_mean(x)
+    exe = pt.Executor()
+    outs = list(exe.run_pipelined(iter(batches), pt.default_main_program(),
+                                  fetch_list=[out], steps_per_dispatch=3,
+                                  is_test=True))
+    assert len(outs) == len(batches)
+    for f, o in zip(batches, outs):
+        np.testing.assert_allclose(o[0], f["x"].mean(), rtol=1e-6)
+
+
+def test_run_pipelined_propagates_feed_iter_exception():
+    _fresh()
+    x = layers.data("x", shape=[4], dtype="float32")
+    out = layers.reduce_mean(x)
+    exe = pt.Executor()
+
+    def feeds():
+        yield {"x": np.zeros((2, 4), np.float32)}
+        raise RuntimeError("source died")
+
+    with pytest.raises(RuntimeError, match="source died"):
+        list(exe.run_pipelined(feeds(), pt.default_main_program(),
+                               fetch_list=[out], steps_per_dispatch=2,
+                               is_test=True))
+
+
+def test_run_pipelined_rejects_check_nan_inf():
+    _fresh()
+    layers.data("x", shape=[4], dtype="float32")
+    exe = pt.Executor(check_nan_inf=True)
+    with pytest.raises(ValueError, match="check_nan_inf"):
+        next(iter(exe.run_pipelined(iter([]), pt.default_main_program())))
+
+
+# ---------------------------------------------------------------------------
+# Trainer pipeline= option
+# ---------------------------------------------------------------------------
+def test_trainer_pipeline_trains_and_fires_events():
+    from paddle_tpu import trainer as trainer_mod
+
+    rng = np.random.RandomState(0)
+    w_true = rng.rand(5, 1).astype("float32")
+
+    def reader():
+        r = np.random.RandomState(1)
+        for _ in range(30):
+            xb = r.rand(8, 5).astype("float32")
+            yb = xb @ w_true + 0.01 * r.randn(8, 1).astype("float32")
+            yield [(xb[i], yb[i]) for i in range(8)]
+
+    x = layers.data("x", shape=[5], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    cost = layers.mean(layers.square_error_cost(pred, y))
+    sgd = trainer_mod.SGD(cost, update_equation=pt.optimizer.SGD(
+        learning_rate=0.05))
+
+    seen = {"begin": 0, "end": 0, "passes": 0, "losses": []}
+
+    def handler(e):
+        if isinstance(e, trainer_mod.events.BeginIteration):
+            seen["begin"] += 1
+        elif isinstance(e, trainer_mod.events.EndIteration):
+            seen["end"] += 1
+            seen["losses"].append(e.cost)
+        elif isinstance(e, trainer_mod.events.EndPass):
+            seen["passes"] += 1
+
+    sgd.train(reader, num_passes=2, event_handler=handler,
+              feed_list=[x, y], pipeline={"steps_per_dispatch": 4})
+    assert seen["begin"] == seen["end"] == 60
+    assert seen["passes"] == 2
+    assert np.isfinite(seen["losses"]).all()
+    # training signal: second pass clearly below the first's start
+    assert np.mean(seen["losses"][-10:]) < seen["losses"][0]

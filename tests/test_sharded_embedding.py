@@ -16,7 +16,7 @@ def test_manual_sharded_lookup_matches_dense(rng):
     table = rng.randn(V, D).astype("float32")
     ids = rng.randint(0, V, (10,))
     mesh = make_mesh(MeshConfig(tp=8))
-    f = jax.shard_map(
+    f = pt.compat.shard_map(
         lambda t, i: parallel.sharded_lookup(t, i, axis_name="tp"),
         mesh=mesh, in_specs=(P("tp", None), P()), out_specs=P())
     out = np.asarray(jax.jit(f)(table, ids))
@@ -28,7 +28,7 @@ def test_sharded_lookup_grad_rows(rng):
     ids = rng.randint(0, V, (6,))
     g = rng.randn(6, D).astype("float32")
     mesh = make_mesh(MeshConfig(tp=8))
-    f = jax.shard_map(
+    f = pt.compat.shard_map(
         lambda i, go: parallel.embedding.sharded_lookup_grad_rows(
             i, go, V, axis_name="tp"),
         mesh=mesh, in_specs=(P(), P()), out_specs=P("tp", None))
