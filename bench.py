@@ -44,9 +44,11 @@ def _median_window_throughput(exe, prog, feeds, loss, units_per_step,
     donated state), so per-step host dispatch and tunnel latency are out
     of the measurement entirely; the first (untimed) call is the compile +
     warmup.  Median of `reps` windows; spread = (max-min)/median."""
+    t0 = time.perf_counter()
     (lv,) = exe.run_steps(iters, prog, feed=feeds, fetch_list=[loss],
                           return_numpy=False)
     assert np.isfinite(np.asarray(lv)[-1])     # compile+warmup executed
+    _median_window_throughput.last_warmup_s = time.perf_counter() - t0
     rates = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -62,7 +64,7 @@ def main():
     import jax
 
     import paddle_tpu as pt
-    from paddle_tpu import layers, models
+    from paddle_tpu import layers, models, profiler
 
     img = layers.data("img", shape=[3, 224, 224], dtype="float32")
     label = layers.data("label", shape=[1], dtype="int64")
@@ -92,6 +94,9 @@ def main():
     prog = pt.default_main_program()
     img_s, spread = _median_window_throughput(
         exe, prog, feeds, loss, units_per_step=BATCH, iters=80, reps=3)
+    # snapshot NOW: the seq2seq/pipeline legs below reuse the timing core
+    # and would overwrite last_warmup_s before the record is built
+    resnet_warmup_s = getattr(_median_window_throughput, "last_warmup_s", 0.0)
 
     tok_s = tok_spread = None
     try:
@@ -111,6 +116,16 @@ def main():
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
         "window_spread": round(spread, 4),
+        # compile-time telemetry (core/compile_cache.py): how much of this
+        # run went to trace/lower/compile, and whether the persistent
+        # cache (PADDLE_TPU_CACHE_DIR) shortcut it — the cold-start axis
+        # benchmark/compile_cache.py measures in isolation
+        "compile_telemetry": {
+            "first_dispatch_s": round(resnet_warmup_s, 3),
+            "compile_phases_s": round(
+                profiler.compile_stats().total_compile_seconds(), 3),
+            "cache_counters": profiler.compile_stats().snapshot(),
+        },
     }
     extra = []
     if tok_s is not None:

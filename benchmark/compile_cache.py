@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Compile-cache A/B: cold vs warm startup-to-first-step across two
+subprocesses.
+
+Unlike kernel throughput (TPU-gated), compile time is fully measurable in a
+CPU-only container: each arm is a FRESH python process that builds a real
+model, runs the startup program and executes train steps with
+``PADDLE_TPU_CACHE_DIR`` pointing at a shared directory.  The first (cold)
+process populates the persistent cache (serialized step executables +
+JAX's HLO-keyed compilation cache, core/compile_cache.py); the second
+(warm) process loads them, skipping trace, lower AND compile.
+
+Measured columns per arm (all wall-clock in the child, never projected):
+
+* ``engine_s``         — startup-program run + first train step: the span
+                         the compile cache can shorten.  The headline
+                         speedup is ``cold.engine_s / warm.engine_s``.
+* ``total_s``          — python-process start to first step done (includes
+                         the jax+framework import tax, identical in both
+                         arms; reported so the end-to-end picture is
+                         honest).
+* ``steps_digest``     — sha256 over every fetch of ``--steps`` train
+                         steps; cold and warm must be BIT-IDENTICAL (the
+                         deserialized executable is the same program).
+* ``counters``         — compile_stats() snapshot (traces / disk hits /
+                         stores); a correct warm arm has ZERO traces.
+
+Models: ``wide_deep`` (CTR embeddings + MLP), ``resnet`` (CIFAR resnet-20),
+``lstm`` (embedding -> dynamic_lstm -> fc) — the three
+benchmark-representative graph shapes — plus ``tiny`` for the --smoke
+seconds-fast path (tmpdir cache, asserts warm-run disk hit + bit-identical
+fetches) wired into tier-1.
+
+Usage:
+    python benchmark/compile_cache.py              # full A/B, writes
+                                                   # compile_cache_results.json
+    python benchmark/compile_cache.py --smoke      # tiny model, seconds
+    python benchmark/compile_cache.py --model lstm
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+RESULTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "compile_cache_results.json")
+MODELS = ("wide_deep", "resnet", "lstm")
+
+
+# ---------------------------------------------------------------------------
+# child: one measured arm in a fresh process
+# ---------------------------------------------------------------------------
+def _build_model(model, rng):
+    """Build (loss, feeds) for one model; fixed shapes + seeded data so the
+    cold and warm arms run bit-identical programs on bit-identical inputs."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    if model == "wide_deep":
+        B, nsparse, vocab, dense_d = 32, 8, 1000, 13
+        sparse = [layers.data(f"s{i}", shape=[1], dtype="int64")
+                  for i in range(nsparse)]
+        dense = layers.data("dense", shape=[dense_d], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="float32")
+        ctr = models.wide_deep(sparse, dense, [vocab] * nsparse)
+        loss = layers.mean(layers.log_loss(ctr, label))
+        pt.optimizer.Adam(1e-3).minimize(loss)
+        feeds = {f"s{i}": rng.randint(0, vocab, (B, 1))
+                 for i in range(nsparse)}
+        feeds["dense"] = rng.rand(B, dense_d).astype("float32")
+        feeds["label"] = rng.randint(0, 2, (B, 1)).astype("float32")
+    elif model == "resnet":
+        B = 8
+        img = layers.data("img", shape=[3, 32, 32], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred = models.resnet_cifar(img, num_classes=10, depth=20)
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        pt.optimizer.Momentum(learning_rate=0.01, momentum=0.9) \
+            .minimize(loss)
+        feeds = {"img": rng.rand(B, 3, 32, 32).astype("float32"),
+                 "label": rng.randint(0, 10, (B, 1))}
+    elif model == "lstm":
+        B, T, vocab = 16, 32, 2000
+        words = layers.data("words", shape=[], dtype="int64", lod_level=1)
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred = models.lstm_text_classification(
+            words, vocab_size=vocab, num_classes=2, emb_dim=32,
+            hidden_size=64, lstm_num=1)
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        pt.optimizer.Adam(1e-3).minimize(loss)
+        feeds = {"words": rng.randint(0, vocab, (B, T)),
+                 "words@LEN": np.full(B, T),
+                 "label": rng.randint(0, 2, (B, 1))}
+    elif model == "tiny":
+        B = 8
+        x = layers.data("x", shape=[16], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="int64")
+        pred = layers.fc(layers.fc(x, size=32, act="relu"), size=4,
+                         act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, y))
+        pt.optimizer.SGD(0.1).minimize(loss)
+        feeds = {"x": rng.rand(B, 16).astype("float32"),
+                 "y": rng.randint(0, 4, (B, 1))}
+    else:
+        raise ValueError(f"unknown model {model!r}")
+    return loss, feeds
+
+
+def child_main(model: str, steps: int):
+    """One arm: build, startup, ``steps`` train steps; print ONE JSON
+    line.  PADDLE_TPU_CACHE_DIR (and JAX_PLATFORMS) come from the
+    environment set by the parent."""
+    t_proc = time.perf_counter()
+    import hashlib
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.core import compile_cache
+
+    t_import = time.perf_counter()
+    rng = np.random.RandomState(0)
+    loss, feeds = _build_model(model, rng)
+    t_build = time.perf_counter()
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    t_startup = time.perf_counter()
+    outs = [exe.run(feed=feeds, fetch_list=[loss])]
+    t_first = time.perf_counter()
+    for _ in range(steps - 1):
+        outs.append(exe.run(feed=feeds, fetch_list=[loss]))
+    digest = hashlib.sha256(
+        b"".join(np.ascontiguousarray(o[0]).tobytes() for o in outs)
+    ).hexdigest()
+
+    stats = compile_cache.stats()
+    print(json.dumps({
+        "model": model,
+        "import_s": round(t_import - t_proc, 4),
+        "build_s": round(t_build - t_import, 4),
+        "startup_run_s": round(t_startup - t_build, 4),
+        "first_step_s": round(t_first - t_startup, 4),
+        "engine_s": round(t_first - t_build, 4),
+        "total_s": round(t_first - t_proc, 4),
+        "first_loss": float(np.asarray(outs[0][0])),
+        "steps_digest": digest,
+        "counters": stats.snapshot(),
+    }), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# parent: cold/warm pairs
+# ---------------------------------------------------------------------------
+def _run_arm(model: str, cache_dir: str, steps: int) -> dict:
+    env = dict(os.environ, PADDLE_TPU_CACHE_DIR=cache_dir,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         "--model", model, "--steps", str(steps)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"compile_cache child ({model}) failed:\n{r.stderr[-2000:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def run_model(model: str, steps: int = 3, cache_dir: str = None,
+              quiet: bool = False) -> dict:
+    """One cold/warm pair in a fresh cache dir; returns the result row."""
+    d = cache_dir or tempfile.mkdtemp(prefix=f"ptcc_{model}_")
+    owns = cache_dir is None
+    try:
+        cold = _run_arm(model, d, steps)
+        warm = _run_arm(model, d, steps)
+    finally:
+        if owns:
+            shutil.rmtree(d, ignore_errors=True)
+    row = {
+        "model": model,
+        "cold_engine_s": cold["engine_s"], "warm_engine_s": warm["engine_s"],
+        "speedup_engine": round(cold["engine_s"] / warm["engine_s"], 2),
+        "cold_total_s": cold["total_s"], "warm_total_s": warm["total_s"],
+        "speedup_total": round(cold["total_s"] / warm["total_s"], 2),
+        "bit_identical": cold["steps_digest"] == warm["steps_digest"],
+        "warm_traces": warm["counters"].get("traces", 0),
+        "warm_disk_hits": warm["counters"].get("disk_hits", 0),
+        "cold_counters": cold["counters"], "warm_counters": warm["counters"],
+        "cold": cold, "warm": warm,
+    }
+    if not quiet:
+        print(json.dumps({k: row[k] for k in (
+            "model", "cold_engine_s", "warm_engine_s", "speedup_engine",
+            "cold_total_s", "warm_total_s", "speedup_total",
+            "bit_identical", "warm_traces", "warm_disk_hits")}),
+            flush=True)
+    return row
+
+
+def run_smoke(steps: int = 3) -> dict:
+    """Seconds-fast correctness path (tier-1): tiny model, tmpdir cache.
+    Asserts the warm arm hit the persistent cache without a single trace
+    and produced bit-identical fetches.  Timing columns are reported but
+    NOT asserted — smoke is a correctness gate, not a perf gate."""
+    row = run_model("tiny", steps=steps, quiet=True)
+    assert row["bit_identical"], (
+        "warm-run fetches differ from cold run:\n"
+        f"cold {row['cold']['steps_digest']} warm {row['warm']['steps_digest']}")
+    assert row["warm_disk_hits"] >= 2, (
+        "warm run did not hit the persistent executable cache: "
+        f"{row['warm_counters']}")
+    assert row["warm_traces"] == 0, (
+        "warm run re-traced despite persistent cache: "
+        f"{row['warm_counters']}")
+    print(json.dumps({"model": "compile_cache_smoke", "ok": True,
+                      "speedup_engine": row["speedup_engine"],
+                      "warm_counters": row["warm_counters"]}), flush=True)
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true",
+                    help="internal: run one measured arm in this process")
+    ap.add_argument("--model", default=None,
+                    help=f"one of {MODELS + ('tiny',)} (default: all three)")
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny model + assertions, seconds-fast")
+    args = ap.parse_args()
+
+    if args.child:
+        child_main(args.model, args.steps)
+        return
+    if args.smoke:
+        run_smoke(steps=args.steps)
+        return
+
+    models = [args.model] if args.model else list(MODELS)
+    rows = [run_model(m, steps=args.steps) for m in models]
+    import multiprocessing
+
+    import jax
+    payload = {
+        "benchmark": "compile_cache_cold_vs_warm",
+        "note": ("two fresh subprocesses sharing one PADDLE_TPU_CACHE_DIR; "
+                 "engine_s = startup-program run + first train step (the "
+                 "span compile caching can shorten); measured in-container "
+                 "on CPU, never projected"),
+        "host": {"jax": jax.__version__,
+                 "backend": jax.default_backend(),
+                 "cpu_count": multiprocessing.cpu_count()},
+        "rows": [{k: v for k, v in r.items()
+                  if k not in ("cold", "warm")} for r in rows],
+        "detail": [{"model": r["model"], "cold": r["cold"],
+                    "warm": r["warm"]} for r in rows],
+    }
+    with open(RESULTS_PATH, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    print(f"wrote {RESULTS_PATH}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

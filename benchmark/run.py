@@ -185,13 +185,25 @@ def run_input_pipeline(smoke=False):
     return [run_workload(w, smoke=smoke) for w in sorted(WORKLOADS)]
 
 
+def run_compile_cache(smoke=False):
+    """Delegate to benchmark/compile_cache.py (cold vs warm
+    startup-to-first-step across two subprocesses); --smoke is the
+    seconds-fast tiny-model correctness gate wired into tier-1."""
+    from benchmark.compile_cache import MODELS, run_model, run_smoke
+    if smoke:
+        return [run_smoke()]
+    return [run_model(m) for m in MODELS]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None,
-                    help="model config, or 'input_pipeline' for the "
-                         "naive-vs-pipelined input A/B")
+                    help="model config, 'input_pipeline' for the "
+                         "naive-vs-pipelined input A/B, or 'compile_cache' "
+                         "for the cold-vs-warm startup A/B")
     ap.add_argument("--smoke", action="store_true",
-                    help="input_pipeline only: seconds-fast path check")
+                    help="input_pipeline/compile_cache only: seconds-fast "
+                         "path check")
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--iters", type=int, default=None,
                     help="steps per timed window (default: 60 for the "
@@ -209,6 +221,9 @@ def main():
     args = ap.parse_args()
     if args.model == "input_pipeline":
         run_input_pipeline(smoke=args.smoke)
+        return
+    if args.model == "compile_cache":
+        run_compile_cache(smoke=args.smoke)
         return
     if args.all:
         for name, batch in HEADLINE:

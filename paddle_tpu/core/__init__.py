@@ -10,6 +10,8 @@ from .program import (
 )
 from .registry import register_op, get_op_impl, has_op, registered_ops
 from .scope import Scope, global_scope, scope_guard, reset_global_scope
+from . import compile_cache
+from .compile_cache import CompiledProgram, retrace_guard
 from .executor import (
     Executor, Place, CPUPlace, TPUPlace, CUDAPlace,
     Env, LoweringContext, interpret_ops, run_op, stack_feeds,
@@ -25,4 +27,5 @@ __all__ = [
     "Scope", "global_scope", "scope_guard", "reset_global_scope",
     "Executor", "Place", "CPUPlace", "TPUPlace", "CUDAPlace",
     "Env", "LoweringContext", "interpret_ops", "run_op", "stack_feeds",
+    "compile_cache", "CompiledProgram", "retrace_guard",
 ]

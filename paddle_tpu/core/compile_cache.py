@@ -1,0 +1,646 @@
+"""Compilation-cache subsystem: fingerprints, LRU entry cache, persistent
+on-disk executables, and compile-time telemetry.
+
+paddle_tpu's one-big-jit design (core/executor.py) pays trace->lower->compile
+for every (program, feed-signature) variant.  This module makes that cost
+*managed* instead of implicit, in three layers:
+
+1. **Stable fingerprints** — a compiled step variant is keyed by a content
+   hash of everything that determines the traced computation: the serialized
+   Program (ops, attrs, var shapes/dtypes, random_seed), the feed signature
+   (names/shapes/dtypes), fetch names, state keys, executor configuration
+   (amp, compute_dtype, compiler_options, conv1x1_pallas, check_nan_inf),
+   mesh + sharding specs (ShardedExecutor), x64 mode, and the jax +
+   paddle_tpu versions.  Unlike the previous ``id(program)``/``version``
+   keys, fingerprints survive process restarts and deduplicate
+   content-identical programs (``prune().clone(for_test=True)`` slices built
+   per evaluation call now hit the same entry).
+
+2. **Persistent cache** — when the ``cache_dir`` flag (env
+   ``PADDLE_TPU_CACHE_DIR``) is set, every compiled step executable is
+   serialized (``jax.experimental.serialize_executable``) to
+   ``<dir>/ptxc-<fingerprint>.pkl`` together with its StableHLO text and
+   compile-phase timings; a later process with the same fingerprint loads
+   the executable directly, skipping trace, lower AND compile.  JAX's own
+   persistent compilation cache (``jax_compilation_cache_dir``) is wired to
+   the same directory as a second layer that still helps when executable
+   deserialization is unavailable (it caches the XLA compile step keyed by
+   HLO).
+
+3. **Telemetry** — per-fingerprint trace/lower/compile wall times, cache
+   hit/miss/eviction counters and a retrace detector
+   (:func:`retrace_guard` / :meth:`CompileStats.assert_no_retrace`), all
+   surfaced through ``paddle_tpu.profiler.compile_stats()``.
+
+The deploy-time entry point is ``Executor.compile(...) -> CompiledProgram``
+(AOT ``jit(...).lower().compile()``), so serving paths and
+``Trainer.train(warmup=...)`` pay compile cost at a chosen moment instead of
+first-request time.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import logging
+import os
+import pickle
+import tempfile
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional
+
+import jax
+
+logger = logging.getLogger("paddle_tpu")
+
+DISK_FORMAT = 1                  # bump to invalidate every on-disk entry
+_DISK_PREFIX = "ptxc-"
+
+_env_key = None
+_jax_cc_dir_wired: Optional[str] = None
+_serialize_warned = False
+
+
+def framework_version() -> str:
+    try:
+        from .. import __version__
+        return __version__
+    except Exception:
+        return "0"
+
+
+def environment_key():
+    """Process-environment component of every fingerprint: a compiled
+    executable is only valid for the same jax/paddle_tpu versions and the
+    same backend topology."""
+    global _env_key
+    if _env_key is None:
+        _env_key = (jax.__version__, framework_version(),
+                    jax.default_backend(), jax.device_count())
+    return _env_key
+
+
+def fingerprint_hex(sig) -> str:
+    """Stable hex digest of a structured signature tuple.
+
+    ``sig`` must repr deterministically (strings, ints, bools, nested
+    tuples); the Program component should be ``program.content_digest()``
+    so the key survives process restarts."""
+    payload = repr((sig, environment_key()))
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def program_content_digest(program) -> str:
+    """Content hash of a serialized Program, cached per version bump.
+
+    Serialization cost is paid once per program mutation, not per step —
+    the same discipline as ``Executor._state_keys``."""
+    key = (program.version, program.random_seed)   # random_seed mutates
+    cached = getattr(program, "_content_digest", None)  # without a bump
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    payload = json.dumps(program.to_dict(), sort_keys=True,
+                         separators=(",", ":"), default=repr)
+    digest = hashlib.sha256(payload.encode()).hexdigest()
+    program._content_digest = (key, digest)
+    return digest
+
+
+# ---------------------------------------------------------------------------
+# Telemetry
+# ---------------------------------------------------------------------------
+class RetraceError(AssertionError):
+    """Raised by :func:`retrace_guard` when a fingerprint traces twice."""
+
+
+class CompileStats:
+    """Compile-time telemetry: counters + per-fingerprint phase records.
+
+    Counters:
+      hits/misses/evictions       — in-process entry cache (ExecCache)
+      disk_hits/disk_misses       — persistent executable cache lookups
+      disk_stores                 — executables serialized to disk
+      traces                      — jit traces of step functions (a trace
+                                    runs the Python interpreter over the
+                                    whole Program; the retrace detector
+                                    flags a fingerprint traced twice)
+      state_keys_evictions        — Program._state_keys_cache sweeps
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = collections.defaultdict(int)
+        self.entries: Dict[str, dict] = {}
+        self._guards: List[Dict[str, int]] = []
+
+    # -- recording -------------------------------------------------------
+    def entry(self, fp: str) -> dict:
+        with self._lock:
+            return self.entries.setdefault(
+                fp, {"traces": 0, "hits": 0, "times": {}, "source": None,
+                     "label": None})
+
+    def bump(self, counter: str, n: int = 1):
+        with self._lock:
+            self.counters[counter] += n
+
+    def record_trace(self, fp: Optional[str]):
+        if fp is None:
+            return
+        e = self.entry(fp)
+        with self._lock:
+            e["traces"] += 1
+            self.counters["traces"] += 1
+            guard_hit = [g for g in self._guards if g.get(fp, 0) >= 1]
+            for g in self._guards:
+                g[fp] = g.get(fp, 0) + 1
+        if guard_hit:
+            # guard_hit[0] aliases a dict the loop above already bumped
+            raise RetraceError(
+                f"retrace detected: fingerprint {fp[:16]}… traced "
+                f"{guard_hit[0][fp]} times inside retrace_guard() — the "
+                f"same (program, feed-signature, config) re-paid its "
+                f"trace cost; expected exactly one trace per fingerprint")
+
+    def record_hit(self, fp: str):
+        e = self.entry(fp)
+        with self._lock:
+            e["hits"] += 1
+            self.counters["hits"] += 1
+
+    def record_times(self, fp: str, source: str, label: Optional[str] = None,
+                     **times):
+        e = self.entry(fp)
+        with self._lock:
+            e["times"].update({k: round(v, 6) for k, v in times.items()})
+            e["source"] = source
+            if label:
+                e["label"] = label
+
+    # -- queries ---------------------------------------------------------
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counters)
+
+    def total_compile_seconds(self) -> float:
+        """Wall time spent in trace/lower/compile phases only — a warm
+        start's deserialize_s is deliberately excluded (it is disk-load
+        time, not compilation; bench.py reports this as the cold-start
+        cost the persistent cache removes)."""
+        with self._lock:
+            return sum(e["times"].get(k, 0.0)
+                       for e in self.entries.values()
+                       for k in ("trace_s", "lower_s", "compile_s"))
+
+    def assert_no_retrace(self):
+        bad = {fp: e["traces"] for fp, e in self.entries.items()
+               if e["traces"] > 1}
+        if bad:
+            raise RetraceError(
+                f"fingerprints traced more than once: "
+                f"{ {fp[:16]: n for fp, n in bad.items()} }")
+
+    def report(self) -> str:
+        lines = ["======= CompileStats ======="]
+        with self._lock:
+            for k in sorted(self.counters):
+                lines.append(f"  {k}: {self.counters[k]}")
+            for fp, e in self.entries.items():
+                t = " ".join(f"{k}={v * 1e3:.1f}ms"
+                             for k, v in e["times"].items())
+                lines.append(
+                    f"  [{fp[:12]}] traces={e['traces']} hits={e['hits']} "
+                    f"source={e['source']} {t}"
+                    + (f" ({e['label']})" if e.get("label") else ""))
+        return "\n".join(lines)
+
+    def reset(self):
+        with self._lock:
+            self.counters.clear()
+            self.entries.clear()
+
+
+_stats = CompileStats()
+
+
+def stats() -> CompileStats:
+    return _stats
+
+
+class retrace_guard:
+    """Context manager: raise :class:`RetraceError` if any fingerprint is
+    traced more than once while active.  Tests wrap training loops in this
+    to pin the compile-once contract; note that cache eviction (LRU
+    overflow) and ``auto_layout`` (which compiles probe variants)
+    legitimately re-trace."""
+
+    def __enter__(self):
+        self._window: Dict[str, int] = {}
+        with _stats._lock:
+            _stats._guards.append(self._window)
+        return self
+
+    def __exit__(self, *exc):
+        with _stats._lock:
+            _stats._guards.remove(self._window)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# In-process entry cache: LRU + weakref sweeping
+# ---------------------------------------------------------------------------
+class _Entry:
+    __slots__ = ("fn", "prog_refs")
+
+    def __init__(self, fn, program):
+        self.fn = fn
+        self.prog_refs = [weakref.ref(program)]
+
+    def _prog_cell(self):
+        """The step fn's refreshable program-weakref cell (executor
+        _make_fn), reachable through the jit wrappers' ``_fn``."""
+        fn = self.fn
+        for _ in range(3):
+            cell = getattr(fn, "prog_cell", None)
+            if cell is not None:
+                return cell
+            fn = getattr(fn, "_fn", None)
+            if fn is None:
+                return None
+        return None
+
+    def add_client(self, program):
+        # retarget the step fn at this (content-identical — the fingerprint
+        # guarantees it) client, so a later re-trace doesn't depend on the
+        # CREATOR program still being alive
+        cell = self._prog_cell()
+        if cell is not None and cell[0]() is not program:
+            cell[0] = weakref.ref(program)
+        for r in self.prog_refs:
+            if r() is program:
+                return
+        self.prog_refs = [r for r in self.prog_refs if r() is not None]
+        self.prog_refs.append(weakref.ref(program))
+
+    def dead(self) -> bool:
+        return all(r() is None for r in self.prog_refs)
+
+
+class ExecCache:
+    """Fingerprint -> compiled-step cache with an LRU bound and dead-entry
+    sweeping.
+
+    Each entry tracks weakrefs to every Program that has used it (the step
+    fn itself only weakly references its program — core/executor.py
+    ``_make_fn``), so when the last client program is garbage-collected the
+    entry is dropped on the next put/sweep instead of accumulating for the
+    life of the Executor.  ``max_entries`` bounds live variants with LRU
+    eviction; both eviction kinds count into :class:`CompileStats`.
+    """
+
+    def __init__(self, max_entries: int = 64):
+        self.max_entries = max(1, int(max_entries))
+        self._od: "collections.OrderedDict[str, _Entry]" = \
+            collections.OrderedDict()
+        self.evictions = 0
+
+    def __len__(self):
+        return len(self._od)
+
+    def get(self, fp: str, program=None):
+        e = self._od.get(fp)
+        if e is None:
+            _stats.bump("misses")
+            return None
+        self._od.move_to_end(fp)
+        if program is not None:
+            e.add_client(program)
+        _stats.record_hit(fp)
+        return e.fn
+
+    def put(self, fp: str, fn, program):
+        self.sweep()
+        self._od[fp] = _Entry(fn, program)
+        self._od.move_to_end(fp)
+        while len(self._od) > self.max_entries:
+            self._od.popitem(last=False)
+            self.evictions += 1
+            _stats.bump("evictions")
+
+    def sweep(self):
+        dead = [fp for fp, e in self._od.items() if e.dead()]
+        for fp in dead:
+            del self._od[fp]
+            self.evictions += 1
+            _stats.bump("evictions")
+
+    def clear(self):
+        self._od.clear()
+
+
+# ---------------------------------------------------------------------------
+# Persistent on-disk layer
+# ---------------------------------------------------------------------------
+def cache_dir() -> str:
+    """Active persistent-cache directory ('' = disabled).  Reads the
+    ``cache_dir`` flag, which the env var PADDLE_TPU_CACHE_DIR seeds."""
+    from .. import flags
+    try:
+        return str(flags.get_flag("cache_dir") or "")
+    except KeyError:
+        return ""
+
+
+def wire_jax_compilation_cache(path: str):
+    """Point JAX's persistent compilation cache at ``path`` (idempotent).
+    This caches the XLA compile step keyed by lowered HLO — the fallback
+    layer when whole-executable serialization is unavailable for a
+    backend."""
+    global _jax_cc_dir_wired
+    if not path or _jax_cc_dir_wired == path:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:
+            pass
+        _jax_cc_dir_wired = path
+    except Exception as e:          # very old jax: no persistent cache
+        logger.warning("persistent compilation cache unavailable (%s: %s)",
+                       type(e).__name__, e)
+        _jax_cc_dir_wired = path    # don't retry every entry
+
+
+def _disk_path(dirpath: str, fp: str) -> str:
+    return os.path.join(dirpath, f"{_DISK_PREFIX}{fp}.pkl")
+
+
+def disk_load(fp: str) -> Optional[dict]:
+    """Load a persisted entry payload for ``fp``, or None.  Any failure
+    (missing, corrupt, foreign format/version) is a miss — the fingerprint
+    already folds in jax/paddle_tpu versions and backend topology, so a
+    stale file can only be hit by a hash collision or a truncated write."""
+    d = cache_dir()
+    if not d:
+        return None
+    try:
+        with open(_disk_path(d, fp), "rb") as f:
+            payload = pickle.load(f)
+        if payload.get("format") != DISK_FORMAT or \
+                payload.get("fingerprint") != fp:
+            _stats.bump("disk_misses")
+            return None
+        _stats.bump("disk_hits")
+        return payload
+    except FileNotFoundError:
+        _stats.bump("disk_misses")
+        return None
+    except Exception as e:
+        logger.warning("compile cache: unreadable entry for %s… (%s: %s)",
+                       fp[:12], type(e).__name__, e)
+        _stats.bump("disk_misses")
+        return None
+
+
+def disk_store(fp: str, payload: dict):
+    """Atomically persist an entry payload (tmp file + rename, so a
+    concurrent reader never sees a truncated pickle)."""
+    d = cache_dir()
+    if not d:
+        return
+    try:
+        os.makedirs(d, exist_ok=True)
+        payload = dict(payload, format=DISK_FORMAT, fingerprint=fp)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=_DISK_PREFIX, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, _disk_path(d, fp))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        _stats.bump("disk_stores")
+    except Exception as e:
+        logger.warning("compile cache: could not persist %s… (%s: %s)",
+                       fp[:12], type(e).__name__, e)
+
+
+# ---------------------------------------------------------------------------
+# The jit wrapper: explicit trace/lower/compile with telemetry + disk
+# ---------------------------------------------------------------------------
+class CachedStep:
+    """AOT-compiled step function for ONE fingerprint.
+
+    Replaces a bare ``jax.jit(fn, donate_argnums=(1,))`` in the executor's
+    entry cache.  Semantics are identical (the executor's signature already
+    pins shapes/dtypes/x64, so one specialization per instance is exact),
+    but the explicit ``trace -> lower -> compile`` pipeline buys:
+
+    * per-phase wall-time telemetry (CompileStats),
+    * ``compiler_options`` support (plain jit has no per-call hook),
+    * executable serialization to the persistent cache, and symmetric
+      deserialization that skips all three phases on a warm start,
+    * an AOT ``prepare()`` entry point taking abstract avals
+      (``jax.ShapeDtypeStruct``) for ``Executor.compile`` /
+      ``Trainer.train(warmup=...)``.
+
+    If the compiled executable rejects a call's arguments (argument-check
+    errors happen before donation), the call retries once through an
+    equivalent lazily-compiled ``jax.jit`` — e.g. inputs committed to a
+    non-default device, which jit re-specializes on but an AOT executable
+    cannot.
+    """
+
+    def __init__(self, fn, fingerprint: Optional[str],
+                 compiler_options: Optional[dict] = None,
+                 in_shardings=None, label: Optional[str] = None):
+        kw = {"donate_argnums": (1,)}
+        if in_shardings is not None:
+            kw["in_shardings"] = in_shardings
+        self._fn = fn
+        self._jit = jax.jit(fn, **kw)
+        self._fp = fingerprint
+        self._opts = dict(compiler_options or {})
+        self._label = label
+        self._compiled = None
+        self._fallback_recorded = False
+        self._times: Dict[str, float] = {}
+
+    # -- public ----------------------------------------------------------
+    @property
+    def fingerprint(self) -> Optional[str]:
+        return self._fp
+
+    @property
+    def times(self) -> Dict[str, float]:
+        return dict(self._times)
+
+    def prepare(self, feeds, state, step):
+        """Ensure the executable exists; args may be abstract
+        (ShapeDtypeStruct) or concrete — only shapes/dtypes are read."""
+        if self._compiled is None:
+            self._compiled = self._load_or_compile(feeds, state, step)
+        return self
+
+    def stablehlo(self) -> Optional[str]:
+        """StableHLO text of the lowered step, read back from the
+        persistent entry on demand (never pinned in memory — resnet-scale
+        module text runs to MBs per cache entry)."""
+        payload = disk_load(self._fp) if self._fp else None
+        return payload.get("stablehlo") if payload else None
+
+    def __call__(self, feeds, state, step):
+        if self._compiled is None:
+            self._compiled = self._load_or_compile(feeds, state, step)
+        try:
+            return self._compiled(feeds, state, step)
+        except (ValueError, TypeError):
+            # argument-check rejection (pre-donation): inputs jit would
+            # re-specialize on (foreign device commitment / layout).  Route
+            # THIS call through the equivalent lazy jit, keeping the AOT
+            # executable for calls that do match.  Guard: if any state
+            # buffer was already donated, execution STARTED — the error is
+            # a real execution failure and a re-run on deleted buffers
+            # would mask it (same hazard _AutoLayoutStep documents).
+            if any(v.is_deleted() for v in state.values()
+                   if hasattr(v, "is_deleted")):
+                raise
+            # The jit trace is an honest retrace of this fingerprint —
+            # record it (once; jit caches its specializations) so
+            # retrace_guard and the telemetry don't under-report.
+            if not self._fallback_recorded:
+                self._fallback_recorded = True
+                logger.warning(
+                    "compile cache: AOT executable rejected call args for "
+                    "%s…; falling back to lazy jit for mismatching calls",
+                    (self._fp or "?")[:12])
+                _stats.record_trace(self._fp)
+            return self._jit(feeds, state, step)
+
+    # -- internals -------------------------------------------------------
+    def _load_or_compile(self, feeds, state, step):
+        d = cache_dir()
+        if d:
+            wire_jax_compilation_cache(d)
+            loaded = self._try_deserialize()
+            if loaded is not None:
+                return loaded
+        t0 = time.perf_counter()
+        try:
+            traced = self._jit.trace(feeds, state, step)
+            t1 = time.perf_counter()
+            lowered = traced.lower()
+        except AttributeError:       # older jax: no jit.trace — fuse phases
+            t1 = t0
+            lowered = self._jit.lower(feeds, state, step)
+        t2 = time.perf_counter()
+        # the trace happened inside trace()/lower(): record it now (the
+        # retrace detector fires here if this fingerprint already traced)
+        _stats.record_trace(self._fp)
+        compiled = lowered.compile(
+            compiler_options=self._opts if self._opts else None)
+        t3 = time.perf_counter()
+        self._times = {"trace_s": t1 - t0, "lower_s": t2 - t1,
+                       "compile_s": t3 - t2}
+        if self._fp:
+            _stats.record_times(self._fp, source="compile",
+                                label=self._label, **self._times)
+        if d:
+            self._serialize(lowered, compiled)
+        return compiled
+
+    def _try_deserialize(self):
+        payload = disk_load(self._fp) if self._fp else None
+        if payload is None or "executable" not in payload:
+            return None
+        try:
+            from jax.experimental.serialize_executable import \
+                deserialize_and_load
+            t0 = time.perf_counter()
+            compiled = deserialize_and_load(
+                payload["executable"], payload["in_tree"],
+                payload["out_tree"])
+            dt = time.perf_counter() - t0
+            self._times = {"deserialize_s": dt}
+            _stats.record_times(self._fp, source="disk", label=self._label,
+                                deserialize_s=dt)
+            return compiled
+        except Exception as e:
+            logger.warning(
+                "compile cache: executable deserialization failed for %s… "
+                "(%s: %s); recompiling (jax's HLO-keyed persistent cache "
+                "still shortcuts the XLA compile)",
+                self._fp[:12], type(e).__name__, e)
+            return None
+
+    def _serialize(self, lowered, compiled):
+        global _serialize_warned
+        try:
+            from jax.experimental.serialize_executable import serialize
+            payload_bytes, in_tree, out_tree = serialize(compiled)
+            hlo = None
+            try:
+                hlo = lowered.as_text()
+            except Exception:
+                pass
+            disk_store(self._fp, {
+                "executable": payload_bytes, "in_tree": in_tree,
+                "out_tree": out_tree, "stablehlo": hlo,
+                "times": dict(self._times), "label": self._label,
+            })
+        except Exception as e:
+            if not _serialize_warned:
+                _serialize_warned = True
+                logger.warning(
+                    "compile cache: executable serialization unavailable "
+                    "(%s: %s); warm starts will rely on jax's HLO-keyed "
+                    "persistent cache only", type(e).__name__, e)
+
+
+class CompiledProgram:
+    """Handle returned by ``Executor.compile``: an ahead-of-time compiled
+    step variant already installed in the executor's cache, so a matching
+    ``Executor.run``/``run_steps`` call executes without tracing or
+    compiling.  ``run(...)`` delegates with the bound fetch list."""
+
+    def __init__(self, executor, program, fingerprint: str, step: CachedStep,
+                 fetch_names, state_keys, num_steps=None,
+                 feeds_stacked=False, is_test=False):
+        self._executor = executor
+        self.program = program
+        self.fingerprint = fingerprint
+        self._step = step
+        self.fetch_names = list(fetch_names)
+        self.state_keys = list(state_keys)
+        self.num_steps = num_steps
+        self.feeds_stacked = feeds_stacked
+        self.is_test = is_test
+
+    @property
+    def compile_times(self) -> Dict[str, float]:
+        return self._step.times
+
+    def stablehlo(self) -> Optional[str]:
+        return self._step.stablehlo()
+
+    def run(self, feed=None, scope=None, return_numpy=True):
+        if self.num_steps is not None:
+            return self._executor.run_steps(
+                self.num_steps, self.program, feed=feed,
+                fetch_list=self.fetch_names, scope=scope,
+                return_numpy=return_numpy, is_test=self.is_test,
+                feeds_stacked=self.feeds_stacked)
+        return self._executor.run(
+            self.program, feed=feed, fetch_list=self.fetch_names,
+            scope=scope, return_numpy=return_numpy, is_test=self.is_test)
+
+    def __repr__(self):
+        return (f"CompiledProgram(fingerprint={self.fingerprint[:12]}…, "
+                f"fetches={self.fetch_names}, num_steps={self.num_steps})")

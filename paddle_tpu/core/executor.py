@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import compile_cache
 from .program import Block, Operator, Program, Variable, grad_var_name
 from .registry import get_op_impl
 from .scope import Scope, global_scope
@@ -405,6 +406,12 @@ def _feed_signature(feed: Dict[str, object]):
 # ---------------------------------------------------------------------------
 # Executor
 # ---------------------------------------------------------------------------
+# bound on per-program (scope, keys_version) -> state-keys entries; dead
+# scopes are swept on every cache miss (satellite of the compile-cache
+# work: these used to accumulate for the life of the program)
+_STATE_KEYS_CACHE_MAX = 32
+
+
 class Executor:
     """Compile-and-run a Program (reference: fluid/executor.py:56-119).
 
@@ -441,9 +448,20 @@ class Executor:
         # None defers to the conv1x1_pallas flag, a per-op use_pallas attr
         # (layers.conv2d(use_pallas=...)) overrides both
         self.conv1x1_pallas = conv1x1_pallas
-        self._cache: Dict = {}
+        # compiled step variants keyed by CONTENT fingerprint (survives
+        # process restarts via the persistent layer; content-identical
+        # programs share an entry), LRU-bounded with dead-program sweeping
+        self._cache = compile_cache.ExecCache(self._cache_capacity())
         self._fmt_registry: Dict = {}  # state var name -> pinned Format
         self._step = 0
+
+    @staticmethod
+    def _cache_capacity() -> int:
+        try:
+            from .. import flags
+            return int(flags.get_flag("executor_cache_entries"))
+        except Exception:
+            return 64
 
     # -- public ------------------------------------------------------------
     def run(self, program: Optional[Program] = None,
@@ -479,22 +497,13 @@ class Executor:
         state_keys = self._state_keys(program, scope)
         state = {k: scope.get(k) for k in state_keys}
 
-        sig = (id(program), program.version,
-               tuple(sorted((n, a.shape, str(a.dtype))
-                            for n, a in feed_arrays.items())),
-               tuple(fetch_names), tuple(sorted(state_keys)), is_test,
-               self.check_nan_inf)   # the flag changes the compiled fn's
-        #                              output arity (finite-flags dict)
-        entry = self._cache.get(sig)
-        fn = None
-        if entry is not None:
-            prog_ref, fn = entry
-            if prog_ref() is not program:   # id() reuse after GC
-                fn = None
+        fp = compile_cache.fingerprint_hex(self._entry_sig(
+            program, feed_arrays, fetch_names, state_keys, is_test))
+        fn = self._cache.get(fp, program)
         if fn is None:
             fn = self._build(program, sorted(feed_arrays), fetch_names,
-                             sorted(state_keys), is_test)
-            self._cache[sig] = (weakref.ref(program), fn)
+                             sorted(state_keys), is_test, fingerprint=fp)
+            self._cache.put(fp, fn, program)
 
         step = self._step
         self._step += 1
@@ -574,37 +583,16 @@ class Executor:
         state_keys = self._state_keys(program, scope)
         state = {k: scope.get(k) for k in state_keys}
 
-        sig = ("steps", id(program), program.version,
-               num_steps, feeds_stacked,
-               tuple(sorted((n, a.shape, str(a.dtype))
-                            for n, a in feed_arrays.items())),
-               tuple(fetch_names), tuple(sorted(state_keys)), is_test)
-        entry = self._cache.get(sig)
-        jfn = None
-        if entry is not None:
-            prog_ref, jfn = entry
-            if prog_ref() is not program:
-                jfn = None
+        fp = compile_cache.fingerprint_hex(self._entry_sig(
+            program, feed_arrays, fetch_names, state_keys, is_test,
+            steps=(num_steps, feeds_stacked)))
+        jfn = self._cache.get(fp, program)
         if jfn is None:
-            step_fn = self._make_fn(program, fetch_names, is_test)
-
-            def multi(feeds, st, step0):
-                def body(carry, xs):
-                    s, step = carry
-                    f = xs if feeds_stacked else feeds
-                    fetches, new_s = step_fn(f, s, step)
-                    return (new_s, step + 1), fetches
-
-                init = (st, jnp.asarray(step0, jnp.uint32))
-                if feeds_stacked:
-                    (s_out, _), ys = jax.lax.scan(body, init, feeds)
-                else:
-                    (s_out, _), ys = jax.lax.scan(body, init, None,
-                                                  length=num_steps)
-                return ys, s_out
-
-            jfn = self._build_steps(program, multi, feeds_stacked)
-            self._cache[sig] = (weakref.ref(program), jfn)
+            multi = self._make_multi(program, fetch_names, is_test,
+                                     num_steps, feeds_stacked)
+            jfn = self._build_steps(program, multi, feeds_stacked,
+                                    fingerprint=fp)
+            self._cache.put(fp, jfn, program)
 
         step0 = self._step
         self._step += num_steps
@@ -711,7 +699,32 @@ class Executor:
                                scope=scope, return_numpy=return_numpy,
                                is_test=is_test)
 
-    def _build_steps(self, program: Program, multi, feeds_stacked: bool):
+    def _make_multi(self, program: Program, fetch_names: List[str],
+                    is_test: bool, num_steps: int, feeds_stacked: bool):
+        """The K-step scan function run_steps compiles: a device-side
+        ``lax.scan`` over the per-step fn with donated state threading."""
+        step_fn = self._make_fn(program, fetch_names, is_test)
+
+        def multi(feeds, st, step0):
+            def body(carry, xs):
+                s, step = carry
+                f = xs if feeds_stacked else feeds
+                fetches, new_s = step_fn(f, s, step)
+                return (new_s, step + 1), fetches
+
+            init = (st, jnp.asarray(step0, jnp.uint32))
+            if feeds_stacked:
+                (s_out, _), ys = jax.lax.scan(body, init, feeds)
+            else:
+                (s_out, _), ys = jax.lax.scan(body, init, None,
+                                              length=num_steps)
+            return ys, s_out
+
+        multi.prog_cell = step_fn.prog_cell
+        return multi
+
+    def _build_steps(self, program: Program, multi, feeds_stacked: bool,
+                     fingerprint: Optional[str] = None):
         """jit wrapper for the K-step scan fn (ShardedExecutor overrides
         this to pin mesh shardings).  auto_layout executors route through
         _AutoLayoutStep — the shared format registry keeps run() and
@@ -724,9 +737,138 @@ class Executor:
         if self.auto_layout:
             return _AutoLayoutStep(multi, self._fmt_registry,
                                    self.compiler_options)
-        if self.compiler_options:
-            return _OptionsStep(multi, self.compiler_options)
-        return jax.jit(multi, donate_argnums=(1,))
+        return compile_cache.CachedStep(
+            multi, fingerprint, compiler_options=self.compiler_options,
+            label="run_steps")
+
+    # -- fingerprinting ------------------------------------------------------
+    def _config_sig(self):
+        """Executor-configuration component of every cache fingerprint —
+        everything on `self` that changes the traced computation."""
+        return (self.use_jit, self.amp, self.auto_layout,
+                str(self.compute_dtype), self.conv1x1_pallas,
+                tuple(sorted((k, repr(v))
+                             for k, v in self.compiler_options.items())))
+
+    def _fingerprint_extras(self, program: Program):
+        """Subclass hook: extra fingerprint components (ShardedExecutor
+        folds in mesh axes/devices and feed/param sharding specs)."""
+        return ()
+
+    def _entry_sig(self, program: Program, feed_arrays, fetch_names,
+                   state_keys, is_test: bool, steps=None):
+        """Structured cache signature for one compiled step variant.  The
+        program component is a CONTENT digest (ops/attrs/var shapes/dtypes/
+        random_seed via Program.to_dict), so the key is stable across
+        processes and shared by content-identical programs; x64 mode is
+        folded in because it changes every traced aval."""
+        head = ("run",) if steps is None else ("steps",) + tuple(steps)
+        return head + (
+            compile_cache.program_content_digest(program),
+            tuple(sorted((n, tuple(np.shape(a)), str(a.dtype))
+                         for n, a in feed_arrays.items())),
+            tuple(fetch_names), tuple(sorted(state_keys)), bool(is_test),
+            self.check_nan_inf,   # changes the compiled fn's output arity
+            bool(jax.config.jax_enable_x64),
+            self._config_sig(), self._fingerprint_extras(program))
+
+    # -- AOT -----------------------------------------------------------------
+    def compile(self, program: Optional[Program] = None,
+                feed: Optional[Dict[str, object]] = None,
+                fetch_list: Optional[Sequence] = None,
+                scope: Optional[Scope] = None,
+                is_test: bool = False,
+                num_steps: Optional[int] = None,
+                feeds_stacked: bool = False):
+        """Ahead-of-time compile ONE step variant and install it in the
+        executor's cache, so the matching :meth:`run` (or :meth:`run_steps`
+        when ``num_steps`` is given) executes without paying trace/lower/
+        compile at first-request time — the deploy-time analog of
+        ``jax.jit(...).lower().compile()``.
+
+        ``feed`` maps feed names to example arrays, ``(shape, dtype)``
+        tuples, or ``jax.ShapeDtypeStruct``s — only shapes/dtypes are read
+        (declared Program var dtypes override, exactly as ``run`` coerces
+        feeds).  For ``feeds_stacked=True`` the specs must carry the
+        leading ``num_steps`` axis, as ``run_steps`` receives them.
+
+        Call AFTER the startup program ran: the persistable state in
+        ``scope`` is part of the step signature.  Returns a
+        :class:`~paddle_tpu.core.compile_cache.CompiledProgram`.  With a
+        persistent cache directory set (``PADDLE_TPU_CACHE_DIR``), the
+        compiled executable is serialized for warm process starts.
+        """
+        from .program import default_main_program
+        if not self.use_jit:
+            raise ValueError("Executor.compile requires use_jit=True")
+        if self.auto_layout:
+            raise ValueError(
+                "Executor.compile: auto_layout compiles lazily (AUTO "
+                "layouts are chosen from concrete arrays); drop "
+                "auto_layout or warm up with a real first step")
+        if self.check_nan_inf and num_steps is not None:
+            raise ValueError("run_steps: check_nan_inf needs per-step host "
+                             "inspection")
+        if feeds_stacked and num_steps is None:
+            raise ValueError(
+                "Executor.compile: feeds_stacked=True requires num_steps "
+                "(stacked [K, ...] specs describe the run_steps scan "
+                "variant; without num_steps the single-step variant would "
+                "silently compile against the wrong shapes)")
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        scope = global_scope() if scope is None else scope
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_list]
+
+        gb = program.global_block()
+        feeds_abs: Dict[str, jax.ShapeDtypeStruct] = {}
+        for name, val in feed.items():
+            if isinstance(val, jax.ShapeDtypeStruct):
+                shape, dtype = tuple(val.shape), val.dtype
+            elif (isinstance(val, tuple) and len(val) == 2
+                    and not hasattr(val, "dtype")
+                    and isinstance(val[0], (tuple, list))):
+                shape, dtype = tuple(int(s) for s in val[0]), \
+                    np.dtype(val[1])
+            else:
+                a = val if isinstance(val, jax.Array) else np.asarray(val)
+                shape, dtype = tuple(a.shape), a.dtype
+            if gb.has_var(name):
+                dtype = jax.dtypes.canonicalize_dtype(gb.var(name).dtype)
+            feeds_abs[name] = jax.ShapeDtypeStruct(shape, dtype)
+
+        state_keys = self._state_keys(program, scope)
+        state_abs = {k: jax.ShapeDtypeStruct(
+            tuple(np.shape(scope.get(k))),
+            getattr(scope.get(k), "dtype", np.asarray(scope.get(k)).dtype))
+            for k in state_keys}
+
+        steps = None if num_steps is None else (num_steps, feeds_stacked)
+        fp = compile_cache.fingerprint_hex(self._entry_sig(
+            program, feeds_abs, fetch_names, state_keys, is_test,
+            steps=steps))
+        fn = self._cache.get(fp, program)
+        if fn is None:
+            if num_steps is None:
+                fn = self._build(program, sorted(feeds_abs), fetch_names,
+                                 sorted(state_keys), is_test, fingerprint=fp)
+            else:
+                multi = self._make_multi(program, fetch_names, is_test,
+                                         num_steps, feeds_stacked)
+                fn = self._build_steps(program, multi, feeds_stacked,
+                                       fingerprint=fp)
+            self._cache.put(fp, fn, program)
+        prepare = getattr(fn, "prepare", None)
+        if prepare is None:
+            raise ValueError("Executor.compile: this step variant does not "
+                             "support AOT preparation")
+        step = prepare(feeds_abs, state_abs, 0)
+        return compile_cache.CompiledProgram(
+            self, program, fp, step, fetch_names, state_keys,
+            num_steps=num_steps, feeds_stacked=feeds_stacked,
+            is_test=is_test)
 
     # -- internals ---------------------------------------------------------
     def _state_keys(self, program: Program, scope: Scope) -> List[str]:
@@ -749,7 +891,19 @@ class Executor:
             if scope_ref() is scope:
                 return keys
         keys = self._state_keys_uncached(program, scope)
-        cache["entries"][sk] = (weakref.ref(scope), keys)
+        entries = cache["entries"]
+        # sweep entries whose scope died (id-keyed dead pairs used to
+        # accumulate for the life of the program); misses are rare — once
+        # per new (scope, keys_version) — so the O(entries) deref is cheap
+        dead = [k for k, (ref, _) in entries.items() if ref() is None]
+        if dead:
+            for k in dead:
+                del entries[k]
+            compile_cache.stats().bump("state_keys_evictions", len(dead))
+        while len(entries) >= _STATE_KEYS_CACHE_MAX:   # then FIFO-bound
+            entries.pop(next(iter(entries)))
+            compile_cache.stats().bump("state_keys_evictions")
+        entries[sk] = (weakref.ref(scope), keys)
         return keys
 
     def _state_keys_uncached(self, program: Program,
@@ -771,21 +925,33 @@ class Executor:
         return keys
 
     def _build(self, program: Program, feed_names: List[str],
-               fetch_names: List[str], state_keys: List[str], is_test: bool):
+               fetch_names: List[str], state_keys: List[str], is_test: bool,
+               fingerprint: Optional[str] = None):
         fn = self._make_fn(program, fetch_names, is_test)
         if not self.use_jit:
             return fn
         if self.auto_layout:
             return _AutoLayoutStep(fn, self._fmt_registry,
                                    self.compiler_options)
-        if self.compiler_options:
-            return _OptionsStep(fn, self.compiler_options)
-        return jax.jit(fn, donate_argnums=(1,))
+        return compile_cache.CachedStep(
+            fn, fingerprint, compiler_options=self.compiler_options,
+            label="run")
 
     def _make_fn(self, program: Program, fetch_names: List[str],
                  is_test: bool):
         """The pure (feeds, state, step) -> (fetches, state') function the
-        jit wrappers compile (ShardedExecutor adds mesh shardings)."""
+        jit wrappers compile (ShardedExecutor adds mesh shardings).
+
+        The program is captured by WEAKREF: the traced function only needs
+        it while tracing (the interpreter walks its ops), and a strong
+        closure would pin every cached program for the life of the
+        Executor — the cache evicts entries whose programs died instead.
+        The ref lives in a mutable cell exposed as ``fn.prog_cell`` so the
+        cache can refresh it when a content-identical client Program hits
+        the entry (the fingerprint guarantees any client traces the same
+        computation); a re-trace after the original program died then uses
+        the live client instead of failing.
+        """
         persistable_names = sorted(
             {v.name for b in program.blocks for v in b.vars.values()
              if v.persistable})
@@ -801,10 +967,18 @@ class Executor:
 
         compute_dtype = self.compute_dtype
         conv1x1_pallas_opt = self.conv1x1_pallas
+        prog_cell = [weakref.ref(program)]
+        random_seed = program.random_seed
 
         def fn(feed_arrays, state, step):
+            program = prog_cell[0]()
+            if program is None:
+                raise RuntimeError(
+                    "compiled step traced after its Program was "
+                    "garbage-collected (cache entry outlived every "
+                    "client program)")
             base_key = jax.random.fold_in(
-                jax.random.PRNGKey(program.random_seed), step)
+                jax.random.PRNGKey(random_seed), step)
             env = Env(program.global_block())
             env.local.update(state)
             env.local.update(feed_arrays)
@@ -845,6 +1019,7 @@ class Executor:
                     new_state[k] = v.astype(old.dtype)
             return fetches, new_state
 
+        fn.prog_cell = prog_cell
         return fn
 
     def _nan_check(self, names, fetches):
@@ -963,36 +1138,6 @@ class _AutoLayoutStep:
             state = jax.tree.map(jax.device_put, state,
                                  self._state_formats)
             return self._compiled(feeds, state, step)
-
-
-class _OptionsStep:
-    """Jitted step compiled with explicit XLA compiler options (AOT
-    lower+compile path; plain ``jax.jit`` has no per-call options hook).
-    Specializations are cached per argument signature like jit would."""
-
-    def __init__(self, fn, compiler_options):
-        self._fn = fn
-        self._opts = dict(compiler_options)
-        self._cache = {}
-
-    @staticmethod
-    def _sig(feeds, state):
-        return (tuple(sorted((k, v.shape, str(v.dtype))
-                             for k, v in feeds.items()
-                             if hasattr(v, "shape"))),
-                tuple(sorted((k, v.shape, str(v.dtype))
-                             for k, v in state.items()
-                             if hasattr(v, "shape"))))
-
-    def __call__(self, feeds, state, step):
-        step = np.int64(step)
-        sig = self._sig(feeds, state)
-        comp = self._cache.get(sig)
-        if comp is None:
-            comp = jax.jit(self._fn, donate_argnums=(1,)).lower(
-                feeds, state, step).compile(compiler_options=self._opts)
-            self._cache[sig] = comp
-        return comp(feeds, state, step)
 
 
 def _nan_check_impl(names, fetches):

@@ -362,6 +362,15 @@ class Program:
     def _bump_version(self):
         self.version += 1
 
+    def content_digest(self) -> str:
+        """Stable content hash of the serialized program (ops, attrs, var
+        shapes/dtypes, random_seed) — the process-restart-proof component
+        of the Executor's compile-cache fingerprints.  Cached per
+        (version, random_seed); serialization cost is paid once per
+        mutation, not per step."""
+        from .compile_cache import program_content_digest
+        return program_content_digest(self)
+
     def next_seed(self) -> int:
         """Deterministic per-op seed allocator for random ops."""
         self._seed_counter += 1
@@ -385,6 +394,9 @@ class Program:
                 for op in b.ops:
                     if "is_test" in _TEST_SENSITIVE_OPS.get(op.type, ()):
                         op.attrs["is_test"] = True
+            # attr mutation above bypassed append_op: bump so version-keyed
+            # caches (content digest, state keys) can't serve stale entries
+            p._bump_version()
         return p
 
     def prune(self, targets: Sequence[Variable]) -> "Program":
@@ -416,6 +428,9 @@ class Program:
                 for sop in p.blocks[sub_idx].ops:
                     needed |= set(sop.input_names)
         gb.ops = list(reversed(kept))
+        # direct ops-list surgery bypassed append_op: bump so version-keyed
+        # caches (content digest, state keys) can't serve stale entries
+        p._bump_version()
         return p
 
     def to_dict(self):

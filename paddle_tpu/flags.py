@@ -74,6 +74,16 @@ define_flag("seq_bucket_multiple", 8,
 define_flag("init_model_path", "", "checkpoint dir to resume from "
             "(Flags.cpp:81)")
 define_flag("save_dir", "", "parameter save root (v1 --save_dir)")
+define_flag("cache_dir", "",
+            "persistent compilation-cache directory (PADDLE_TPU_CACHE_DIR); "
+            "empty = off.  Wires JAX's persistent compilation cache and "
+            "additionally stores serialized step executables + StableHLO "
+            "keyed by program fingerprint, so a fresh process with the same "
+            "program/config skips trace, lower AND compile "
+            "(core/compile_cache.py; see README 'Compilation cache')")
+define_flag("executor_cache_entries", 64,
+            "max compiled step variants held per Executor (LRU; evictions "
+            "and dead-program sweeps count into profiler.compile_stats())")
 define_flag("conv1x1_pallas", False,
             "route eligible 1x1 conv2d ops (groups=1, pad 0, dil 1, "
             "128-divisible dims) to the hand-written Pallas dot kernels "

@@ -17,12 +17,14 @@ Parallelism taxonomy (mesh axes, see parallel.mesh):
 """
 from __future__ import annotations
 
+import weakref
 from typing import Dict, Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import compile_cache
 from ..core.executor import Executor
 from ..core.program import Program
 from .mesh import get_mesh
@@ -96,83 +98,113 @@ class ShardedExecutor(Executor):
         with self.mesh:
             return super().run_steps(num_steps, program, feed=feed, **kw)
 
-    def _build_steps(self, program: Program, multi, feeds_stacked: bool):
-        """K-step scan with the same mesh shardings as the per-step path;
-        stacked feeds shard their PER-STEP dims (the leading steps axis
-        stays unsharded — it is scanned over, not distributed)."""
-        if not self.use_jit:
-            return multi
+    def compile(self, *args, **kw):
+        with self.mesh:
+            return super().compile(*args, **kw)
+
+    def _fingerprint_extras(self, program: Program):
+        """Mesh + sharding-spec fingerprint components: the same program/
+        feed signature compiled under a different mesh shape, device set,
+        batch axis or spec override is a different executable."""
+        mesh = self.mesh
+        return ("mesh", tuple(mesh.axis_names),
+                tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+                tuple(str(d) for d in np.ravel(mesh.devices)),
+                self.batch_axis, self.num_microbatches,
+                tuple(sorted((k, repr(v))
+                             for k, v in self.feed_specs.items())),
+                tuple(sorted((k, repr(v))
+                             for k, v in self.param_specs.items())))
+
+    def _state_shardings(self, program: Program, state):
+        """Pin only explicitly-annotated params; None leaves let jit keep
+        whatever sharding GSPMD propagated onto the arrays (replicated
+        params stay replicated, derived accumulators keep their layout)."""
+        state_sh = {}
+        for k in state:
+            spec = self.param_specs.get(k)
+            if spec is None:
+                v = self._find_var(program, k)
+                if v is not None and getattr(v, "sharding", None):
+                    spec = P(*v.sharding)
+            state_sh[k] = NamedSharding(self.mesh, spec) \
+                if spec is not None else None
+        return state_sh
+
+    def _sharded_wrapper(self, program: Program, fn, fingerprint, label,
+                         feeds_stacked=None):
+        """Shared jit wrapper: one CachedStep per argument-name set, with
+        mesh shardings pinned on the inputs.  The outer fingerprint already
+        covers shapes/dtypes/specs, so in practice each wrapper holds
+        exactly one step; the dict guards name-set drift.  ``feeds_stacked``
+        None means the per-step path; True/False the K-step scan (stacked
+        feeds shard their PER-STEP dims — the leading steps axis is scanned
+        over, not distributed).
+
+        The Program is resolved through the step fn's refreshable weakref
+        cell (executor._make_fn) rather than captured strongly: a strong
+        closure here would defeat ExecCache's dead-program sweeping for
+        every sharded entry."""
         mesh = self.mesh
         jitted = {}
+        prog_cell = getattr(fn, "prog_cell", None) or \
+            [weakref.ref(program)]
 
-        def wrapper(feed_arrays, state, step0):
+        def get_step(feed_arrays, state):
             key = (tuple(sorted(feed_arrays)), tuple(sorted(state)))
             if key not in jitted:
+                program = prog_cell[0]()
+                if program is None:
+                    raise RuntimeError(
+                        "sharded step built after its Program was "
+                        "garbage-collected (cache entry outlived every "
+                        "client program)")
                 lead = 1 if feeds_stacked else 0
                 feed_sh = {}
                 for n, a in feed_arrays.items():
-                    spec = self._feed_spec(program, n, np.ndim(a) - lead,
-                                           shape=np.shape(a)[lead:])
+                    spec = self._feed_spec(
+                        program, n, len(np.shape(a)) - lead,
+                        shape=tuple(np.shape(a))[lead:])
                     if feeds_stacked:
                         spec = P(None, *spec)
                     feed_sh[n] = NamedSharding(mesh, spec)
-                state_sh = {}
-                for k in state:
-                    spec = self.param_specs.get(k)
-                    if spec is None:
-                        v = self._find_var(program, k)
-                        if v is not None and getattr(v, "sharding", None):
-                            spec = P(*v.sharding)
-                    state_sh[k] = NamedSharding(mesh, spec) \
-                        if spec is not None else None
-                jitted[key] = jax.jit(
-                    multi, in_shardings=(feed_sh, state_sh, None),
-                    donate_argnums=(1,))
-            return jitted[key](feed_arrays, state, step0)
+                # out_shardings stay unspecified: the produced state set can
+                # exceed the fed state (first step materializes
+                # accumulators) and GSPMD keeps params on input shardings.
+                jitted[key] = compile_cache.CachedStep(
+                    fn, fingerprint,
+                    compiler_options=self.compiler_options,
+                    in_shardings=(feed_sh,
+                                  self._state_shardings(program, state),
+                                  None),
+                    label=label)
+            return jitted[key]
 
+        def wrapper(feed_arrays, state, step):
+            return get_step(feed_arrays, state)(feed_arrays, state, step)
+
+        wrapper.prog_cell = prog_cell
+        # AOT hook for Executor.compile: prepare (and return) the inner
+        # CachedStep from abstract avals
+        wrapper.prepare = lambda feeds, state, step: \
+            get_step(feeds, state).prepare(feeds, state, step)
         return wrapper
 
+    def _build_steps(self, program: Program, multi, feeds_stacked: bool,
+                     fingerprint=None):
+        if not self.use_jit:
+            return multi
+        return self._sharded_wrapper(program, multi, fingerprint,
+                                     "sharded_run_steps",
+                                     feeds_stacked=feeds_stacked)
+
     def _build(self, program: Program, feed_names, fetch_names,
-               state_keys, is_test):
+               state_keys, is_test, fingerprint=None):
         fn = self._make_fn(program, fetch_names, is_test)
         if not self.use_jit:
             return fn
-        mesh = self.mesh
-
-        def shardings_for_call(feed_arrays, state):
-            feed_sh = {n: NamedSharding(mesh, self._feed_spec(
-                program, n, np.ndim(a), shape=np.shape(a)))
-                for n, a in feed_arrays.items()}
-            # Pin only explicitly-annotated params; None leaves let jit keep
-            # whatever sharding GSPMD propagated onto the arrays (replicated
-            # params stay replicated, derived accumulators keep their layout).
-            state_sh = {}
-            for k in state:
-                spec = self.param_specs.get(k)
-                if spec is None:
-                    v = self._find_var(program, k)
-                    if v is not None and getattr(v, "sharding", None):
-                        spec = P(*v.sharding)
-                state_sh[k] = NamedSharding(mesh, spec) if spec is not None \
-                    else None
-            return feed_sh, state_sh
-
-        jitted = {}
-
-        def wrapper(feed_arrays, state, step):
-            key = (tuple(sorted(feed_arrays)), tuple(sorted(state)))
-            if key not in jitted:
-                feed_sh, state_sh = shardings_for_call(feed_arrays, state)
-                # out_shardings stay unspecified: the produced state set can
-                # exceed the fed state (first step materializes accumulators)
-                # and GSPMD propagation keeps params on their input shardings.
-                jitted[key] = jax.jit(
-                    fn,
-                    in_shardings=(feed_sh, state_sh, None),
-                    donate_argnums=(1,))
-            return jitted[key](feed_arrays, state, step)
-
-        return wrapper
+        return self._sharded_wrapper(program, fn, fingerprint,
+                                     "sharded_run")
 
     def place_state(self, program: Program, scope=None):
         """Pre-place persistable scope entries with their specs (params get

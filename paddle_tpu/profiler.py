@@ -74,6 +74,25 @@ def timer(name: str):
         yield
 
 
+# ---------------------------------------------------------------------------
+# Compile-time telemetry (core/compile_cache.py)
+# ---------------------------------------------------------------------------
+def compile_stats():
+    """The global :class:`~paddle_tpu.core.compile_cache.CompileStats`:
+    per-fingerprint trace/lower/compile wall times, cache hit/miss/evict
+    counters, and the retrace detector
+    (``compile_stats().assert_no_retrace()``).  The compile-time analog of
+    :func:`global_stat` — a cold start's cost lives here, not in step
+    timers."""
+    from .core import compile_cache
+    return compile_cache.stats()
+
+
+def compile_report() -> str:
+    """Human-readable compile telemetry (StatSet-style report)."""
+    return compile_stats().report()
+
+
 class StepTimer:
     """Per-step wall-clock with warmup discard, for benchmarks."""
 

@@ -69,7 +69,8 @@ class SGD:
     def train(self, reader: Callable, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
               feeding=None, feed_list: Optional[Sequence[Variable]] = None,
-              steps_per_dispatch: int = 1, pipeline=False):
+              steps_per_dispatch: int = 1, pipeline=False,
+              warmup: bool = False):
         """reader yields batches (lists of rows); feeding maps data-layer
         names to row positions (v2 trainer.py feeding) or pass feed_list.
 
@@ -93,12 +94,24 @@ class SGD:
         (staged dispatches in flight, default 2).  Step math is identical
         to the per-batch loop; only event timing changes (events for a
         dispatch fire after it completes).
+
+        ``warmup=True`` pays trace/lower/compile BEFORE the training loop
+        starts: one batch is peeked from ``reader`` (for its shapes only)
+        and the step variant(s) this loop will dispatch are compiled ahead
+        of time (``Executor.compile``), so the first real batch executes a
+        ready executable.  With a persistent cache directory set
+        (``PADDLE_TPU_CACHE_DIR``), warmup in a deploy step also persists
+        the executables for later processes.  Bucketed readers whose later
+        batches change shape still compile those variants on first use.
         """
         event_handler = event_handler or (lambda e: None)
         if not self._initialized:
             self.exe.run(default_startup_program(), feed={}, fetch_list=[])
             self._initialized = True
         fetch = [self.cost] + self.extra
+        if warmup:
+            self._warmup(reader, feeding, feed_list, fetch,
+                         steps_per_dispatch, pipeline)
 
         def emit_end(pass_id, batch_id, out):
             metrics = {getattr(v, "name", str(i)): out[1 + i]
@@ -108,9 +121,7 @@ class SGD:
 
         if pipeline:
             opts = dict(pipeline) if isinstance(pipeline, dict) else {}
-            K = int(opts.get("steps_per_dispatch",
-                             steps_per_dispatch if steps_per_dispatch > 1
-                             else 8))
+            K = self._dispatch_k(opts, steps_per_dispatch)
             workers = int(opts.get("num_workers", 1))
             buf = int(opts.get("buffer_size", 4))
             depth = int(opts.get("prefetch_depth", 2))
@@ -202,6 +213,39 @@ class SGD:
         return [t / count for t in totals]
 
     # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _dispatch_k(opts, steps_per_dispatch):
+        """Steps per pipelined dispatch — ONE derivation shared by the
+        train loop and _warmup, so warmup always AOT-compiles the exact
+        scan variant the loop will dispatch."""
+        return int(opts.get("steps_per_dispatch",
+                            steps_per_dispatch if steps_per_dispatch > 1
+                            else 8))
+
+    def _warmup(self, reader, feeding, feed_list, fetch,
+                steps_per_dispatch, pipeline):
+        """AOT-compile the step variant(s) the configured loop will use,
+        from the shapes of one peeked batch (the batch itself is NOT
+        consumed from the training stream — readers are re-callable)."""
+        probe = next(iter(reader()), None)
+        if probe is None:
+            return
+        feed0 = self._feeder(feeding, feed_list).feed(probe)
+        if pipeline:
+            opts = dict(pipeline) if isinstance(pipeline, dict) else {}
+            K = self._dispatch_k(opts, steps_per_dispatch)
+        else:
+            K = steps_per_dispatch
+        # single-step variant: the per-batch path, and the tail/signature-
+        # change fallback of the chunked paths
+        self.exe.compile(self.main_program, feed=feed0, fetch_list=fetch)
+        if K > 1:
+            from .core.executor import stack_feeds
+            self.exe.compile(self.main_program,
+                             feed=stack_feeds([feed0] * K),
+                             fetch_list=fetch, num_steps=K,
+                             feeds_stacked=True)
+
     def _feeder(self, feeding, feed_list, staging_slots: int = 0):
         if feed_list is None:
             gb = self.main_program.global_block()

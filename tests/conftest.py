@@ -22,6 +22,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: full benchmark A/Bs (minutes); deselect with -m 'not slow'")
+
+
 @pytest.fixture(autouse=True)
 def fresh_state():
     """Fresh default programs/scope/name-counters per test."""

@@ -1,0 +1,441 @@
+"""Compile-cache subsystem (core/compile_cache.py): fingerprint-keyed
+executor caching, retrace detection, LRU/weakref eviction, the persistent
+on-disk executable cache, AOT ``Executor.compile`` and
+``Trainer.train(warmup=...)``.
+
+The retrace contract under test: ONE jit trace per (program content, feed
+signature, executor config) — repeated ``run``/``run_steps``/
+``run_pipelined`` calls must never re-pay trace/lower/compile, while any
+fingerprint ingredient changing (program mutation, feed dtype, mesh, amp,
+compiler options) must cost exactly one new trace.
+"""
+import gc
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import compile_cache
+from paddle_tpu.core.compile_cache import (ExecCache, RetraceError,
+                                           retrace_guard)
+from paddle_tpu.core.program import Program, program_guard
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    """Per-test telemetry isolation + persistent-cache knob restore."""
+    compile_cache.stats().reset()
+    yield
+    pt.flags.set_flag("cache_dir", "")
+    compile_cache.stats().reset()
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    """Point the persistent layer at a tmp dir for one test."""
+    d = tmp_path / "ptcache"
+    pt.flags.set_flag("cache_dir", str(d))
+    return d
+
+
+def _build_net(rng, seed=0):
+    """Small classifier; returns (loss, feed)."""
+    pt.default_main_program().random_seed = seed
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    pred = layers.fc(x, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    pt.optimizer.SGD(0.1).minimize(loss)
+    feed = {"x": rng.rand(8, 4).astype("float32"),
+            "y": rng.randint(0, 3, (8, 1))}
+    return loss, feed
+
+
+def _traces():
+    return compile_cache.stats().snapshot().get("traces", 0)
+
+
+# ---------------------------------------------------------------------------
+# retrace detector
+# ---------------------------------------------------------------------------
+def test_exactly_one_trace_per_signature(rng):
+    loss, feed = _build_net(rng)
+    exe = pt.Executor()
+    with retrace_guard():
+        exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+        for _ in range(4):
+            exe.run(feed=feed, fetch_list=[loss])
+        for _ in range(2):
+            exe.run_steps(3, feed=feed, fetch_list=[loss])
+    # startup + run variant + run_steps variant
+    assert _traces() == 3
+    compile_cache.stats().assert_no_retrace()
+
+
+def test_exactly_one_trace_run_pipelined(rng):
+    loss, feed = _build_net(rng)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    t0 = _traces()
+
+    def feed_iter():
+        for _ in range(10):
+            yield dict(feed)
+
+    with retrace_guard():
+        outs = list(exe.run_pipelined(feed_iter(), fetch_list=[loss],
+                                      steps_per_dispatch=4))
+        outs += list(exe.run_pipelined(feed_iter(), fetch_list=[loss],
+                                       steps_per_dispatch=4))
+    assert len(outs) == 20
+    # one scan variant + one per-step tail variant, traced once EACH
+    # across BOTH pipelined sweeps
+    assert _traces() - t0 == 2
+    compile_cache.stats().assert_no_retrace()
+
+
+def test_one_new_trace_on_program_mutation(rng):
+    loss, feed = _build_net(rng)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    exe.run(feed=feed, fetch_list=[loss])
+    t0 = _traces()
+    layers.mean(loss)                       # version bump, content change
+    exe.run(feed=feed, fetch_list=[loss])
+    exe.run(feed=feed, fetch_list=[loss])
+    assert _traces() - t0 == 1
+
+
+def test_one_new_trace_on_feed_dtype_change(rng):
+    loss, feed = _build_net(rng)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    exe.run(feed=feed, fetch_list=[loss])
+    t0 = _traces()
+    # "y" declared int64 is dtype-coerced by run(); vary the UNDECLARED
+    # feed precision instead: shape change on x is a new signature
+    feed2 = dict(feed, x=feed["x"][:4])
+    feed2["y"] = feed["y"][:4]
+    exe.run(feed=feed2, fetch_list=[loss])
+    exe.run(feed=feed2, fetch_list=[loss])
+    assert _traces() - t0 == 1
+
+
+def test_retrace_guard_fires_on_cache_clear(rng):
+    loss, feed = _build_net(rng)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    with pytest.raises(RetraceError):
+        with retrace_guard():
+            exe.run(feed=feed, fetch_list=[loss])
+            exe._cache.clear()              # force the pathology
+            exe.run(feed=feed, fetch_list=[loss])
+
+
+# ---------------------------------------------------------------------------
+# fingerprint ingredients
+# ---------------------------------------------------------------------------
+def test_fingerprint_invalidation_matrix(rng):
+    """Program mutation, feed dtype, amp, compiler options and mesh each
+    change the signature; a no-op rebuild does not."""
+    from paddle_tpu.parallel import MeshConfig, ShardedExecutor, make_mesh
+
+    loss, feed = _build_net(rng)
+    prog = pt.default_main_program()
+    exe = pt.Executor()
+
+    def sig(e, feeds=feed, p=None):
+        import jax
+        # mirror run()'s feed normalization: declared dtypes are coerced
+        # BEFORE the signature is computed
+        p = p or prog
+        gb = p.global_block()
+        fa = {}
+        for k, v in feeds.items():
+            arr = np.asarray(v)
+            if gb.has_var(k):
+                want = jax.dtypes.canonicalize_dtype(gb.var(k).dtype)
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            fa[k] = arr
+        return e._entry_sig(p, fa, [loss.name], [], False)
+
+    base = sig(exe)
+    assert sig(exe) == base                               # stable
+    assert sig(pt.Executor()) == base                     # executor-independent
+    assert sig(pt.Executor(amp=True)) != base
+    assert sig(pt.Executor(check_nan_inf=True)) != base
+    assert sig(pt.Executor(compute_dtype="float64")) != base
+    assert sig(pt.Executor(
+        compiler_options={"xla_cpu_enable_fast_math": True})) != base
+
+    f32 = dict(feed, x=feed["x"].astype("float64"))
+    # x declared float32: coerced, same signature; an UNdeclared feed
+    # keeps its dtype and must differ
+    assert sig(exe, feeds=f32) == base
+    extra = dict(feed, z=np.zeros(3, "int32"))
+    assert sig(exe, feeds=extra) != base
+    assert sig(exe, feeds=dict(
+        feed, z=np.zeros(3, "int64"))) != sig(exe, feeds=extra)
+
+    layers.mean(loss)                                     # content change
+    assert sig(exe) != base
+
+    m8 = make_mesh(MeshConfig(dp=8))
+    m4 = make_mesh(MeshConfig(dp=4), devices=__import__("jax").devices()[:4])
+    s8, s4 = ShardedExecutor(mesh=m8), ShardedExecutor(mesh=m4)
+    assert sig(s8) != sig(exe)                            # mesh folded in
+    assert sig(s8) != sig(s4)                             # mesh shape/devices
+    assert sig(ShardedExecutor(
+        mesh=m8, param_specs={"w": ("dp",)})) != sig(s8)  # specs folded in
+
+
+def test_content_identical_programs_share_entry(rng):
+    """prune().clone(for_test=True) inference slices built per call (the
+    trainer.test pattern) hit ONE cache entry instead of recompiling."""
+    loss, feed = _build_net(rng)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    main = pt.default_main_program()
+    t0 = _traces()
+    with retrace_guard():
+        for _ in range(3):
+            test_prog = main.prune([loss]).clone(for_test=True)
+            exe.run(test_prog, feed=feed, fetch_list=[loss], is_test=True)
+    assert _traces() - t0 == 1
+
+
+def test_shared_entry_retargets_to_live_client(rng):
+    """A shared entry's step fn must not depend on its CREATOR program
+    staying alive: when a content-identical client hits the entry, the
+    fn's program weakref cell retargets to the client, so a later
+    re-trace (lazy-jit fallback, auto_layout re-jit) uses the live
+    program instead of raising."""
+    loss, feed = _build_net(rng)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    main = pt.default_main_program()
+    first = main.prune([loss]).clone(for_test=True)
+    exe.run(first, feed=feed, fetch_list=[loss], is_test=True)
+    second = main.prune([loss]).clone(for_test=True)
+    exe.run(second, feed=feed, fetch_list=[loss], is_test=True)
+    del first
+    gc.collect()
+    (entry,) = [e for e in exe._cache._od.values()
+                if any(r() is second for r in e.prog_refs)]
+    assert not entry.dead()
+    cell = entry._prog_cell()
+    assert cell is not None and cell[0]() is second
+
+
+def test_clone_and_prune_bump_version(rng):
+    loss, _ = _build_net(rng)
+    main = pt.default_main_program()
+    d0 = main.content_digest()
+    pruned = main.prune([loss])
+    assert pruned.content_digest() != d0       # ops changed, digest follows
+    cloned = main.clone(for_test=True)
+    assert cloned.version > main.version
+    assert main.content_digest() == d0         # original untouched
+    main.random_seed += 1                      # mutates without a bump
+    assert main.content_digest() != d0         # digest cache keyed on seed
+
+
+# ---------------------------------------------------------------------------
+# eviction: LRU bound + dead-program sweeping
+# ---------------------------------------------------------------------------
+def test_lru_bound_and_eviction_counter(rng):
+    loss, feed = _build_net(rng)
+    exe = pt.Executor()
+    exe._cache = ExecCache(max_entries=2)
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    for n in (8, 6, 4, 2):                     # distinct feed signatures
+        exe.run(feed={k: v[:n] for k, v in feed.items()},
+                fetch_list=[loss])
+    assert len(exe._cache) == 2
+    assert exe._cache.evictions >= 3           # startup + older variants
+    assert compile_cache.stats().snapshot()["evictions"] >= 3
+
+
+def test_dead_program_entries_swept(rng):
+    exe = pt.Executor()
+
+    def one_shot(i):
+        with program_guard(Program(), Program()):
+            x = layers.data("x", shape=[4], dtype="float32")
+            out = layers.fc(x, size=2 + i)
+            prog = pt.default_main_program()
+            exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+            exe.run(prog, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[out], is_test=True)
+
+    one_shot(0)
+    n_live = len(exe._cache)
+    assert n_live >= 1
+    gc.collect()                               # programs now unreachable
+    exe._cache.sweep()
+    assert len(exe._cache) == 0
+    assert exe._cache.evictions >= n_live
+    # sweeping also happens implicitly on the next put
+    one_shot(1)
+    assert len(exe._cache) <= 4
+
+
+def test_state_keys_cache_swept(rng):
+    """Dead (scope, keys_version) pairs no longer accumulate unboundedly."""
+    from paddle_tpu.core.executor import _STATE_KEYS_CACHE_MAX
+    loss, feed = _build_net(rng)
+    exe = pt.Executor()
+    prog = pt.default_main_program()
+    for _ in range(_STATE_KEYS_CACHE_MAX + 10):
+        sc = pt.core.Scope()
+        with pt.core.scope_guard(sc):
+            exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+            exe.run(prog, feed=feed, fetch_list=[loss], scope=sc)
+        del sc
+        gc.collect()
+    entries = prog._state_keys_cache["entries"]
+    assert len(entries) <= _STATE_KEYS_CACHE_MAX + 1
+    assert compile_cache.stats().snapshot().get(
+        "state_keys_evictions", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# AOT: Executor.compile / CompiledProgram / Trainer warmup
+# ---------------------------------------------------------------------------
+def test_executor_compile_then_run_no_retrace(rng):
+    loss, feed = _build_net(rng)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    cp = exe.compile(feed=feed, fetch_list=[loss])
+    assert cp.compile_times.get("compile_s", 0) > 0
+    t0 = _traces()
+    with retrace_guard():
+        (v1,) = exe.run(feed=feed, fetch_list=[loss])
+        (v2,) = cp.run(feed=feed)
+    assert _traces() == t0                     # AOT paid the trace already
+    assert np.isfinite(v1) and np.isfinite(v2)
+
+
+def test_executor_compile_spec_feed_and_steps(rng):
+    """(shape, dtype) specs compile the same variant concrete feeds hit;
+    num_steps compiles the scan variant."""
+    loss, feed = _build_net(rng)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    exe.compile(feed={"x": ((8, 4), "float32"), "y": ((8, 1), "int64")},
+                fetch_list=[loss])
+    cp = exe.compile(
+        feed={"x": ((4, 8, 4), "float32"), "y": ((4, 8, 1), "int64")},
+        fetch_list=[loss], num_steps=4, feeds_stacked=True)
+    t0 = _traces()
+    with retrace_guard():
+        exe.run(feed=feed, fetch_list=[loss])
+        from paddle_tpu.core.executor import stack_feeds
+        exe.run_steps(4, feed=stack_feeds([feed] * 4), fetch_list=[loss],
+                      feeds_stacked=True)
+    assert _traces() == t0
+    assert cp.num_steps == 4
+
+
+def test_trainer_warmup(rng):
+    from paddle_tpu import trainer
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    pred = layers.fc(x, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    rows = [(rng.rand(4).astype("float32"), int(rng.randint(3)))
+            for _ in range(32)]
+
+    def reader():
+        for i in range(0, 32, 8):
+            yield rows[i:i + 8]
+
+    t = trainer.SGD(loss, update_equation=pt.optimizer.SGD(0.1))
+    t.train(reader, num_passes=1, feed_list=[x, y], warmup=True,
+            steps_per_dispatch=2)
+    t_after_warm_pass = _traces()
+    with retrace_guard():                      # second pass: all cached
+        t.train(reader, num_passes=1, feed_list=[x, y],
+                steps_per_dispatch=2)
+    assert _traces() == t_after_warm_pass
+
+
+def test_sharded_compile_aot(rng):
+    from paddle_tpu.parallel import MeshConfig, ShardedExecutor, make_mesh
+    loss, feed = _build_net(rng)
+    exe = ShardedExecutor(mesh=make_mesh(MeshConfig(dp=8)))
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    exe.compile(feed=feed, fetch_list=[loss])
+    t0 = _traces()
+    with retrace_guard():
+        (v,) = exe.run(feed=feed, fetch_list=[loss])
+    assert _traces() == t0
+    assert np.isfinite(v)
+
+
+# ---------------------------------------------------------------------------
+# persistent on-disk layer
+# ---------------------------------------------------------------------------
+def test_persistent_cache_roundtrip(rng, cache_dir):
+    """A second Executor (fresh in-process cache, same persistent dir)
+    loads the serialized executable instead of tracing, and its fetches
+    are bit-identical."""
+    loss, feed = _build_net(rng)
+    exe1 = pt.Executor()
+    exe1.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    (v1,) = exe1.run(feed=feed, fetch_list=[loss])
+    snap = compile_cache.stats().snapshot()
+    assert snap["disk_stores"] >= 2            # startup + step executables
+    assert any(p.name.startswith("ptxc-") for p in cache_dir.iterdir())
+
+    # fresh executor, params reset to the same init by re-running startup
+    pt.core.reset_global_scope()
+    exe2 = pt.Executor()
+    t0 = _traces()
+    exe2.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    (v2,) = exe2.run(feed=feed, fetch_list=[loss])
+    snap2 = compile_cache.stats().snapshot()
+    assert _traces() == t0                     # zero traces: disk served both
+    assert snap2["disk_hits"] - snap.get("disk_hits", 0) >= 2
+    assert v1.tobytes() == v2.tobytes()
+
+
+def test_persistent_cache_corrupt_entry_recompiles(rng, cache_dir):
+    loss, feed = _build_net(rng)
+    exe1 = pt.Executor()
+    exe1.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    exe1.run(feed=feed, fetch_list=[loss])
+    for p in cache_dir.iterdir():
+        if p.name.startswith("ptxc-"):
+            p.write_bytes(b"corrupt")
+    pt.core.reset_global_scope()
+    exe2 = pt.Executor()
+    exe2.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    (v,) = exe2.run(feed=feed, fetch_list=[loss])   # recompiles, no crash
+    assert np.isfinite(v)
+
+
+# ---------------------------------------------------------------------------
+# benchmark wiring (satellite: tier-1 smoke; full A/B is slow)
+# ---------------------------------------------------------------------------
+def test_benchmark_smoke_cold_warm_subprocesses():
+    """benchmark/run.py --model compile_cache --smoke: two fresh
+    subprocesses share a tmp cache; asserts the warm arm loads executables
+    (zero traces) and produces bit-identical fetches."""
+    from benchmark.compile_cache import run_smoke
+    row = run_smoke()
+    assert row["bit_identical"]
+    assert row["warm_traces"] == 0
+
+
+@pytest.mark.slow
+def test_benchmark_full_ab_models():
+    """Full cold-vs-warm A/B on the three real models (minutes)."""
+    from benchmark.compile_cache import MODELS, run_model
+    rows = [run_model(m, quiet=True) for m in MODELS]
+    assert all(r["bit_identical"] for r in rows)
+    fast = [r for r in rows if r["speedup_engine"] >= 1.5]
+    assert len(fast) >= 2, [
+        (r["model"], r["speedup_engine"]) for r in rows]

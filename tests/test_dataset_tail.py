@@ -236,7 +236,11 @@ def test_voc2012_ssd_demo():
 
 def test_mq2007_rank_demo():
     """demo/rank: pairwise rank_loss on mq2007 features learns to order."""
-    pairs = list(mq2007.train()())[:256]
+    # param init lives in the STARTUP program; pin its seed so the learned
+    # scorer (and the held-out frac below) is one deterministic number per
+    # jax PRNG implementation, not a draw
+    pt.default_startup_program().random_seed = 0
+    pairs = list(mq2007.train()())          # 2237 synthetic pairs
     hi = np.stack([p[1] for p in pairs]).astype("float32")
     lo = np.stack([p[2] for p in pairs]).astype("float32")
     left = layers.data("left", shape=[46], dtype="float32")
@@ -248,16 +252,22 @@ def test_mq2007_rank_demo():
     loss = layers.mean(layers.rank_loss(lab, sl, sr))
 
     def feeds(i):
-        s = (i * 64) % 192
+        s = (i * 64) % 384
         return {"left": hi[s:s + 64], "right": lo[s:s + 64],
                 "lab": np.ones((64, 1), "float32")}
 
-    vals = _train_steps(loss, feeds, steps=15, lr=0.5)
+    vals = _train_steps(loss, feeds, steps=30, lr=0.5)
     assert vals[-1] < vals[0]
-    # the learned scorer ranks held-out hi above lo most of the time
+    # the learned scorer ranks held-out hi above lo most of the time.
+    # Threshold: with n=1853 held-out pairs the random-ranking null is
+    # frac ~ N(0.5, 0.5/sqrt(1853) ≈ 0.012), so 0.7 is >17σ above chance;
+    # the seeded run measures 0.820 here and every nearby init seed lands
+    # ≥ 0.74, so 0.7 flags real ranking regressions without sitting on the
+    # measured value (the old 64-pair eval read 0.594 against a 0.6 bar —
+    # chance-level noise of ±0.0625 with the bound inside it).
     wv = np.asarray(pt.global_scope().get("rank_w"))
-    frac = float(np.mean((hi[192:] @ wv) > (lo[192:] @ wv)))
-    assert frac > 0.6
+    frac = float(np.mean((hi[384:] @ wv) > (lo[384:] @ wv)))
+    assert frac > 0.7
 
 
 def test_sentiment_classifier_demo():
