@@ -12,6 +12,15 @@ settings(), and runs the requested job on the TPU runtime:
              (compile+warmup), one timed window, ms/batch
   checkgrad  numeric-vs-autodiff gradient check on the config's cost
 
+``python -m paddle_tpu check prog.json`` is the subcommand form of the
+static program verifier (paddle_tpu.analysis): it loads a serialized
+program — ``Program.to_json`` output, a ``save_inference_model``
+``__model__`` meta, or a directory containing one — runs all passes, and
+prints the ``PT0xx`` report (exit 1 on errors, and on warnings too with
+``--strict``).  ``--mesh dp=8,mp=2`` enables the sharding lints; with a
+v1 config (``check --config conf.py``) it verifies the built main and
+startup programs instead.
+
 Feeds come from ``--feed-npz`` (named arrays matching the config's data
 layers, with ``name@LEN`` companions for sequences); ``time`` and
 ``checkgrad`` synthesize random feeds from the declared shapes when none
@@ -227,10 +236,115 @@ def job_checkgrad(cfg, exe, feeds, args, eps=1e-4, rtol=1e-3):
     return 0 if failures == 0 else 1
 
 
+def _parse_mesh(s: Optional[str]) -> Optional[Dict[str, int]]:
+    """'dp=8,mp=2' -> {'dp': 8, 'mp': 2} for the sharding lints."""
+    if not s:
+        return None
+    out: Dict[str, int] = {}
+    for kv in s.split(","):
+        k, _, v = kv.partition("=")
+        try:
+            size = int(v)
+        except ValueError:
+            raise SystemExit(f"--mesh: bad axis entry {kv!r} "
+                             f"(want name=size,...)")
+        if size < 1:
+            # size <= 1 axes are skipped by the divisibility lints, so a
+            # typo'd dp=0 would silently validate nothing and PASS
+            raise SystemExit(f"--mesh: axis size must be >= 1, got {kv!r}")
+        k = k.strip()
+        if k in out:
+            # dp=8,dp=2 (typo for dp=8,mp=2) would silently lint against
+            # the last size only
+            raise SystemExit(f"--mesh: duplicate axis {k!r}")
+        out[k] = size
+    return out
+
+
+def _load_check_target(path: str):
+    """(program, fetch_names) from a program JSON / __model__ meta / dir."""
+    from paddle_tpu.core.program import Program
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "__model__")
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except OSError as e:
+        raise SystemExit(f"check: cannot read program {path!r}: {e}")
+    except json.JSONDecodeError as e:
+        raise SystemExit(f"check: {path!r} is not a program JSON "
+                         f"(Program.to_json or save_inference_model "
+                         f"__model__): {e}")
+    try:
+        if "program" in d:     # save_inference_model meta
+            return Program.from_dict(d["program"]), d.get("fetch_var_names")
+        return Program.from_dict(d), None
+    except (KeyError, TypeError, ValueError) as e:
+        raise SystemExit(f"check: {path!r} does not deserialize as a "
+                         f"Program: {type(e).__name__}: {e}")
+
+
+def job_check(argv):
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu check",
+        description="static program verifier: shape/dtype inference, "
+                    "well-formedness and graph lints with stable PT0xx "
+                    "codes (the desc-layer InferShape analog; see "
+                    "paddle_tpu.analysis)")
+    ap.add_argument("program", nargs="?", default=None,
+                    help="Program.to_json file, save_inference_model "
+                         "__model__ meta, or a directory containing one")
+    ap.add_argument("--config", default=None,
+                    help="verify a v1 config's built programs instead")
+    ap.add_argument("--config_args", default=None,
+                    help="k=v,... forwarded to get_config_arg")
+    ap.add_argument("--mesh", default=None,
+                    help="axis=size,... — enables the sharding lints "
+                         "(PT030/PT031) against this mesh")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings too")
+    args = ap.parse_args(argv)
+    if (args.program is None) == (args.config is None):
+        ap.error("give exactly one of a program file or --config")
+
+    mesh = _parse_mesh(args.mesh)
+    targets = []                 # (label, program, fetch_list)
+    if args.config is not None:
+        from paddle_tpu.trainer_config_helpers import load_v1_config
+        cfg = load_v1_config(args.config,
+                             **_parse_config_args(args.config_args))
+        targets.append(("main", cfg.main_program, cfg.outputs))
+        targets.append(("startup", cfg.startup_program, None))
+    else:
+        program, fetch_names = _load_check_target(args.program)
+        targets.append((args.program, program, fetch_names))
+
+    errors = warnings_ = 0
+    for label, program, fetch_list in targets:
+        report = program.validate(fetch_list=fetch_list, mesh=mesh)
+        errors += len(report.errors)
+        warnings_ += len(report.warnings)
+        print(f"== {label}: {report.render()}", flush=True)
+    print(json.dumps({"check": "FAIL" if errors or
+                      (args.strict and warnings_) else "PASS",
+                      "errors": errors, "warnings": warnings_}),
+          flush=True)
+    return 1 if errors or (args.strict and warnings_) else 0
+
+
 def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "check":
+        return job_check(argv[1:])
     ap = argparse.ArgumentParser(
         prog="paddle_tpu",
-        description="TrainerMain analog: run a v1 config on the TPU runtime")
+        description="TrainerMain analog: run a v1 config on the TPU "
+                    "runtime.  A `check` subcommand also exists: "
+                    "`paddle_tpu check prog.json|__model__|dir` runs the "
+                    "static program verifier (see `paddle_tpu check "
+                    "--help`).")
     ap.add_argument("--config", required=True, help="v1 config file")
     ap.add_argument("--job", default="train",
                     choices=["train", "test", "time", "checkgrad"])

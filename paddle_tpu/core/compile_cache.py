@@ -127,6 +127,11 @@ class CompileStats:
                                     whole Program; the retrace detector
                                     flags a fingerprint traced twice)
       state_keys_evictions        — Program._state_keys_cache sweeps
+      validations                 — static-verifier runs (analysis
+                                    .validate_program); the executor
+                                    memoizes per (program, version,
+                                    fetches), so this stays flat across
+                                    steps — tests/test_analysis.py pins it
     """
 
     def __init__(self):

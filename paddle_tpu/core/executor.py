@@ -25,6 +25,7 @@ into a single ``jax.jit`` function per (program-version, feed-signature):
 from __future__ import annotations
 
 import logging
+import warnings
 import weakref
 from typing import Dict, List, Optional, Sequence
 
@@ -403,6 +404,30 @@ def _feed_signature(feed: Dict[str, object]):
         for k, v in feed.items()))
 
 
+def _specs_sig(d):
+    """Canonical hashable digest of a {name: spec/option} dict — shared by
+    the cache fingerprints and the validation memo so the two can never
+    disagree on how specs are keyed."""
+    return tuple(sorted((k, repr(v)) for k, v in (d or {}).items()))
+
+
+def _validation_ctx_key(mesh, param_specs, feed_specs):
+    """Hashable digest of the sharding-lint inputs, folded into the
+    validation memo key — a ShardedExecutor whose mesh or spec overrides
+    change after a successful validation must re-run PT030/PT031.
+    Recomputed on every validated run by design: the spec dicts are
+    mutable and mutation is exactly what the memo must detect."""
+    if mesh is None and not param_specs and not feed_specs:
+        return None
+    if isinstance(mesh, dict):
+        mesh_key = tuple(sorted(mesh.items()))
+    elif mesh is not None and hasattr(mesh, "shape"):
+        mesh_key = tuple(dict(mesh.shape).items())
+    else:
+        mesh_key = repr(mesh)
+    return (mesh_key, _specs_sig(param_specs), _specs_sig(feed_specs))
+
+
 # ---------------------------------------------------------------------------
 # Executor
 # ---------------------------------------------------------------------------
@@ -424,7 +449,8 @@ class Executor:
                  auto_layout: bool = False,
                  compiler_options: Optional[Dict[str, object]] = None,
                  compute_dtype: Optional[str] = None,
-                 conv1x1_pallas: Optional[bool] = None):
+                 conv1x1_pallas: Optional[bool] = None,
+                 validate: Optional[bool] = None):
         self.place = place or TPUPlace()
         self.use_jit = use_jit
         self.check_nan_inf = check_nan_inf
@@ -448,6 +474,16 @@ class Executor:
         # None defers to the conv1x1_pallas flag, a per-op use_pallas attr
         # (layers.conv2d(use_pallas=...)) overrides both
         self.conv1x1_pallas = conv1x1_pallas
+        # static program verification (paddle_tpu.analysis) before trace
+        # AND before compile-cache fingerprinting, so an invalid program
+        # never enters the cache; None defers to the `validate` flag
+        # (PADDLE_TPU_VALIDATE=1).  Memoized per (program, version,
+        # fetches) — zero cost in the stepped hot path.  Keyed by the
+        # live Program object (weakly, so dead programs drop and an
+        # id()-reused successor can never inherit a stale "validated").
+        self.validate = validate
+        self._validated: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
         # compiled step variants keyed by CONTENT fingerprint (survives
         # process restarts via the persistent layer; content-identical
         # programs share an entry), LRU-bounded with dead-program sweeping
@@ -462,6 +498,54 @@ class Executor:
             return int(flags.get_flag("executor_cache_entries"))
         except Exception:
             return 64
+
+    def _validation_context(self):
+        """(mesh, param_specs, feed_specs) for the sharding lints; the
+        base executor has no mesh.  ShardedExecutor overrides."""
+        return None, None, None
+
+    def _maybe_validate(self, program: Program, fetch_names: Sequence[str]):
+        """Run the static verifier once per (program, version, fetches).
+
+        Called by run/run_steps/compile BEFORE the entry fingerprint is
+        computed, so an invalid program is rejected before it can be
+        installed in (or persisted to) the compilation cache.  Successful
+        validations memoize; error reports re-raise on every call.
+        """
+        want = self.validate
+        if want is None:
+            try:
+                from .. import flags
+                want = bool(flags.get_flag("validate"))
+            except Exception:
+                want = False
+        if not want:
+            return
+        mesh, param_specs, feed_specs = self._validation_context()
+        seen = self._validated.get(program)
+        key = (program.version, tuple(fetch_names),
+               _validation_ctx_key(mesh, param_specs, feed_specs))
+        if seen is not None and key in seen:
+            return
+        from ..analysis import validate_program
+        # an EMPTY fetch list (side-effect/warmup runs) means the targets
+        # are unknown, not "nothing is live" — skip the dead-op lint
+        report = validate_program(program,
+                                  fetch_list=list(fetch_names) or None,
+                                  mesh=mesh, param_specs=param_specs,
+                                  feed_specs=feed_specs)
+        report.raise_on_error()
+        for d in report.warnings:
+            warnings.warn(f"program verifier: {d.render()}", stacklevel=3)
+        if seen is None:
+            seen = self._validated.setdefault(program, set())
+        else:
+            # version bumps are monotonic, so stale-version keys can never
+            # hit again — drop them, bounding the memo for long-lived
+            # programs that are mutated and re-run under validation
+            seen.difference_update(
+                [k for k in seen if k[0] != program.version])
+        seen.add(key)
 
     # -- public ------------------------------------------------------------
     def run(self, program: Optional[Program] = None,
@@ -497,6 +581,7 @@ class Executor:
         state_keys = self._state_keys(program, scope)
         state = {k: scope.get(k) for k in state_keys}
 
+        self._maybe_validate(program, fetch_names)
         fp = compile_cache.fingerprint_hex(self._entry_sig(
             program, feed_arrays, fetch_names, state_keys, is_test))
         fn = self._cache.get(fp, program)
@@ -583,6 +668,7 @@ class Executor:
         state_keys = self._state_keys(program, scope)
         state = {k: scope.get(k) for k in state_keys}
 
+        self._maybe_validate(program, fetch_names)
         fp = compile_cache.fingerprint_hex(self._entry_sig(
             program, feed_arrays, fetch_names, state_keys, is_test,
             steps=(num_steps, feeds_stacked)))
@@ -747,8 +833,7 @@ class Executor:
         everything on `self` that changes the traced computation."""
         return (self.use_jit, self.amp, self.auto_layout,
                 str(self.compute_dtype), self.conv1x1_pallas,
-                tuple(sorted((k, repr(v))
-                             for k, v in self.compiler_options.items())))
+                _specs_sig(self.compiler_options))
 
     def _fingerprint_extras(self, program: Program):
         """Subclass hook: extra fingerprint components (ShardedExecutor
@@ -845,6 +930,7 @@ class Executor:
             getattr(scope.get(k), "dtype", np.asarray(scope.get(k)).dtype))
             for k in state_keys}
 
+        self._maybe_validate(program, fetch_names)
         steps = None if num_steps is None else (num_steps, feeds_stacked)
         fp = compile_cache.fingerprint_hex(self._entry_sig(
             program, feeds_abs, fetch_names, state_keys, is_test,

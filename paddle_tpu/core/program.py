@@ -38,6 +38,7 @@ from .types import VarType, convert_dtype
 
 GRAD_SUFFIX = "@GRAD"
 LEN_SUFFIX = "@LEN"          # companion sequence-length vector for lod_level>0
+LEN2_SUFFIX = "@LEN2"        # nested (lod-2) inner-length companion
 
 
 def grad_var_name(name: str) -> str:
@@ -432,6 +433,29 @@ class Program:
         # caches (content digest, state keys) can't serve stale entries
         p._bump_version()
         return p
+
+    def validate(self, fetch_list: Optional[Sequence] = None, mesh=None,
+                 param_specs=None, feed_specs=None,
+                 raise_on_error: bool = False):
+        """Run the static program verifier (``paddle_tpu.analysis``) over
+        this program — the build-time analog of the reference's desc-layer
+        InferShape/OpDesc validation.
+
+        ``fetch_list`` (Variables or names) enables the dead-op lint;
+        ``mesh`` (a ``jax.sharding.Mesh`` or an axis->size dict) plus
+        optional ``param_specs``/``feed_specs`` enable the sharding-spec
+        checks.  Returns a :class:`~paddle_tpu.analysis.ValidationReport`
+        of ``PT0xx`` diagnostics; with ``raise_on_error=True``,
+        error-severity findings raise
+        :class:`~paddle_tpu.analysis.ProgramVerificationError` instead.
+        """
+        from ..analysis import validate_program
+        report = validate_program(self, fetch_list=fetch_list, mesh=mesh,
+                                  param_specs=param_specs,
+                                  feed_specs=feed_specs)
+        if raise_on_error:
+            report.raise_on_error()
+        return report
 
     def to_dict(self):
         return {"version": 1, "random_seed": self.random_seed,

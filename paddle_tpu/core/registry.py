@@ -25,9 +25,10 @@ Implementation signature::
 """
 from __future__ import annotations
 
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 _OP_IMPLS: Dict[str, Callable] = {}
+_SHAPE_FNS: Dict[str, Callable] = {}
 
 
 def register_op(*names: str):
@@ -58,3 +59,45 @@ def has_op(name: str) -> bool:
 
 def registered_ops():
     return sorted(_OP_IMPLS)
+
+
+def register_shape_fn(*names: str):
+    """Register a static shape/dtype inference rule for one or more op type
+    names — the build-time companion of :func:`register_op` and the analog
+    of the reference's per-op ``InferShape`` (operator.h InferShapeContext,
+    run inside OpDesc construction by the C++ desc layer).
+
+    A rule has the signature ``fn(op, ins, attrs) -> {slot: VarInfo|...}``
+    where ``ins`` maps input slot -> list of
+    :class:`paddle_tpu.analysis.shape_infer.VarInfo`; it must raise
+    :class:`paddle_tpu.analysis.shape_infer.ShapeError` when the inputs are
+    statically incompatible.  Rules run at validation time only — never
+    inside the stepped hot path (core/executor.py memoizes per program
+    version/signature).
+
+    Ops without a rule must be listed in
+    ``paddle_tpu.analysis.shape_infer.SHAPE_INFER_ALLOWLIST``; tier-1
+    enforces that every registered op has exactly one of the two
+    (tests/test_analysis.py), so inference coverage can only grow.
+    """
+
+    def deco(fn):
+        for n in names:
+            if n in _SHAPE_FNS:
+                raise ValueError(f"shape fn for op {n!r} registered twice")
+            _SHAPE_FNS[n] = fn
+        return fn
+
+    return deco
+
+
+def get_shape_fn(name: str) -> Optional[Callable]:
+    return _SHAPE_FNS.get(name)
+
+
+def has_shape_fn(name: str) -> bool:
+    return name in _SHAPE_FNS
+
+
+def registered_shape_fns():
+    return sorted(_SHAPE_FNS)

@@ -27,6 +27,15 @@ DATA_MD5 = "387719152ae52d60422c016e92a742fc"
 WORDS_NAME = "conll05st-release/test.wsj/words/test.wsj.words.gz"
 PROPS_NAME = "conll05st-release/test.wsj/props/test.wsj.props.gz"
 
+# The published dictionaries the reference trains/embeds against
+# (reference conll05.py:33-40) — one token per line, line index == id.
+WORDDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st%2FwordDict.txt"
+WORDDICT_MD5 = "ea7fb7d4c75cc6254716f0177a506baa"
+VERBDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st%2FverbDict.txt"
+VERBDICT_MD5 = "0d2977293bbb6cbefab5b0f97db1e77c"
+TRGDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st%2FtargetDict.txt"
+TRGDICT_MD5 = "d8c7f03ceb5fc2e5a0fa7503a4353751"
+
 UNK_IDX = 0
 WORD_VOCAB, NUM_TAGS = 1000, 9
 
@@ -139,11 +148,48 @@ def _archive(download=False):
                        do_download=download)
 
 
+def _published_dicts(download=False):
+    """The reference's published wordDict/verbDict/targetDict via the
+    shared cache probe; (word, verb, label) dicts, or None when any file
+    is absent and cannot be fetched."""
+    from .common import cached_path
+    paths = []
+    for url, md5 in ((WORDDICT_URL, WORDDICT_MD5),
+                     (VERBDICT_URL, VERBDICT_MD5),
+                     (TRGDICT_URL, TRGDICT_MD5)):
+        try:
+            p = cached_path(url, "conll05st", md5, do_download=download)
+        except (RuntimeError, OSError) as e:
+            import warnings
+            warnings.warn(f"conll05: published dict {url} unavailable "
+                          f"({e}); falling back to corpus-derived dicts "
+                          f"(token ids will NOT match the reference)")
+            return None
+        if p is None:
+            return None
+        paths.append(p)
+    return tuple(load_dict(p) for p in paths)
+
+
 def get_dict(download=False):
-    """Word/verb/label dictionaries.  With the official archive
-    (explicitly requested) the dicts are built from the corpus itself
-    (the published dict files are a separate download); by default they
-    are the synthetic vocabulary."""
+    """Word/verb/label dictionaries.
+
+    With ``download=True`` the reference's PUBLISHED wordDict/verbDict/
+    targetDict files are loaded via :func:`load_dict` (served from the
+    shared cache when already present — no re-fetch), so token ids match
+    the reference exactly — the id assignment its pretrained SRL
+    embedding (the ``get_embedding`` workflow) and any model trained
+    against the published vocabulary expect.  When the published files
+    are unavailable but the test
+    corpus archive is, the dicts are BUILT FROM THE CORPUS instead:
+    alphabetic enumeration of the test split.  Corpus-derived ids are
+    **incompatible** with the published ids (different vocabulary,
+    different order), so checkpoints/embeddings cannot be exchanged
+    between the two modes.  By default (no cache, no download) both fall
+    back to the synthetic vocabulary the hermetic tests use."""
+    published = _published_dicts(download)
+    if published is not None:
+        return published
     arch = _archive(download)
     if arch is None:
         word_dict = {f"w{i}": i for i in range(WORD_VOCAB)}

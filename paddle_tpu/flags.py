@@ -81,6 +81,14 @@ define_flag("cache_dir", "",
             "keyed by program fingerprint, so a fresh process with the same "
             "program/config skips trace, lower AND compile "
             "(core/compile_cache.py; see README 'Compilation cache')")
+define_flag("validate", False,
+            "run the static program verifier (paddle_tpu.analysis) before "
+            "every new step variant is traced — and before its compile-"
+            "cache fingerprint is computed, so an invalid program can "
+            "never enter the cache.  Errors raise "
+            "ProgramVerificationError with stable PT0xx codes naming the "
+            "op; warnings go to warnings.warn.  Per-executor override: "
+            "Executor(validate=...).  (PADDLE_TPU_VALIDATE=1)")
 define_flag("executor_cache_entries", 64,
             "max compiled step variants held per Executor (LRU; evictions "
             "and dead-program sweeps count into profiler.compile_stats())")

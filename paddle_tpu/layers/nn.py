@@ -138,15 +138,12 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
     b = helper.create_parameter(
         ParamAttr._to_attr(bias_attr) or ParamAttr(), shape=[1, bias_size],
         dtype=dtype, is_bias=True)
-    x = input
-    if is_reverse:
-        x = sequence_reverse(x)
     B, T = (input.shape or (-1, -1))[:2]
     hid = helper.create_variable_for_type_inference(
         dtype, (B, T, hidden), lod_level=input.lod_level)
     cell = helper.create_variable_for_type_inference(
         dtype, (B, T, hidden), lod_level=input.lod_level)
-    ins = {"Input": [x], "Weight": [w], "Bias": [b]}
+    ins = {"Input": [input], "Weight": [w], "Bias": [b]}
     if h_0 is not None:
         ins["H0"] = [h_0]
     if c_0 is not None:
@@ -154,12 +151,10 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
     helper.append_op(type="lstm", inputs=ins,
                      outputs={"Hidden": [hid], "Cell": [cell]},
                      attrs={"use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse,
                             "gate_activation": gate_activation,
                             "cell_activation": cell_activation,
                             "candidate_activation": candidate_activation})
-    if is_reverse:
-        hid = sequence_reverse(hid)
-        cell = sequence_reverse(cell)
     return hid, cell
 
 
@@ -173,18 +168,16 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
     b = helper.create_parameter(
         ParamAttr._to_attr(bias_attr) or ParamAttr(), shape=[1, 3 * size],
         dtype=dtype, is_bias=True)
-    x = sequence_reverse(input) if is_reverse else input
     B, T = (input.shape or (-1, -1))[:2]
     hid = helper.create_variable_for_type_inference(
         dtype, (B, T, size), lod_level=input.lod_level)
-    ins = {"Input": [x], "Weight": [w], "Bias": [b]}
+    ins = {"Input": [input], "Weight": [w], "Bias": [b]}
     if h_0 is not None:
         ins["H0"] = [h_0]
     helper.append_op(type="gru", inputs=ins, outputs={"Hidden": [hid]},
                      attrs={"gate_activation": gate_activation,
+                            "is_reverse": is_reverse,
                             "activation": candidate_activation})
-    if is_reverse:
-        hid = sequence_reverse(hid)
     return hid
 
 

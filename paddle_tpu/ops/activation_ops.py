@@ -114,3 +114,21 @@ def _prelu(ctx, ins, attrs):
         else:                                   # channel mode
             alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
     return {"Out": jnp.where(x >= 0, x, alpha * x)}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rules (analysis.shape_infer): every activation maps
+# X -> Out elementwise, so one same_as rule covers the whole file — the
+# InferShape analog of activation_op.h's UnaryOpUnchangedInferShape.
+# ---------------------------------------------------------------------------
+from ..analysis.shape_infer import same_as  # noqa: E402
+from ..core.registry import register_shape_fn  # noqa: E402
+
+register_shape_fn(
+    "sigmoid", "logsigmoid", "tanh", "relu", "relu6", "abs", "sqrt",
+    "rsqrt", "square", "exp", "log", "floor", "ceil", "round",
+    "reciprocal", "softsign", "softplus", "softrelu", "sin", "cos",
+    "gelu", "silu", "swish", "brelu", "leaky_relu", "elu", "stanh",
+    "hard_shrink", "soft_shrink", "softshrink", "thresholded_relu",
+    "hard_sigmoid", "prelu",
+)(same_as("X"))

@@ -277,3 +277,50 @@ def _print(ctx, ins, attrs):
 @register_op("assert")
 def _assert(ctx, ins, attrs):
     return {}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rules (analysis.shape_infer).  The structured control
+# flow ops (while/conditional_block/rnn) are allowlisted — their outputs are
+# whatever the sub-block binds — but the tensor-array plumbing around them
+# is statically knowable.
+# ---------------------------------------------------------------------------
+from ..analysis.shape_infer import (VarInfo, first, no_outputs,  # noqa: E402
+                                    passthrough, same_as)
+from ..core.registry import register_shape_fn  # noqa: E402
+
+register_shape_fn("shrink_rnn_memory", "rnn_memory_helper")(same_as("X"))
+register_shape_fn("split_lod_tensor")(
+    same_as("X", out="OutTrue", also=("OutFalse",)))
+register_shape_fn("merge_lod_tensor")(same_as("InTrue"))
+register_shape_fn("reorder_lod_tensor_by_rank")(same_as("X"))
+register_shape_fn("print")(passthrough("In", "X"))
+register_shape_fn("assert")(no_outputs())
+
+
+@register_shape_fn("read_from_array")
+def _read_from_array_shape(op, ins, attrs):
+    buf = first(ins, "X")
+    if buf.shape is None:
+        return {"Out": buf}
+    return {"Out": buf.with_shape(buf.shape[1:])}
+
+
+@register_shape_fn("lod_array_length")
+def _lod_array_length_shape(op, ins, attrs):
+    return {"Out": VarInfo((), "int64")}
+
+
+@register_shape_fn("lod_tensor_to_array", "array_to_lod_tensor")
+def _swap01_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None or len(x.shape) < 2:
+        return {"Out": VarInfo(None, x.dtype)}
+    return {"Out": x.with_shape((x.shape[1], x.shape[0]) + x.shape[2:])}
+
+
+@register_shape_fn("lod_rank_table")
+def _lod_rank_table_shape(op, ins, attrs):
+    x = first(ins, "X")
+    b = x.shape[0] if x.shape is not None else -1
+    return {"Out": VarInfo((b,), "int32")}

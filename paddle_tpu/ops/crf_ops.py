@@ -282,3 +282,70 @@ def _copy_len(ctx, ins, attrs):
     if lens2 is not None:
         ctx.set_len2(ctx.op.outputs["Out"][0], lens2)
     return {}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rules (analysis.shape_infer).
+# ---------------------------------------------------------------------------
+from ..analysis.shape_infer import (ShapeError, VarInfo, dim_ok,  # noqa: E402
+                                    first, no_outputs)
+from ..core.registry import register_shape_fn  # noqa: E402
+
+register_shape_fn("copy_len")(no_outputs())
+
+
+@register_shape_fn("linear_chain_crf")
+def _linear_chain_crf_shape(op, ins, attrs):
+    em, trans = first(ins, "Emission"), first(ins, "Transition")
+    if em.shape is not None and trans.shape is not None and \
+            len(em.shape) == 3 and len(trans.shape) == 2 and \
+            em.shape[-1] >= 0 and trans.shape[-1] >= 0:
+        d = em.shape[-1]
+        if trans.shape[-1] != d or (trans.shape[0] >= 0
+                                    and trans.shape[0] != d + 2):
+            raise ShapeError(
+                f"linear_chain_crf: Transition {list(trans.shape)} must be "
+                f"[D+2, D] for Emission D={d}")
+    b = em.shape[0] if em.shape is not None else -1
+    d = em.shape[-1] if em.shape is not None else -1
+    return {"LogLikelihood": VarInfo((b, 1), em.dtype),
+            "Alpha": VarInfo((b, d), em.dtype),
+            "EmissionExps": em, "TransitionExps": trans}
+
+
+@register_shape_fn("crf_decoding")
+def _crf_decoding_shape(op, ins, attrs):
+    em = first(ins, "Emission")
+    if em.shape is None or len(em.shape) < 2:
+        return {"ViterbiPath": VarInfo(None, "int64")}
+    return {"ViterbiPath": VarInfo(em.shape[:2], "int64")}
+
+
+@register_shape_fn("warpctc")
+def _warpctc_shape(op, ins, attrs):
+    logits = first(ins, "Logits")
+    b = logits.shape[0] if logits.shape is not None else -1
+    return {"Loss": VarInfo((b, 1), logits.dtype)}
+
+
+@register_shape_fn("edit_distance")
+def _edit_distance_shape(op, ins, attrs):
+    hyp = first(ins, "Hyps")
+    b = hyp.shape[0] if hyp.shape is not None else -1
+    return {"Out": VarInfo((b, 1), "float32"),
+            "SequenceNum": VarInfo((1,), "int64")}
+
+
+@register_shape_fn("chunk_eval")
+def _chunk_eval_shape(op, ins, attrs):
+    inf, lab = first(ins, "Inference"), first(ins, "Label")
+    if inf.shape is not None and lab.shape is not None and \
+            not dim_ok(inf.shape[0], lab.shape[0]):
+        raise ShapeError(
+            f"chunk_eval: batch mismatch Inference {list(inf.shape)} vs "
+            f"Label {list(lab.shape)}")
+    f = VarInfo((1,), "float32")
+    i = VarInfo((1,), "int64")
+    return {"Precision": f, "Recall": f, "F1-Score": f,
+            "NumInferChunks": i, "NumLabelChunks": i,
+            "NumCorrectChunks": i}

@@ -318,3 +318,27 @@ def _detection_output(ctx, ins, attrs):
 
     out = jax.vmap(one_image)(scores, boxes)
     return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rule.  The data-dependent detection ops (roi_pool,
+# prior_box, box_coder, ssd_loss, multiclass_nms, detection_output) are
+# allowlisted in analysis.shape_infer — their output layout is placeholder-
+# shaped by design — but IoU is statically exact.
+# ---------------------------------------------------------------------------
+from ..analysis.shape_infer import ShapeError, VarInfo, first  # noqa: E402
+from ..core.registry import register_shape_fn  # noqa: E402
+
+
+@register_shape_fn("iou_similarity")
+def _iou_similarity_shape(op, ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    for name, v in (("X", x), ("Y", y)):
+        if v.shape is not None and len(v.shape) >= 1 and \
+                v.shape[-1] >= 0 and v.shape[-1] != 4:
+            raise ShapeError(
+                f"iou_similarity: {name} boxes must be [*, 4], got "
+                f"{list(v.shape)}")
+    n = x.shape[0] if x.shape is not None else -1
+    m = y.shape[0] if y.shape is not None else -1
+    return {"Out": VarInfo((n, m), x.dtype)}

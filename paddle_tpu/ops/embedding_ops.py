@@ -101,3 +101,52 @@ def _hsigmoid(ctx, ins, attrs):
         jnp.log1p(jnp.exp(-jnp.abs(logits)))
     cost = jnp.sum(ce * valid.astype(x.dtype), axis=1, keepdims=True)
     return {"Out": cost, "PreOut": logits}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rules (analysis.shape_infer).
+# ---------------------------------------------------------------------------
+import numpy as _np  # noqa: E402
+
+from ..analysis.shape_infer import (ShapeError, VarInfo, first,  # noqa: E402
+                                    squeeze_ids)
+from ..core.registry import register_shape_fn  # noqa: E402
+
+
+@register_shape_fn("lookup_table")
+def _lookup_table_shape(op, ins, attrs):
+    w, ids = first(ins, "W"), first(ins, "Ids")
+    if ids.dtype is not None and ids.dtype.kind == "f":
+        raise ShapeError(
+            f"lookup_table: Ids must be integral, got {ids.dtype.name}")
+    s = squeeze_ids(ids)
+    if s is None or w.shape is None:
+        return {"Out": VarInfo(None, w.dtype)}
+    return {"Out": VarInfo(s + (w.shape[-1],), w.dtype)}
+
+
+@register_shape_fn("nce")
+def _nce_shape(op, ins, attrs):
+    x, w = first(ins, "Input"), first(ins, "Weight")
+    if x.shape is not None and w.shape is not None and \
+            x.shape[-1] >= 0 and w.shape[-1] >= 0 and \
+            x.shape[-1] != w.shape[-1]:
+        raise ShapeError(
+            f"nce: Input dim {x.shape[-1]} != Weight dim {w.shape[-1]}")
+    b = x.shape[0] if x.shape is not None else -1
+    k = attrs.get("num_neg_samples", 10)
+    return {"Cost": VarInfo((b, 1), _np.float32 if x.dtype is None
+                            else x.dtype),
+            "SampleLogits": VarInfo((b, 1 + k), x.dtype),
+            "SampleLabels": VarInfo((b, 1 + k), "int64")}
+
+
+@register_shape_fn("hierarchical_sigmoid", "hsigmoid")
+def _hsigmoid_shape(op, ins, attrs):
+    x = first(ins, "X")
+    b = x.shape[0] if x.shape is not None else -1
+    num_classes = attrs.get("num_classes")
+    depth = (int(num_classes) - 1).bit_length() \
+        if isinstance(num_classes, int) else -1
+    return {"Out": VarInfo((b, 1), x.dtype),
+            "PreOut": VarInfo((b, depth), x.dtype)}

@@ -255,3 +255,77 @@ def _cross_entropy_over_beam(ctx, ins, attrs):
         ce = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
         total = ce if total is None else total + ce
     return {"Out": total[:, None]}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rules (analysis.shape_infer).
+# ---------------------------------------------------------------------------
+from ..analysis.shape_infer import (ShapeError, VarInfo, dim_ok,  # noqa: E402
+                                    first, same_as, shapes_compatible)
+from ..core.registry import register_shape_fn  # noqa: E402
+
+register_shape_fn("hinge_loss")(same_as("Logits", out="Loss"))
+register_shape_fn("log_loss")(same_as("Predicted", out="Loss"))
+register_shape_fn("sigmoid_cross_entropy_with_logits")(same_as("X"))
+register_shape_fn("mse_loss")(same_as("X"))
+register_shape_fn("kldiv_loss")(same_as("X", out="Loss"))
+register_shape_fn("rank_loss")(same_as("Left"))
+register_shape_fn("huber_loss")(same_as("X", also=("Residual",)))
+register_shape_fn("modified_huber_loss")(
+    same_as("X", also=("IntermediateVal",)))
+register_shape_fn("margin_rank_loss")(same_as("X1", also=("Activated",)))
+
+
+def _rowwise(x, extra=1):
+    b = x.shape[0] if x.shape is not None else -1
+    return VarInfo((b, extra), x.dtype)
+
+
+@register_shape_fn("smooth_l1_loss")
+def _smooth_l1_shape(op, ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    if not shapes_compatible(x.shape, y.shape):
+        raise ShapeError(
+            f"smooth_l1_loss: X {list(x.shape)} vs Y {list(y.shape)}")
+    return {"Out": _rowwise(x), "Diff": x}
+
+
+@register_shape_fn("squared_l2_distance")
+def _squared_l2_distance_shape(op, ins, attrs):
+    x = first(ins, "X")
+    return {"Out": _rowwise(x), "sub_result": x}
+
+
+@register_shape_fn("squared_l2_norm")
+def _squared_l2_norm_shape(op, ins, attrs):
+    return {"Out": VarInfo((1,), first(ins, "X").dtype)}
+
+
+@register_shape_fn("cos_sim")
+def _cos_sim_shape(op, ins, attrs):
+    x = first(ins, "X")
+    n = _rowwise(x)
+    return {"Out": n, "XNorm": n, "YNorm": n}
+
+
+@register_shape_fn("bilinear_tensor_product")
+def _bilinear_tp_shape(op, ins, attrs):
+    x, w = first(ins, "X"), first(ins, "Weight")
+    if x.shape is None or w.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    if x.shape[-1] >= 0 and w.shape[1] >= 0 and \
+            not dim_ok(x.shape[-1], w.shape[1]):
+        raise ShapeError(
+            f"bilinear_tensor_product: X dim {x.shape[-1]} vs Weight dx "
+            f"{w.shape[1]}")
+    return {"Out": VarInfo((x.shape[0], w.shape[0]), x.dtype)}
+
+
+@register_shape_fn("lambda_rank")
+def _lambda_rank_shape(op, ins, attrs):
+    return {"Out": _rowwise(first(ins, "Score"))}
+
+
+@register_shape_fn("cross_entropy_over_beam")
+def _ce_over_beam_shape(op, ins, attrs):
+    return {"Out": _rowwise(first(ins, "Scores"))}

@@ -291,3 +291,167 @@ def _scale_sub_region(ctx, ins, attrs):
             (h >= i[:, 2] - 1) & (h <= i[:, 3] - 1) &
             (w >= i[:, 4] - 1) & (w <= i[:, 5] - 1))
     return {"Out": jnp.where(mask, x * value, x)}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rules (analysis.shape_infer) — the InferShape analogs
+# of elementwise_op.h / mul_op.cc / matmul_op.cc / reduce_op.cc.
+# ---------------------------------------------------------------------------
+from ..analysis.shape_infer import (ShapeError, VarInfo, dim_ok,  # noqa: E402
+                                    elementwise, first, prod_dims,
+                                    reduce_rule, same_as,
+                                    shapes_compatible, unify_dim)
+from ..core.registry import register_shape_fn  # noqa: E402
+
+register_shape_fn(
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_pow", "elementwise_max",
+    "elementwise_min", "elementwise_mod",
+)(elementwise())
+register_shape_fn(
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal",
+)(elementwise(dtype="bool"))
+register_shape_fn("logical_and", "logical_or", "logical_xor")(
+    elementwise(dtype="bool"))
+register_shape_fn("logical_not")(same_as("X", dtype="bool"))
+register_shape_fn(
+    "scale", "minus", "clip", "clip_by_norm", "sign", "pow", "increment",
+    "cumsum", "l2_normalize", "norm", "interpolation", "scale_sub_region",
+)(same_as("X"))
+register_shape_fn("abs_diff", "squared_difference")(elementwise())
+register_shape_fn("reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+                  "reduce_prod")(reduce_rule())
+
+
+@register_shape_fn("mul")
+def _mul_shape(op, ins, attrs):
+    """mul_op.cc InferShape: flatten to 2-D at the num_col_dims splits and
+    check the contraction."""
+    x, y = first(ins, "X"), first(ins, "Y")
+    if x.shape is None or y.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    if not 0 < xn < len(x.shape) + 1 or not 0 < yn < len(y.shape) + 1:
+        raise ShapeError(
+            f"mul: num_col_dims ({xn}, {yn}) out of range for ranks "
+            f"{len(x.shape)}, {len(y.shape)}")
+    k1, k2 = prod_dims(x.shape[xn:]), prod_dims(y.shape[:yn])
+    if not dim_ok(k1, k2):
+        raise ShapeError(
+            f"mul: contraction mismatch {list(x.shape)}[{xn}:] ({k1}) vs "
+            f"{list(y.shape)}[:{yn}] ({k2})")
+    return {"Out": VarInfo(x.shape[:xn] + y.shape[yn:], x.dtype)}
+
+
+@register_shape_fn("matmul")
+def _matmul_shape(op, ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    if x.shape is None or y.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    xs, ys = list(x.shape), list(y.shape)
+    if len(xs) < 1 or len(ys) < 1:
+        raise ShapeError("matmul: operands must have rank >= 1")
+    if attrs.get("transpose_X", False) and len(xs) > 1:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if attrs.get("transpose_Y", False) and len(ys) > 1:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    if len(xs) == 1:
+        xs = [1] + xs
+    if len(ys) == 1:
+        ys = ys + [1]
+    if not dim_ok(xs[-1], ys[-2]):
+        raise ShapeError(
+            f"matmul: contraction mismatch {list(x.shape)} @ "
+            f"{list(y.shape)} ({xs[-1]} vs {ys[-2]})")
+    batch = []
+    for i in range(2, max(len(xs), len(ys)))[::-1]:
+        bx = xs[-i - 1] if i < len(xs) else None
+        by = ys[-i - 1] if i < len(ys) else None
+        if bx is not None and by is not None:
+            if not (dim_ok(bx, by) or bx == 1 or by == 1):
+                raise ShapeError(
+                    f"matmul: batch dims mismatch {list(x.shape)} vs "
+                    f"{list(y.shape)}")
+            # broadcast with -1-safe semantics: a 1 yields the other
+            # side verbatim (even if unknown); -1 never collapses to 1
+            if bx == 1:
+                batch.append(by)
+            elif by == 1:
+                batch.append(bx)
+            else:
+                batch.append(unify_dim(bx, by))
+        else:
+            batch.append(bx if bx is not None else by)
+    shape = tuple(batch) + (xs[-2], ys[-1])
+    if x.ndim == 1:
+        shape = shape[:-2] + (shape[-1],)
+    elif y.ndim == 1:
+        shape = shape[:-1]
+    return {"Out": VarInfo(shape, x.dtype)}
+
+
+@register_shape_fn("sum")
+def _sum_shape(op, ins, attrs):
+    """sum_op: every input must carry the same shape."""
+    xs = ins.get("X", [])
+    out = xs[0] if xs else None
+    for x in xs[1:]:
+        if not shapes_compatible(out.shape, x.shape):
+            raise ShapeError(
+                f"sum: operand shapes differ: {list(out.shape)} vs "
+                f"{list(x.shape)}")
+    return {"Out": out}
+
+
+@register_shape_fn("mean")
+def _mean_shape(op, ins, attrs):
+    x = first(ins, "X")
+    return {"Out": x.with_shape(())}
+
+
+@register_shape_fn("cast")
+def _cast_shape(op, ins, attrs):
+    x = first(ins, "X")
+    return {"Out": x.with_dtype(
+        attrs.get("out_dtype", attrs.get("dtype", "float32")))}
+
+
+@register_shape_fn("isfinite")
+def _isfinite_shape(op, ins, attrs):
+    return {"Out": VarInfo((), "bool")}
+
+
+@register_shape_fn("conv_shift")
+def _conv_shift_shape(op, ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    if x.shape is not None and y.shape is not None and \
+            len(y.shape) == 2 and y.shape[1] >= 0 and y.shape[1] % 2 == 0:
+        raise ShapeError(f"conv_shift: Y width must be odd, got "
+                         f"{y.shape[1]}")
+    return {"Out": x}
+
+
+@register_shape_fn("outer_prod")
+def _outer_prod_shape(op, ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    if x.shape is None or y.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    if len(x.shape) != 2 or len(y.shape) != 2:
+        raise ShapeError("outer_prod: X and Y must be rank-2")
+    m, n = x.shape[1], y.shape[1]
+    return {"Out": VarInfo((x.shape[0], -1 if m < 0 or n < 0 else m * n),
+                           x.dtype)}
+
+
+@register_shape_fn("factorization_machine")
+def _fm_shape(op, ins, attrs):
+    x, v = first(ins, "X"), first(ins, "V")
+    if x.shape is not None and v.shape is not None and \
+            not dim_ok(x.shape[-1], v.shape[0]):
+        raise ShapeError(
+            f"factorization_machine: X feature dim {x.shape[-1]} vs V rows "
+            f"{v.shape[0]}")
+    b = x.shape[0] if x.shape is not None else -1
+    return {"Out": VarInfo((b, 1), x.dtype)}

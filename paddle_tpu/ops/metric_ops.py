@@ -97,3 +97,39 @@ def _pnpair(ctx, ins, attrs):
         neu = neu + ins["AccumulateNeutralPair"][0].reshape(())
     return {"PositivePair": pos.reshape(1), "NegativePair": neg.reshape(1),
             "NeutralPair": neu.reshape(1)}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rules (analysis.shape_infer).
+# ---------------------------------------------------------------------------
+from ..analysis.shape_infer import VarInfo  # noqa: E402
+from ..core.registry import register_shape_fn  # noqa: E402
+
+
+@register_shape_fn("accuracy")
+def _accuracy_shape(op, ins, attrs):
+    return {"Accuracy": VarInfo((1,), "float32"),
+            "Correct": VarInfo((1,), "int32"),
+            "Total": VarInfo((1,), "int32")}
+
+
+@register_shape_fn("auc")
+def _auc_shape(op, ins, attrs):
+    n = attrs.get("num_thresholds", 200)
+    hist = VarInfo((n + 1,), "float32")
+    return {"AUC": VarInfo((1,), "float32"), "StatPosOut": hist,
+            "StatNegOut": hist}
+
+
+@register_shape_fn("precision_recall")
+def _precision_recall_shape(op, ins, attrs):
+    ncls = attrs["class_number"]
+    m = VarInfo((6,), "float32")
+    return {"BatchMetrics": m, "AccumMetrics": m,
+            "AccumStatesInfo": VarInfo((ncls, 3), "float32")}
+
+
+@register_shape_fn("positive_negative_pair")
+def _pnpair_shape(op, ins, attrs):
+    s = VarInfo((1,), "float32")
+    return {"PositivePair": s, "NegativePair": s, "NeutralPair": s}

@@ -72,3 +72,29 @@ def _moe(ctx, ins, attrs):
     out = jnp.einsum("tec,ecd->td", combine, out_e)
     return {"Out": out.reshape(shape),
             "AuxLoss": aux.reshape(()).astype(jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rules (analysis.shape_infer).
+# ---------------------------------------------------------------------------
+from ..analysis.shape_infer import ShapeError, VarInfo, first  # noqa: E402
+from ..core.registry import register_shape_fn  # noqa: E402
+
+
+@register_shape_fn("moe")
+def _moe_shape(op, ins, attrs):
+    x, gate_w = first(ins, "X"), first(ins, "GateW")
+    w1 = first(ins, "W1")
+    if x.shape is not None and gate_w.shape is not None and \
+            x.shape[-1] >= 0 and gate_w.shape[0] >= 0 and \
+            x.shape[-1] != gate_w.shape[0]:
+        raise ShapeError(
+            f"moe: X feature dim {x.shape[-1]} != GateW rows "
+            f"{gate_w.shape[0]}")
+    if w1.shape is not None and gate_w.shape is not None and \
+            w1.shape[0] >= 0 and gate_w.shape[-1] >= 0 and \
+            w1.shape[0] != gate_w.shape[-1]:
+        raise ShapeError(
+            f"moe: W1 expert count {w1.shape[0]} != GateW experts "
+            f"{gate_w.shape[-1]}")
+    return {"Out": x, "AuxLoss": VarInfo((), "float32")}

@@ -460,3 +460,256 @@ def _im2sequence(ctx, ins, attrs):
     out = patches.reshape(n, ckk, oh * ow).transpose(0, 2, 1)
     return {"Out": out}
 
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rules (analysis.shape_infer) — the InferShape analogs
+# of conv_op.cc / pool_op.cc / batch_norm_op.cc etc.
+# ---------------------------------------------------------------------------
+from ..analysis.shape_infer import (ShapeError, VarInfo,  # noqa: E402
+                                    conv_out_dim, dim_ok, first, mirror,
+                                    same_as)
+from ..core.registry import register_shape_fn  # noqa: E402
+
+register_shape_fn("softmax", "log_softmax")(same_as("X"))
+register_shape_fn("pad_constant_like")(same_as("X"))
+
+
+@register_shape_fn("conv2d", "depthwise_conv2d")
+def _conv2d_shape(op, ins, attrs):
+    x, w = first(ins, "Input"), first(ins, "Filter")
+    if x.shape is None or w.shape is None:
+        return {"Output": VarInfo(None, x.dtype)}
+    if len(x.shape) != 4 or len(w.shape) != 4:
+        raise ShapeError(
+            f"conv2d: Input/Filter must be rank-4, got {list(x.shape)} / "
+            f"{list(w.shape)}")
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1) or 1)
+    n, c, h, wd = x.shape
+    o, cg, kh, kw = w.shape
+    if c >= 0 and cg >= 0 and c != cg * groups:
+        raise ShapeError(
+            f"conv2d: input channels {c} != Filter C/g {cg} * groups "
+            f"{groups}")
+    if o >= 0 and groups > 1 and o % groups:
+        raise ShapeError(
+            f"conv2d: output channels {o} not divisible by groups {groups}")
+    oh = conv_out_dim(h, kh, pads[0], strides[0], dil[0])
+    ow = conv_out_dim(wd, kw, pads[1], strides[1], dil[1])
+    return {"Output": VarInfo((n, o, oh, ow), x.dtype)}
+
+
+@register_shape_fn("conv2d_transpose")
+def _conv2d_transpose_shape(op, ins, attrs):
+    x, w = first(ins, "Input"), first(ins, "Filter")
+    if x.shape is None or w.shape is None:
+        return {"Output": VarInfo(None, x.dtype)}
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    n, c, h, wd = x.shape
+    ci, co, kh, kw = w.shape
+    if c >= 0 and ci >= 0 and c != ci:
+        raise ShapeError(
+            f"conv2d_transpose: input channels {c} != Filter C_in {ci}")
+
+    def _out(size, k, p, s, d):
+        if size < 0:
+            return -1
+        return (size - 1) * s - 2 * p + d * (k - 1) + 1
+
+    return {"Output": VarInfo(
+        (n, co, _out(h, kh, pads[0], strides[0], dil[0]),
+         _out(wd, kw, pads[1], strides[1], dil[1])), x.dtype)}
+
+
+@register_shape_fn("conv3d")
+def _conv3d_shape(op, ins, attrs):
+    x, w = first(ins, "Input"), first(ins, "Filter")
+    if x.shape is None or w.shape is None:
+        return {"Output": VarInfo(None, x.dtype)}
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    pads = tuple(attrs.get("paddings", [0, 0, 0]))
+    dil = tuple(attrs.get("dilations", [1, 1, 1]))
+    n, c = x.shape[0], x.shape[1]
+    o = w.shape[0]
+    dims = tuple(conv_out_dim(x.shape[2 + i], w.shape[2 + i], pads[i],
+                              strides[i], dil[i]) for i in range(3))
+    return {"Output": VarInfo((n, o) + dims, x.dtype)}
+
+
+def _pool2d_out_shape(x, attrs):
+    if attrs.get("global_pooling", False):
+        return x.shape[:2] + (1, 1)
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    ceil = attrs.get("ceil_mode", False)
+    return x.shape[:2] + (
+        conv_out_dim(x.shape[2], ksize[0], pads[0], strides[0],
+                     ceil_mode=ceil),
+        conv_out_dim(x.shape[3], ksize[1], pads[1], strides[1],
+                     ceil_mode=ceil))
+
+
+@register_shape_fn("pool2d")
+def _pool2d_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    if len(x.shape) != 4:
+        raise ShapeError(f"pool2d: X must be rank-4, got {list(x.shape)}")
+    return {"Out": x.with_shape(_pool2d_out_shape(x, attrs))}
+
+
+@register_shape_fn("max_pool2d_with_index", "pool2d_with_index")
+def _pool2d_with_index_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x, "Mask": VarInfo(None, "int64")}
+    a = dict(attrs)
+    a.setdefault("strides", a.get("ksize", [2, 2]))
+    # the patch-extraction lowering always floors, unlike _pool2d_core
+    a["ceil_mode"] = False
+    shape = _pool2d_out_shape(x, a)
+    return {"Out": x.with_shape(shape), "Mask": VarInfo(shape, "int64")}
+
+
+@register_shape_fn("pool3d")
+def _pool3d_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    if attrs.get("global_pooling", False):
+        return {"Out": x.with_shape(x.shape[:2] + (1, 1, 1))}
+    ks = list(attrs.get("ksize", [2, 2, 2]))
+    strides = list(attrs.get("strides", ks))
+    pads = list(attrs.get("paddings", [0, 0, 0]))
+    dims = tuple(conv_out_dim(x.shape[2 + i], ks[i], pads[i], strides[i])
+                 for i in range(3))
+    return {"Out": x.with_shape(x.shape[:2] + dims)}
+
+
+@register_shape_fn("unpool")
+def _unpool_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    n, c, oh, ow = x.shape
+    if "unpool_size" in attrs:
+        uh, uw = attrs["unpool_size"]
+    else:
+        uh, uw = attrs["ksize"][0] * oh, attrs["ksize"][1] * ow
+    return {"Out": x.with_shape((n, c, uh, uw))}
+
+
+@register_shape_fn("batch_norm")
+def _batch_norm_shape(op, ins, attrs):
+    x = first(ins, "X")
+    scale = first(ins, "Scale")
+    if x.shape is not None and scale.shape is not None and \
+            len(x.shape) >= 2 and not dim_ok(x.shape[1], scale.shape[-1]):
+        raise ShapeError(
+            f"batch_norm: channel dim {x.shape[1]} != Scale size "
+            f"{scale.shape[-1]}")
+    res = {"Y": x}
+    res.update(mirror({"MeanOut": "Mean", "VarianceOut": "Variance",
+                       "SavedMean": "Mean", "SavedVariance": "Variance"})(
+        op, ins, attrs))
+    return res
+
+
+@register_shape_fn("layer_norm")
+def _layer_norm_shape(op, ins, attrs):
+    x = first(ins, "X")
+    res = {"Y": x}
+    if x.shape is not None:
+        begin = attrs.get("begin_norm_axis", 1)
+        stat = VarInfo(x.shape[:begin], x.dtype)
+        res["Mean"] = stat
+        res["Variance"] = stat
+    return res
+
+
+@register_shape_fn("cross_entropy")
+def _cross_entropy_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Y": x}
+    return {"Y": x.with_shape(x.shape[:-1] + (1,))}
+
+
+@register_shape_fn("softmax_with_cross_entropy")
+def _softmax_ce_shape(op, ins, attrs):
+    logits, label = first(ins, "Logits"), first(ins, "Label")
+    if logits.shape is None:
+        return {"Softmax": logits, "Loss": VarInfo(None, logits.dtype)}
+    if label.shape is not None and not attrs.get("soft_label", False):
+        if not dim_ok(label.shape[0], logits.shape[0]):
+            raise ShapeError(
+                f"softmax_with_cross_entropy: batch mismatch Logits "
+                f"{list(logits.shape)} vs Label {list(label.shape)}")
+    return {"Softmax": logits,
+            "Loss": logits.with_shape(logits.shape[:-1] + (1,))}
+
+
+@register_shape_fn("dropout")
+def _dropout_shape(op, ins, attrs):
+    x = first(ins, "X")
+    return {"Out": x, "Mask": x}
+
+
+@register_shape_fn("lrn")
+def _lrn_shape(op, ins, attrs):
+    x = first(ins, "X")
+    return {"Out": x, "MidOut": x}
+
+
+@register_shape_fn("maxout")
+def _maxout_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    g = attrs["groups"]
+    n, c, h, w = x.shape
+    if c >= 0 and c % g:
+        raise ShapeError(f"maxout: channels {c} not divisible by groups {g}")
+    return {"Out": x.with_shape((n, -1 if c < 0 else c // g, h, w))}
+
+
+@register_shape_fn("bilinear_interp")
+def _bilinear_interp_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    return {"Out": x.with_shape(x.shape[:2] + (attrs["out_h"],
+                                               attrs["out_w"]))}
+
+
+@register_shape_fn("spp")
+def _spp_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    n, c = x.shape[0], x.shape[1]
+    bins = sum(4 ** lv for lv in range(attrs.get("pyramid_height", 3)))
+    return {"Out": x.with_shape((n, -1 if c < 0 else c * bins))}
+
+
+@register_shape_fn("im2sequence", "block_expand")
+def _im2sequence_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    kh, kw = _pair(attrs.get("kernels", attrs.get("block", [1, 1])))
+    sh, sw = _pair(attrs.get("strides", [1, 1]))
+    ph, pw = _pair(attrs.get("paddings", [0, 0]))
+    n, c, h, wd = x.shape
+    oh = conv_out_dim(h, kh, ph, sh)
+    ow = conv_out_dim(wd, kw, pw, sw)
+    t = -1 if oh < 0 or ow < 0 else oh * ow
+    d = -1 if c < 0 else c * kh * kw
+    return {"Out": x.with_shape((n, t, d))}

@@ -504,3 +504,23 @@ def _flash_attention_op(ctx, ins, attrs):
         block_q=attrs.get("block_q", 1024),   # swept best at 16k AND 32k
         block_k=attrs.get("block_k", 1024),
         interpret=attrs.get("interpret", False))}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rule: flash_attention is shape-preserving on Q.
+# ---------------------------------------------------------------------------
+from ..analysis.shape_infer import ShapeError, dim_ok, first  # noqa: E402
+from ..core.registry import register_shape_fn  # noqa: E402
+
+
+@register_shape_fn("flash_attention")
+def _flash_attention_shape(op, ins, attrs):
+    q, k, v = first(ins, "Q"), first(ins, "K"), first(ins, "V")
+    for name, o in (("K", k), ("V", v)):
+        if q.shape is not None and o.shape is not None:
+            if len(o.shape) != len(q.shape) or \
+                    not dim_ok(q.shape[-1], o.shape[-1]):
+                raise ShapeError(
+                    f"flash_attention: Q {list(q.shape)} vs {name} "
+                    f"{list(o.shape)} (rank or head dim mismatch)")
+    return {"Out": q}

@@ -315,15 +315,21 @@ def _sequence_slice(ctx, ins, attrs):
     return {"Out": out}
 
 
+def _masked_reverse(x, lens):
+    """Reverse the first ``lens[b]`` steps of each row, padding stays put
+    (reference sequence_reverse_op semantics)."""
+    B, T = x.shape[0], x.shape[1]
+    tpos = jnp.arange(T)[None, :]
+    idx = jnp.where(tpos < lens[:, None], lens[:, None] - 1 - tpos, tpos)
+    return jnp.take_along_axis(
+        x, idx.reshape((B, T) + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1)
+
+
 @register_op("sequence_reverse")
 def _sequence_reverse(ctx, ins, attrs):
     x = ins["X"][0]
     lens = _seq_lens_or_full(ctx, x)
-    B, T = x.shape[0], x.shape[1]
-    tpos = jnp.arange(T)[None, :]
-    idx = jnp.where(tpos < lens[:, None], lens[:, None] - 1 - tpos, tpos)
-    out = jnp.take_along_axis(
-        x, idx.reshape((B, T) + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1)
+    out = _masked_reverse(x, lens)
     ctx.set_len(ctx.op.outputs["Y" if "Y" in ctx.op.outputs else "Out"][0], lens)
     return {("Y" if "Y" in ctx.op.outputs else "Out"): out}
 
@@ -439,6 +445,9 @@ def _lstm(ctx, ins, attrs):
             wi, wf, wo = peep[:H], peep[H:2 * H], peep[2 * H:]
     h0 = ins["H0"][0] if "H0" in ins and ins["H0"] else jnp.zeros((B, H), x.dtype)
     c0 = ins["C0"][0] if "C0" in ins and ins["C0"] else jnp.zeros((B, H), x.dtype)
+    is_reverse = attrs.get("is_reverse", False)
+    if is_reverse:
+        x = _masked_reverse(x, lens)
     xt_seq = jnp.swapaxes(x, 0, 1)              # [T, B, 4H]
     step_mask = _mask(lens, T, x.dtype).T       # [T, B]
 
@@ -468,6 +477,9 @@ def _lstm(ctx, ins, attrs):
     (_, _), (hs, cs) = lax.scan(step, (h0, c0), (xt_seq, step_mask))
     hidden = jnp.swapaxes(hs, 0, 1)
     cell = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        hidden = _masked_reverse(hidden, lens)
+        cell = _masked_reverse(cell, lens)
     for slot, val in (("Hidden", hidden), ("Cell", cell)):
         if slot in ctx.op.outputs and ctx.op.outputs[slot]:
             ctx.set_len(ctx.op.outputs[slot][0], lens)
@@ -488,6 +500,9 @@ def _gru(ctx, ins, attrs):
     w_ur = w[:, :2 * H]
     w_c = w[:, 2 * H:]
     h0 = ins["H0"][0] if "H0" in ins and ins["H0"] else jnp.zeros((B, H), x.dtype)
+    is_reverse = attrs.get("is_reverse", False)
+    if is_reverse:
+        x = _masked_reverse(x, lens)
     xt_seq = jnp.swapaxes(x, 0, 1)
     step_mask = _mask(lens, T, x.dtype).T
 
@@ -510,6 +525,8 @@ def _gru(ctx, ins, attrs):
 
     _, hs = lax.scan(step, h0, (xt_seq, step_mask))
     hidden = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        hidden = _masked_reverse(hidden, lens)
     if "Hidden" in ctx.op.outputs and ctx.op.outputs["Hidden"]:
         ctx.set_len(ctx.op.outputs["Hidden"][0], lens)
     return {"Hidden": hidden}
@@ -571,3 +588,210 @@ def _kmax_seq_score(ctx, ins, attrs):
     if k_eff < k:
         out = jnp.pad(out, ((0, 0), (0, k - k_eff)), constant_values=-1)
     return {"Out": out.astype(jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rules (analysis.shape_infer) over the padded+lengths
+# representation — the InferShape analogs of sequence_*_op.cc and
+# lstm_op.cc/gru_op.cc.
+# ---------------------------------------------------------------------------
+from ..analysis.shape_infer import (ShapeError, VarInfo,  # noqa: E402
+                                    conv_out_dim, dim_ok, first, same_as)
+from ..core.registry import register_shape_fn  # noqa: E402
+
+register_shape_fn("sequence_softmax", "sequence_slice", "sequence_unpad",
+                  "lod_reset", "row_conv")(same_as("X"))
+
+
+@register_shape_fn("sequence_pool")
+def _sequence_pool_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None or len(x.shape) < 2:
+        return {"Out": VarInfo(None, x.dtype)}
+    # [B, T, ...] -> [B, ...]; the nested (lod-2) LAST/FIRST form drops two
+    # dims, but lod levels are runtime metadata — stay at the common case
+    # and let the declaration fill the gap when it disagrees in rank only.
+    return {"Out": x.with_shape(x.shape[:1] + x.shape[2:])}
+
+
+@register_shape_fn("sequence_expand", "sequence_expand_as")
+def _sequence_expand_shape(op, ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    if x.shape is None:
+        return {"Out": x}
+    if y.shape is not None and len(y.shape) >= 2 and \
+            len(x.shape) < len(y.shape):
+        return {"Out": x.with_shape(x.shape[:1] + (y.shape[1],)
+                                    + x.shape[1:])}
+    return {"Out": x}
+
+
+@register_shape_fn("sequence_concat")
+def _sequence_concat_shape(op, ins, attrs):
+    xs = [v for v in ins.get("X", []) if v is not None]
+    known = [v for v in xs if v.shape is not None]
+    if not known or len(known) != len(xs):
+        return {"Out": VarInfo(None, xs[0].dtype if xs else None)}
+    base = known[0]
+    t = 0
+    for v in known:
+        if len(v.shape) != len(base.shape) or \
+                not all(dim_ok(a, b) for a, b in
+                        zip(v.shape[2:], base.shape[2:])):
+            raise ShapeError(
+                f"sequence_concat: feature dims differ: "
+                f"{list(base.shape)} vs {list(v.shape)}")
+        t = -1 if t < 0 or v.shape[1] < 0 else t + v.shape[1]
+    return {"Out": base.with_shape(base.shape[:1] + (t,) + base.shape[2:])}
+
+
+@register_shape_fn("sequence_context")
+def _sequence_context_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    if len(x.shape) != 3:
+        raise ShapeError(
+            f"sequence_context: X must be [B, T, D], got {list(x.shape)}")
+    b, t, d = x.shape
+    ctx_len = attrs.get("contextLength", 3)
+    return {"Out": x.with_shape((b, t, -1 if d < 0 else ctx_len * d))}
+
+
+@register_shape_fn("sub_nested_seq")
+def _sub_nested_seq_shape(op, ins, attrs):
+    x, sel = first(ins, "X"), first(ins, "Selection")
+    if x.shape is None or sel.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    k = sel.shape[1] if len(sel.shape) >= 2 else 1
+    return {"Out": x.with_shape(x.shape[:1] + (k,) + x.shape[2:])}
+
+
+@register_shape_fn("conv2d_dynamic_filter")
+def _conv2d_dynamic_filter_shape(op, ins, attrs):
+    x = first(ins, "Input")
+    if x.shape is None:
+        return {"Output": x}
+    o, i, kh, kw = attrs["filter_shape"]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    pads = tuple(attrs.get("paddings", [0, 0]))
+    return {"Output": VarInfo(
+        (x.shape[0], o, conv_out_dim(x.shape[2], kh, pads[0], strides[0]),
+         conv_out_dim(x.shape[3], kw, pads[1], strides[1])), x.dtype)}
+
+
+@register_shape_fn("sequence_conv")
+def _sequence_conv_shape(op, ins, attrs):
+    x, w = first(ins, "X"), first(ins, "Filter")
+    if x.shape is None:
+        return {"Out": x}
+    if w.shape is not None and x.shape[-1] >= 0 and w.shape[0] >= 0:
+        ctx_len = attrs.get("contextLength", 3)
+        if w.shape[0] != ctx_len * x.shape[-1]:
+            raise ShapeError(
+                f"sequence_conv: Filter rows {w.shape[0]} != "
+                f"contextLength {ctx_len} * D {x.shape[-1]}")
+    m = w.shape[-1] if w.shape is not None else -1
+    return {"Out": x.with_shape(x.shape[:-1] + (m,))}
+
+
+@register_shape_fn("sequence_reverse")
+def _sequence_reverse_shape(op, ins, attrs):
+    x = first(ins, "X")
+    out_slot = "Y" if op.outputs.get("Y") else "Out"
+    return {out_slot: x}
+
+
+@register_shape_fn("sequence_reshape")
+def _sequence_reshape_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    new_dim = attrs["new_dim"]
+    b, t, d = x.shape
+    if t >= 0 and d >= 0:
+        if (t * d) % new_dim:
+            raise ShapeError(
+                f"sequence_reshape: T*D={t * d} not divisible by new_dim "
+                f"{new_dim}")
+        return {"Out": x.with_shape((b, t * d // new_dim, new_dim))}
+    return {"Out": x.with_shape((b, -1, new_dim))}
+
+
+@register_shape_fn("sequence_pad")
+def _sequence_pad_shape(op, ins, attrs):
+    x = first(ins, "X")
+    b = x.shape[0] if x.shape is not None else -1
+    return {"Out": x, "Length": VarInfo((b,), "int32")}
+
+
+@register_shape_fn("max_sequence_len")
+def _max_sequence_len_shape(op, ins, attrs):
+    return {"Out": VarInfo((), "int64")}
+
+
+@register_shape_fn("lstm")
+def _lstm_shape(op, ins, attrs):
+    x, w = first(ins, "Input"), first(ins, "Weight")
+    if x.shape is None:
+        return {"Hidden": x, "Cell": x}
+    b, t, h4 = x.shape
+    if h4 >= 0 and h4 % 4:
+        raise ShapeError(f"lstm: input width {h4} is not 4*H")
+    h = -1 if h4 < 0 else h4 // 4
+    if w.shape is not None and h >= 0 and \
+            (len(w.shape) != 2
+             or not all(dim_ok(a, b)
+                        for a, b in zip(w.shape, (h, h4)))):
+        raise ShapeError(
+            f"lstm: Weight {list(w.shape)} != [H, 4H] = [{h}, {h4}]")
+    info = VarInfo((b, t, h), x.dtype)
+    return {"Hidden": info, "Cell": info}
+
+
+@register_shape_fn("gru")
+def _gru_shape(op, ins, attrs):
+    x, w = first(ins, "Input"), first(ins, "Weight")
+    if x.shape is None:
+        return {"Hidden": x}
+    b, t, h3 = x.shape
+    if h3 >= 0 and h3 % 3:
+        raise ShapeError(f"gru: input width {h3} is not 3*H")
+    h = -1 if h3 < 0 else h3 // 3
+    if w.shape is not None and h >= 0 and \
+            (len(w.shape) != 2
+             or not all(dim_ok(a, b)
+                        for a, b in zip(w.shape, (h, h3)))):
+        raise ShapeError(
+            f"gru: Weight {list(w.shape)} != [H, 3H] = [{h}, {h3}]")
+    return {"Hidden": VarInfo((b, t, h), x.dtype)}
+
+
+@register_shape_fn("lstm_unit")
+def _lstm_unit_shape(op, ins, attrs):
+    gates, c_prev = first(ins, "X"), first(ins, "C_prev")
+    if gates.shape is not None and c_prev.shape is not None and \
+            gates.shape[-1] >= 0 and c_prev.shape[-1] >= 0 and \
+            gates.shape[-1] != 4 * c_prev.shape[-1]:
+        raise ShapeError(
+            f"lstm_unit: gates width {gates.shape[-1]} != 4 * H "
+            f"{c_prev.shape[-1]}")
+    return {"C": c_prev, "H": c_prev}
+
+
+@register_shape_fn("gru_unit")
+def _gru_unit_shape(op, ins, attrs):
+    x, h = first(ins, "Input"), first(ins, "HiddenPrev")
+    res = {"Hidden": h}
+    if h.shape is not None and h.shape[-1] >= 0:
+        res["Gate"] = h.with_shape(h.shape[:-1] + (2 * h.shape[-1],))
+        res["ResetHiddenPrev"] = h
+    return res
+
+
+@register_shape_fn("kmax_seq_score")
+def _kmax_seq_score_shape(op, ins, attrs):
+    x = first(ins, "X")
+    b = x.shape[0] if x.shape is not None else -1
+    k = int(attrs.get("beam_size", attrs.get("k", 1)))
+    return {"Out": VarInfo((b, k), "int64")}

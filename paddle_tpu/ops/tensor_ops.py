@@ -315,3 +315,404 @@ def _reverse(ctx, ins, attrs):
     if not isinstance(axes, (list, tuple)):
         axes = [axes]
     return {"Out": jnp.flip(ins["X"][0], axis=tuple(axes))}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype rules (analysis.shape_infer) — reshape/concat/split etc.
+# InferShape analogs of the reference's data-movement ops.
+# ---------------------------------------------------------------------------
+from ..analysis.shape_infer import (ShapeError, VarInfo,  # noqa: E402
+                                    dim_ok, filled_from_attrs, first,
+                                    numpy_broadcast, passthrough,
+                                    prod_dims, same_as, shapes_compatible,
+                                    squeeze_ids, unify_dim)
+from ..core.registry import register_shape_fn  # noqa: E402
+
+register_shape_fn("feed", "fetch")(passthrough("X"))
+register_shape_fn("assign", "fill_zeros_like", "fill_any_like", "shuffle",
+                  "reverse")(same_as("X"))
+register_shape_fn("scatter")(same_as("X"))
+register_shape_fn("fill_constant", "gaussian_random", "uniform_random",
+                  "truncated_gaussian_random")(filled_from_attrs())
+@register_shape_fn("where", "select")
+def _where_shape(op, ins, attrs):
+    # jnp.where broadcasts all three operands
+    cond, x, y = first(ins, "Condition"), first(ins, "X"), first(ins, "Y")
+    if x.shape is None or y.shape is None or cond.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    shape = numpy_broadcast(numpy_broadcast(cond.shape, x.shape,
+                                            "where Condition/X"),
+                            y.shape, "where X/Y")
+    return {"Out": VarInfo(shape, x.dtype)}
+
+
+@register_shape_fn("shape")
+def _shape_shape(op, ins, attrs):
+    x = first(ins, "X")
+    nd = -1 if x.shape is None else len(x.shape)
+    return {"Out": VarInfo((nd,), "int64")}
+
+
+@register_shape_fn("fill_constant_batch_size_like")
+def _fill_cbsl_shape(op, ins, attrs):
+    ref = first(ins, "Input")
+    shape = list(attrs.get("shape", []))
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    if not shape:
+        return {"Out": VarInfo(None, attrs.get("dtype", "float32"))}
+    if ref.shape is not None:
+        if in_idx >= len(ref.shape) or out_idx >= len(shape):
+            raise ShapeError(
+                f"fill_constant_batch_size_like: dim idx ({in_idx}, "
+                f"{out_idx}) out of range for {list(ref.shape)} -> {shape}")
+        shape[out_idx] = ref.shape[in_idx]
+    else:
+        shape[out_idx] = -1
+    return {"Out": VarInfo(shape, attrs.get("dtype", "float32"))}
+
+
+@register_shape_fn("assign_value")
+def _assign_value_shape(op, ins, attrs):
+    import numpy as _np
+    dt = attrs.get("dtype", "float32")
+    if attrs.get("shape"):
+        return {"Out": VarInfo(tuple(attrs["shape"]), dt)}
+    vals = attrs.get("values")
+    if vals is None:
+        return {"Out": VarInfo(None, dt)}
+    return {"Out": VarInfo(_np.shape(vals), dt)}
+
+
+@register_shape_fn("reshape")
+def _reshape_shape(op, ins, attrs):
+    x = first(ins, "X")
+    shape = list(attrs.get("shape", []))
+    if x.shape is None or not shape:
+        return {"Out": VarInfo(None, x.dtype)}
+    for i, s in enumerate(shape):
+        if s == 0:
+            if i >= len(x.shape):
+                raise ShapeError(
+                    f"reshape: dim {i} copies input dim but input rank is "
+                    f"{len(x.shape)}")
+            shape[i] = x.shape[i]
+    neg = [i for i, s in enumerate(shape) if s == -1]
+    if len(neg) > 1:
+        raise ShapeError(f"reshape: more than one -1 in {shape}")
+    total = prod_dims(x.shape)
+    known = prod_dims([s for i, s in enumerate(shape) if i not in neg])
+    if total >= 0 and known >= 0:
+        if neg:
+            if known == 0 or total % known:
+                raise ShapeError(
+                    f"reshape: cannot infer -1: {list(x.shape)} "
+                    f"({total} elems) -> {shape}")
+            shape[neg[0]] = total // known
+        elif known != total:
+            raise ShapeError(
+                f"reshape: element count mismatch: {list(x.shape)} "
+                f"({total}) -> {shape} ({known})")
+    elif neg:
+        shape[neg[0]] = -1
+    return {"Out": VarInfo(shape, x.dtype)}
+
+
+@register_shape_fn("squeeze")
+def _squeeze_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    axes = attrs.get("axes", None)
+    nd = len(x.shape)
+    if axes:
+        axes = {a % nd for a in axes}
+        for a in axes:
+            if x.shape[a] not in (-1, 1):
+                raise ShapeError(
+                    f"squeeze: axis {a} has size {x.shape[a]} != 1 in "
+                    f"{list(x.shape)}")
+        shape = tuple(d for i, d in enumerate(x.shape) if i not in axes)
+    else:
+        shape = tuple(d for d in x.shape if d != 1)
+    return {"Out": x.with_shape(shape)}
+
+
+@register_shape_fn("unsqueeze")
+def _unsqueeze_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    shape = list(x.shape)
+    for a in sorted(attrs.get("axes", [])):
+        shape.insert(a if a >= 0 else a + len(shape) + 1, 1)
+    return {"Out": x.with_shape(shape)}
+
+
+@register_shape_fn("transpose")
+def _transpose_shape(op, ins, attrs):
+    x = first(ins, "X")
+    perm = attrs.get("axis")
+    if x.shape is None or perm is None:
+        return {"Out": x}
+    if sorted(a % len(x.shape) for a in perm) != list(range(len(x.shape))):
+        raise ShapeError(
+            f"transpose: axis {list(perm)} is not a permutation of rank "
+            f"{len(x.shape)}")
+    return {"Out": x.with_shape(tuple(x.shape[a] for a in perm))}
+
+
+@register_shape_fn("concat")
+def _concat_shape(op, ins, attrs):
+    xs = [v for v in ins.get("X", []) if v is not None]
+    known = [v for v in xs if v.shape is not None]
+    if not known:
+        return {"Out": VarInfo(None, xs[0].dtype if xs else None)}
+    nd = len(known[0].shape)
+    axis = attrs.get("axis", 0) % nd
+    shape = list(known[0].shape)
+    for v in known[1:]:
+        if len(v.shape) != nd:
+            raise ShapeError(
+                f"concat: rank mismatch {list(known[0].shape)} vs "
+                f"{list(v.shape)}")
+        for i in range(nd):
+            if i != axis and not dim_ok(shape[i], v.shape[i]):
+                raise ShapeError(
+                    f"concat: non-axis dim {i} differs: "
+                    f"{list(known[0].shape)} vs {list(v.shape)}")
+            shape[i] = unify_dim(shape[i], v.shape[i]) if i != axis \
+                else shape[i]
+    if len(known) == len(xs):
+        cat = 0
+        for v in known:
+            if v.shape[axis] < 0:
+                cat = -1
+                break
+            cat += v.shape[axis]
+    else:
+        cat = -1
+    shape[axis] = cat
+    return {"Out": VarInfo(shape, known[0].dtype)}
+
+
+@register_shape_fn("split")
+def _split_shape(op, ins, attrs):
+    x = first(ins, "X")
+    names = op.outputs.get("Out", [])
+    if x.shape is None:
+        return {"Out": [VarInfo(None, x.dtype)] * len(names)}
+    axis = attrs.get("axis", 0) % len(x.shape)
+    sections = attrs.get("sections")
+    if sections:
+        if len(sections) != len(names):
+            raise ShapeError(
+                f"split: {len(sections)} sections for {len(names)} outputs")
+        if x.shape[axis] >= 0 and sum(sections) != x.shape[axis]:
+            raise ShapeError(
+                f"split: sections {list(sections)} do not sum to dim "
+                f"{x.shape[axis]}")
+        return {"Out": [x.with_shape(x.shape[:axis] + (s,)
+                                     + x.shape[axis + 1:])
+                        for s in sections]}
+    num = attrs.get("num", len(names))
+    if x.shape[axis] >= 0 and num and x.shape[axis] % num:
+        raise ShapeError(
+            f"split: dim {x.shape[axis]} not divisible into {num} parts")
+    part = -1 if x.shape[axis] < 0 else x.shape[axis] // num
+    return {"Out": [x.with_shape(x.shape[:axis] + (part,)
+                                 + x.shape[axis + 1:])] * len(names)}
+
+
+@register_shape_fn("pad")
+def _pad_shape(op, ins, attrs):
+    x = first(ins, "X")
+    p = attrs.get("paddings")
+    if x.shape is None or p is None:
+        return {"Out": x}
+    if len(p) != 2 * len(x.shape):
+        raise ShapeError(
+            f"pad: {len(p)} padding entries for rank {len(x.shape)}")
+    shape = tuple(d if d < 0 else d + p[2 * i] + p[2 * i + 1]
+                  for i, d in enumerate(x.shape))
+    return {"Out": x.with_shape(shape)}
+
+
+@register_shape_fn("crop")
+def _crop_shape(op, ins, attrs):
+    x = first(ins, "X")
+    shape = attrs.get("shape")
+    if shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    offsets = attrs.get("offsets") or (0,) * len(shape)
+    if x.shape is not None:
+        # a negative size keeps to the end — the lowering slices x[o:],
+        # so the dim is input minus offset (symbolic when input is)
+        out = tuple(
+            (x.shape[i] - offsets[i] if x.shape[i] >= 0 else -1)
+            if s < 0 else s
+            for i, s in enumerate(shape))
+    else:
+        out = tuple(s if s >= 0 else -1 for s in shape)
+    return {"Out": VarInfo(out, x.dtype)}
+
+
+@register_shape_fn("expand")
+def _expand_shape(op, ins, attrs):
+    return _tile_like(first(ins, "X"), attrs.get("expand_times"))
+
+
+@register_shape_fn("tile")
+def _tile_shape(op, ins, attrs):
+    return _tile_like(first(ins, "X"), attrs.get("repeat_times"))
+
+
+def _tile_like(x, times):
+    if x.shape is None or times is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    times = list(times)
+    if len(times) < len(x.shape):
+        times = [1] * (len(x.shape) - len(times)) + times
+    shape = [1] * (len(times) - len(x.shape)) + list(x.shape)
+    out = tuple(d if d < 0 else d * t for d, t in zip(shape, times))
+    return {"Out": VarInfo(out, x.dtype)}
+
+
+@register_shape_fn("slice")
+def _slice_shape(op, ins, attrs):
+    x = first(ins, "Input") if ins.get("Input") else first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    shape = list(x.shape)
+    for ax, st, en in zip(attrs.get("axes", []), attrs.get("starts", []),
+                          attrs.get("ends", [])):
+        ax = ax % len(shape)
+        d = shape[ax]
+        if d < 0:
+            continue
+        lo = max(st + d, 0) if st < 0 else min(st, d)
+        hi = max(en + d, 0) if en < 0 else min(en, d)
+        shape[ax] = max(hi - lo, 0)
+    return {"Out": x.with_shape(shape)}
+
+
+@register_shape_fn("gather")
+def _gather_shape(op, ins, attrs):
+    x, idx = first(ins, "X"), first(ins, "Index")
+    if x.shape is None or idx.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    axis = attrs.get("axis", 0) % len(x.shape)
+    # NO [N,1]->[N] squeeze: the lowering is a plain jnp.take, so a 2-D
+    # index really does produce (..., N, 1, ...) — the rule must describe
+    # the runtime, not the reference's squeezing variant
+    return {"Out": VarInfo(x.shape[:axis] + idx.shape
+                           + x.shape[axis + 1:], x.dtype)}
+
+
+@register_shape_fn("multiplex")
+def _multiplex_shape(op, ins, attrs):
+    x = first(ins, "X")
+    return {"Out": x}
+
+
+@register_shape_fn("top_k")
+def _top_k_shape(op, ins, attrs):
+    x = first(ins, "X")
+    k = attrs.get("k", 1)
+    if x.shape is None:
+        return {"Out": x, "Indices": VarInfo(None, "int64")}
+    if x.shape[-1] >= 0 and k > x.shape[-1]:
+        raise ShapeError(f"top_k: k={k} > last dim {x.shape[-1]}")
+    shape = x.shape[:-1] + (k,)
+    return {"Out": x.with_shape(shape),
+            "Indices": VarInfo(shape, "int64")}
+
+
+@register_shape_fn("sampling_id")
+def _sampling_id_shape(op, ins, attrs):
+    x = first(ins, "X")
+    b = x.shape[0] if x.shape is not None else -1
+    return {"Out": VarInfo((b,), "int64")}
+
+
+@register_shape_fn("argmax", "arg_max", "max_ids")
+def _argmax_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": VarInfo(None, "int64")}
+    axis = attrs.get("axis", -1) % len(x.shape)
+    return {"Out": VarInfo(x.shape[:axis] + x.shape[axis + 1:], "int64")}
+
+
+@register_shape_fn("argsort")
+def _argsort_shape(op, ins, attrs):
+    x = first(ins, "X")
+    return {"Out": x, "Indices": VarInfo(x.shape, "int64")}
+
+
+@register_shape_fn("one_hot")
+def _one_hot_shape(op, ins, attrs):
+    ids = first(ins, "X")
+    s = squeeze_ids(ids)
+    if s is None:
+        return {"Out": VarInfo(None, "float32")}
+    return {"Out": VarInfo(s + (attrs["depth"],), "float32")}
+
+
+@register_shape_fn("range")
+def _range_shape(op, ins, attrs):
+    start, end = attrs.get("start"), attrs.get("end")
+    step = attrs.get("step", 1)
+    dt = attrs.get("dtype", "int64")
+    try:
+        n = max(0, int(-(-(end - start) // step)))
+    except (TypeError, ZeroDivisionError):
+        n = -1
+    return {"Out": VarInfo((n,), dt)}
+
+
+@register_shape_fn("flatten")
+def _flatten_shape(op, ins, attrs):
+    x = first(ins, "X")
+    if x.shape is None:
+        return {"Out": x}
+    ax = attrs.get("axis", 1)
+    return {"Out": x.with_shape((prod_dims(x.shape[:ax]),
+                                 prod_dims(x.shape[ax:])))}
+
+
+@register_shape_fn("stack")
+def _stack_shape(op, ins, attrs):
+    xs = [v for v in ins.get("X", []) if v is not None]
+    base = next((v for v in xs if v.shape is not None), None)
+    if base is None:
+        return {"Out": VarInfo(None, xs[0].dtype if xs else None)}
+    for v in xs:
+        if not shapes_compatible(v.shape, base.shape):
+            raise ShapeError(
+                f"stack: operand shapes differ: {list(base.shape)} vs "
+                f"{list(v.shape)}")
+    axis = attrs.get("axis", 0)
+    nd = len(base.shape) + 1
+    axis = axis % nd
+    shape = base.shape[:axis] + (len(xs),) + base.shape[axis:]
+    return {"Out": VarInfo(shape, base.dtype)}
+
+
+@register_shape_fn("unstack")
+def _unstack_shape(op, ins, attrs):
+    x = first(ins, "X")
+    names = op.outputs.get("Y", [])
+    if x.shape is None:
+        return {"Y": [VarInfo(None, x.dtype)] * len(names)}
+    axis = attrs.get("axis", 0) % len(x.shape)
+    if x.shape[axis] >= 0 and len(names) not in (0, x.shape[axis]):
+        raise ShapeError(
+            f"unstack: {len(names)} outputs for dim {x.shape[axis]}")
+    part = x.shape[:axis] + x.shape[axis + 1:]
+    return {"Y": [x.with_shape(part)] * len(names)}
+
+
+@register_shape_fn("is_empty")
+def _is_empty_shape(op, ins, attrs):
+    return {"Out": VarInfo((), "bool")}

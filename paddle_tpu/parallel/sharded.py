@@ -25,7 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import compile_cache
-from ..core.executor import Executor
+from ..core.executor import Executor, _specs_sig
 from ..core.program import Program
 from .mesh import get_mesh
 
@@ -52,6 +52,11 @@ class ShardedExecutor(Executor):
         # GPipe microbatch count for pipeline_stage-annotated programs
         # (parallel/pipeline_program.py); default = the 'pp' axis size
         self.num_microbatches = num_microbatches
+
+    def _validation_context(self):
+        # the static verifier's sharding lints (PT030/PT031) check
+        # Parameter.sharding and these overrides against the mesh
+        return self.mesh, self.param_specs, self.feed_specs
 
     # -- sharding selection -------------------------------------------------
     def _find_var(self, program: Program, name: str):
@@ -111,10 +116,8 @@ class ShardedExecutor(Executor):
                 tuple(int(mesh.shape[a]) for a in mesh.axis_names),
                 tuple(str(d) for d in np.ravel(mesh.devices)),
                 self.batch_axis, self.num_microbatches,
-                tuple(sorted((k, repr(v))
-                             for k, v in self.feed_specs.items())),
-                tuple(sorted((k, repr(v))
-                             for k, v in self.param_specs.items())))
+                _specs_sig(self.feed_specs),
+                _specs_sig(self.param_specs))
 
     def _state_shardings(self, program: Program, state):
         """Pin only explicitly-annotated params; None leaves let jit keep

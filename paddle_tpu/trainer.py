@@ -70,7 +70,7 @@ class SGD:
               event_handler: Optional[Callable] = None,
               feeding=None, feed_list: Optional[Sequence[Variable]] = None,
               steps_per_dispatch: int = 1, pipeline=False,
-              warmup: bool = False):
+              warmup: bool = False, validate: Optional[bool] = None):
         """reader yields batches (lists of rows); feeding maps data-layer
         names to row positions (v2 trainer.py feeding) or pass feed_list.
 
@@ -103,96 +103,114 @@ class SGD:
         (``PADDLE_TPU_CACHE_DIR``), warmup in a deploy step also persists
         the executables for later processes.  Bucketed readers whose later
         batches change shape still compile those variants on first use.
+
+        ``validate=True`` runs the static program verifier
+        (``paddle_tpu.analysis``) over the startup and training programs
+        before their first trace: a malformed graph fails with a stable
+        ``PT0xx`` diagnostic naming the op instead of a JAX trace error.
+        ``False`` forces it off; ``None`` (default) defers to the
+        ``validate`` flag (``PADDLE_TPU_VALIDATE=1``).  The override
+        applies to this call only — the executor's own setting is
+        restored afterwards.
         """
         event_handler = event_handler or (lambda e: None)
-        if not self._initialized:
-            self.exe.run(default_startup_program(), feed={}, fetch_list=[])
-            self._initialized = True
-        fetch = [self.cost] + self.extra
-        if warmup:
-            self._warmup(reader, feeding, feed_list, fetch,
-                         steps_per_dispatch, pipeline)
+        # validate is a PER-CALL override: restore the executor's own
+        # setting afterwards so a later train() with the default None
+        # defers to the flag again
+        prev_validate = self.exe.validate
+        if validate is not None:
+            self.exe.validate = validate
+        try:
+            if not self._initialized:
+                self.exe.run(default_startup_program(), feed={}, fetch_list=[])
+                self._initialized = True
+            fetch = [self.cost] + self.extra
+            if warmup:
+                self._warmup(reader, feeding, feed_list, fetch,
+                             steps_per_dispatch, pipeline)
 
-        def emit_end(pass_id, batch_id, out):
-            metrics = {getattr(v, "name", str(i)): out[1 + i]
-                       for i, v in enumerate(self.extra)}
-            event_handler(events.EndIteration(
-                pass_id, batch_id, float(out[0]), metrics))
+            def emit_end(pass_id, batch_id, out):
+                metrics = {getattr(v, "name", str(i)): out[1 + i]
+                           for i, v in enumerate(self.extra)}
+                event_handler(events.EndIteration(
+                    pass_id, batch_id, float(out[0]), metrics))
 
-        if pipeline:
-            opts = dict(pipeline) if isinstance(pipeline, dict) else {}
-            K = self._dispatch_k(opts, steps_per_dispatch)
-            workers = int(opts.get("num_workers", 1))
-            buf = int(opts.get("buffer_size", 4))
-            depth = int(opts.get("prefetch_depth", 2))
-            # feed() results live at most until their chunk is stacked /
-            # shipped — K pending plus in-flight slack bounds liveness
-            feeder = self._feeder(feeding, feed_list, staging_slots=K + 2)
-            from .reader.pipeline import prefetch
+            if pipeline:
+                opts = dict(pipeline) if isinstance(pipeline, dict) else {}
+                K = self._dispatch_k(opts, steps_per_dispatch)
+                workers = int(opts.get("num_workers", 1))
+                buf = int(opts.get("buffer_size", 4))
+                depth = int(opts.get("prefetch_depth", 2))
+                # feed() results live at most until their chunk is stacked /
+                # shipped — K pending plus in-flight slack bounds liveness
+                feeder = self._feeder(feeding, feed_list, staging_slots=K + 2)
+                from .reader.pipeline import prefetch
+                for pass_id in range(num_passes):
+                    event_handler(events.BeginPass(pass_id))
+                    # num_workers=0: no reader prefetch stage — decode runs in
+                    # run_pipelined's staging thread (one host thread total;
+                    # right when host cores are scarce)
+                    src = prefetch(reader, buffer_size=buf,
+                                   num_workers=workers) if workers > 0 \
+                        else reader
+                    feed_iter = (feeder.feed(b) for b in src())
+                    for batch_id, out in enumerate(self.exe.run_pipelined(
+                            feed_iter, self.main_program, fetch_list=fetch,
+                            steps_per_dispatch=K, prefetch_depth=depth)):
+                        event_handler(events.BeginIteration(pass_id, batch_id))
+                        emit_end(pass_id, batch_id, out)
+                    event_handler(events.EndPass(pass_id))
+                return
+
+            feeder = self._feeder(feeding, feed_list)
+
+            def flush(pass_id, first_id, chunk):
+                if len(chunk) == 1:
+                    event_handler(events.BeginIteration(pass_id, first_id))
+                    out = self.exe.run(self.main_program, feed=chunk[0],
+                                       fetch_list=fetch)
+                    emit_end(pass_id, first_id, out)
+                    return
+                from .core.executor import stack_feeds
+                stacked = stack_feeds(chunk)
+                outs = self.exe.run_steps(
+                    len(chunk), self.main_program, feed=stacked,
+                    fetch_list=fetch, feeds_stacked=True)
+                for i in range(len(chunk)):
+                    event_handler(events.BeginIteration(pass_id, first_id + i))
+                    emit_end(pass_id, first_id + i, [o[i] for o in outs])
+
             for pass_id in range(num_passes):
                 event_handler(events.BeginPass(pass_id))
-                # num_workers=0: no reader prefetch stage — decode runs in
-                # run_pipelined's staging thread (one host thread total;
-                # right when host cores are scarce)
-                src = prefetch(reader, buffer_size=buf,
-                               num_workers=workers) if workers > 0 \
-                    else reader
-                feed_iter = (feeder.feed(b) for b in src())
-                for batch_id, out in enumerate(self.exe.run_pipelined(
-                        feed_iter, self.main_program, fetch_list=fetch,
-                        steps_per_dispatch=K, prefetch_depth=depth)):
-                    event_handler(events.BeginIteration(pass_id, batch_id))
-                    emit_end(pass_id, batch_id, out)
-                event_handler(events.EndPass(pass_id))
-            return
-
-        feeder = self._feeder(feeding, feed_list)
-
-        def flush(pass_id, first_id, chunk):
-            if len(chunk) == 1:
-                event_handler(events.BeginIteration(pass_id, first_id))
-                out = self.exe.run(self.main_program, feed=chunk[0],
-                                   fetch_list=fetch)
-                emit_end(pass_id, first_id, out)
-                return
-            from .core.executor import stack_feeds
-            stacked = stack_feeds(chunk)
-            outs = self.exe.run_steps(
-                len(chunk), self.main_program, feed=stacked,
-                fetch_list=fetch, feeds_stacked=True)
-            for i in range(len(chunk)):
-                event_handler(events.BeginIteration(pass_id, first_id + i))
-                emit_end(pass_id, first_id + i, [o[i] for o in outs])
-
-        for pass_id in range(num_passes):
-            event_handler(events.BeginPass(pass_id))
-            if steps_per_dispatch <= 1:
+                if steps_per_dispatch <= 1:
+                    for batch_id, batch in enumerate(reader()):
+                        event_handler(events.BeginIteration(pass_id, batch_id))
+                        out = self.exe.run(self.main_program,
+                                           feed=feeder.feed(batch),
+                                           fetch_list=fetch)
+                        emit_end(pass_id, batch_id, out)
+                    event_handler(events.EndPass(pass_id))
+                    continue
+                chunk, first_id, sig = [], 0, None
                 for batch_id, batch in enumerate(reader()):
-                    event_handler(events.BeginIteration(pass_id, batch_id))
-                    out = self.exe.run(self.main_program,
-                                       feed=feeder.feed(batch),
-                                       fetch_list=fetch)
-                    emit_end(pass_id, batch_id, out)
+                    feed = feeder.feed(batch)
+                    fsig = tuple(sorted(
+                        (k, np.shape(v), str(np.asarray(v).dtype))
+                        for k, v in feed.items()))
+                    if chunk and fsig != sig:
+                        flush(pass_id, first_id, chunk)
+                        chunk = []
+                    if not chunk:
+                        first_id, sig = batch_id, fsig
+                    chunk.append(feed)
+                    if len(chunk) == steps_per_dispatch:
+                        flush(pass_id, first_id, chunk)
+                        chunk = []
+                if chunk:
+                    flush(pass_id, first_id, chunk)
                 event_handler(events.EndPass(pass_id))
-                continue
-            chunk, first_id, sig = [], 0, None
-            for batch_id, batch in enumerate(reader()):
-                feed = feeder.feed(batch)
-                fsig = tuple(sorted(
-                    (k, np.shape(v), str(np.asarray(v).dtype))
-                    for k, v in feed.items()))
-                if chunk and fsig != sig:
-                    flush(pass_id, first_id, chunk)
-                    chunk = []
-                if not chunk:
-                    first_id, sig = batch_id, fsig
-                chunk.append(feed)
-                if len(chunk) == steps_per_dispatch:
-                    flush(pass_id, first_id, chunk)
-                    chunk = []
-            if chunk:
-                flush(pass_id, first_id, chunk)
-            event_handler(events.EndPass(pass_id))
+        finally:
+            self.exe.validate = prev_validate
 
     def test(self, reader: Callable, feeding=None, feed_list=None):
         """Average cost (+extras) over a reader without updating params."""

@@ -1,0 +1,714 @@
+"""Static program verifier tests (paddle_tpu.analysis).
+
+Three contracts from the desc-layer parity work:
+
+1. **Seeded-defect matrix** — programmatically corrupt a known-clean
+   program one defect at a time and assert each corruption yields exactly
+   its stable ``PT0xx`` code (and the clean program yields nothing).  The
+   codes are frozen API (analysis/diagnostics.py): a failing assert here
+   means a code changed meaning, which downstream tooling must never see.
+2. **Coverage gate** — every registered op has a ``register_shape_fn``
+   rule or an explicit ``SHAPE_INFER_ALLOWLIST`` entry, never both; a new
+   op without either fails tier-1 instead of silently degrading coverage.
+3. **Zero steady-state overhead** — validation runs at most once per
+   (program, version, fetches), pinned through the ``validations`` counter
+   in ``profiler.compile_stats()``, and an invalid program is rejected
+   BEFORE compile-cache fingerprinting (no trace, no cache entry).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+from paddle_tpu.analysis import (CODES, ProgramVerificationError,
+                                 SHAPE_INFER_ALLOWLIST, coverage)
+from paddle_tpu.core.program import Program
+from paddle_tpu.core.registry import registered_ops, registered_shape_fns
+
+
+# ---------------------------------------------------------------------------
+# Fixture: one small known-clean program (fc classifier)
+# ---------------------------------------------------------------------------
+def _build_clean():
+    """(main, startup, loss) for x[4] -> fc(3, softmax) -> CE -> mean."""
+    main, startup = Program(), Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred = layers.fc(x, size=3, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+    return main, startup, loss
+
+
+def _find_param(program, ndim):
+    for v in program.global_block().vars.values():
+        if v.persistable and v.shape is not None and len(v.shape) == ndim:
+            return v
+    raise AssertionError(f"no persistable rank-{ndim} param found")
+
+
+def _codes(report):
+    return set(report.codes())
+
+
+def test_clean_program_reports_nothing():
+    main, startup, loss = _build_clean()
+    assert len(main.validate(fetch_list=[loss])) == 0
+    assert len(startup.validate()) == 0
+    # a mesh without any specs is also clean
+    assert len(main.validate(fetch_list=[loss], mesh={"dp": 2})) == 0
+
+
+# ---------------------------------------------------------------------------
+# The seeded-defect matrix: one corruption -> exactly one code
+# ---------------------------------------------------------------------------
+def test_pt001_dangling_input():
+    main, _, _ = _build_clean()
+    op = main.global_block().ops[-1]            # the mean op
+    slot = next(iter(op.inputs))
+    op.inputs[slot] = ["missing_var"]
+    assert _codes(main.validate()) == {"PT001"}
+
+
+def test_pt002_declared_never_produced():
+    main, _, _ = _build_clean()
+    b = main.global_block()
+    b.create_var(name="phantom", shape=(-1, 4), dtype="float32")
+    b.create_var(name="phantom_out", shape=(-1, 4), dtype="float32")
+    b.append_op(type="scale", inputs={"X": ["phantom"]},
+                outputs={"Out": ["phantom_out"]}, attrs={"scale": 2.0})
+    assert _codes(main.validate()) == {"PT002"}
+
+
+def test_pt003_undeclared_output():
+    main, _, _ = _build_clean()
+    main.global_block().append_op(
+        type="scale", inputs={"X": ["x"]},
+        outputs={"Out": ["never_declared"]}, attrs={"scale": 1.0})
+    assert _codes(main.validate()) == {"PT003"}
+
+
+def test_pt004_duplicate_writer():
+    main, _, _ = _build_clean()
+    b = main.global_block()
+    b.create_var(name="t1", shape=(-1, 4), dtype="float32")
+    b.append_op(type="scale", inputs={"X": ["x"]},
+                outputs={"Out": ["t1"]}, attrs={"scale": 1.0})
+    b.append_op(type="scale", inputs={"X": ["x"]},
+                outputs={"Out": ["t1"]}, attrs={"scale": 3.0})
+    assert _codes(main.validate()) == {"PT004"}
+
+
+def test_pt005_unregistered_op():
+    main, _, _ = _build_clean()
+    b = main.global_block()
+    b.create_var(name="bogus_out", shape=(-1, 4), dtype="float32")
+    b.append_op(type="totally_bogus_op", inputs={"X": ["x"]},
+                outputs={"Out": ["bogus_out"]})
+    assert _codes(main.validate()) == {"PT005"}
+
+
+def test_pt006_orphaned_len_companion():
+    main, _, _ = _build_clean()
+    main.global_block().create_var(name="seq@LEN", shape=(-1,),
+                                   dtype="int64")
+    assert _codes(main.validate()) == {"PT006"}
+
+
+def test_pt006_len_base_not_a_sequence():
+    main, _, _ = _build_clean()
+    # base exists but is lod_level=0 — a length companion makes no sense
+    main.global_block().create_var(name="x@LEN", shape=(-1,),
+                                   dtype="int64")
+    assert _codes(main.validate()) == {"PT006"}
+
+
+def test_pt006_orphaned_grad():
+    main, _, _ = _build_clean()
+    b = main.global_block()
+    b.create_var(name="g_out", shape=(-1, 4), dtype="float32")
+    b.append_op(type="scale", inputs={"X": ["x@GRAD"]},
+                outputs={"Out": ["g_out"]}, attrs={"scale": 1.0})
+    assert _codes(main.validate()) == {"PT006"}
+
+
+def test_pt007_def_after_use():
+    main, _, _ = _build_clean()
+    ops = main.global_block().ops
+    ops.insert(0, ops.pop())                    # mean now precedes its producer
+    assert _codes(main.validate()) == {"PT007"}
+
+
+def test_pt010_shape_rule_rejects():
+    main, _, _ = _build_clean()
+    w = _find_param(main, ndim=2)
+    w.shape = (5, 3)                            # mul contraction 4 vs 5
+    assert _codes(main.validate()) == {"PT010"}
+
+
+def test_pt011_dtype_flip():
+    main, _, _ = _build_clean()
+    pred = None
+    for op in main.global_block().ops:
+        if op.type == "softmax":
+            pred = op.outputs["Out"][0]
+    main.global_block().var(pred).dtype = np.dtype("int64")
+    assert _codes(main.validate()) == {"PT011"}
+
+
+def test_pt012_shape_contradiction():
+    main, _, _ = _build_clean()
+    pred = None
+    for op in main.global_block().ops:
+        if op.type == "softmax":
+            pred = op.outputs["Out"][0]
+    main.global_block().var(pred).shape = (7, 9)
+    assert _codes(main.validate()) == {"PT012"}
+
+
+def test_pt020_dead_op_tail():
+    main, _, loss = _build_clean()
+    b = main.global_block()
+    b.create_var(name="deadvar", shape=(-1, 4), dtype="float32")
+    b.append_op(type="scale", inputs={"X": ["x"]},
+                outputs={"Out": ["deadvar"]}, attrs={"scale": 1.0})
+    assert _codes(main.validate(fetch_list=[loss])) == {"PT020"}
+    # without fetch targets deadness is undefined -> lint skipped
+    assert len(main.validate()) == 0
+
+
+def test_fetching_len_companion_alone_is_not_dead():
+    # regression: the executor serves `name + "@LEN"` fetches, but the
+    # dead-op lint once seeded reachability with the companion name only —
+    # the producer's output_names hold the BASE name, so every op in a
+    # lengths-only fetch was reported PT020
+    main, startup = Program(), Program()
+    with pt.program_guard(main, startup):
+        words = layers.data("words", shape=[], dtype="int64", lod_level=1)
+        emb = layers.embedding(words, size=[50, 8])
+    assert len(main.validate(fetch_list=[emb.name + "@LEN"])) == 0
+
+
+def test_pt021_unstable_feed_signature():
+    main, _, _ = _build_clean()
+    main.global_block().create_var(
+        name="ragged", shape=(-1, -1), dtype="float32", is_data=True)
+    assert _codes(main.validate()) == {"PT021"}
+
+
+def test_pt022_persistable_rebound():
+    main, _, _ = _build_clean()
+    b = main.global_block()
+    b.create_var(name="running_mean", shape=(4,), dtype="float32",
+                 persistable=True)
+    b.append_op(type="reduce_mean", inputs={"X": ["x"]},
+                outputs={"Out": ["running_mean"]}, attrs={"dim": [0]})
+    assert _codes(main.validate()) == {"PT022"}
+
+
+def test_pt030_unknown_mesh_axis():
+    main, _, loss = _build_clean()
+    _find_param(main, ndim=2).sharding = ("bogus_axis", None)
+    assert _codes(main.validate(fetch_list=[loss],
+                                mesh={"dp": 2})) == {"PT030"}
+    # no mesh context -> sharding lints skipped entirely
+    assert len(main.validate(fetch_list=[loss])) == 0
+
+
+def test_pt031_non_divisible_dim():
+    main, _, loss = _build_clean()
+    w = _find_param(main, ndim=2)
+    assert w.shape == (4, 3)
+    w.sharding = ("dp", None)                   # 4 % 3 != 0
+    assert _codes(main.validate(fetch_list=[loss],
+                                mesh={"dp": 3})) == {"PT031"}
+    # divisible extent is clean
+    assert len(main.validate(fetch_list=[loss], mesh={"dp": 2})) == 0
+
+
+def test_pt030_via_param_specs_override():
+    main, _, loss = _build_clean()
+    w = _find_param(main, ndim=2)
+    rep = main.validate(fetch_list=[loss], mesh={"dp": 2},
+                        param_specs={w.name: ("nope",)})
+    assert _codes(rep) == {"PT030"}
+
+
+def test_raise_on_error_carries_report():
+    main, _, _ = _build_clean()
+    op = main.global_block().ops[-1]
+    slot = next(iter(op.inputs))
+    op.inputs[slot] = ["missing_var"]
+    with pytest.raises(ProgramVerificationError) as ei:
+        main.validate(raise_on_error=True)
+    assert "PT001" in ei.value.report.codes()
+    assert "PT001" in str(ei.value)
+
+
+def test_serialization_roundtrip_still_detects():
+    """Defects survive Program.to_json/from_json — the CLI path."""
+    main, _, _ = _build_clean()
+    op = main.global_block().ops[-1]
+    slot = next(iter(op.inputs))
+    op.inputs[slot] = ["missing_var"]
+    clone = Program.from_json(main.to_json())
+    assert _codes(clone.validate()) == {"PT001"}
+
+
+# ---------------------------------------------------------------------------
+# Shape-rule coverage gate (tier-1: a new op must pick a side)
+# ---------------------------------------------------------------------------
+def test_every_op_has_rule_or_allowlist_entry():
+    ops = set(registered_ops())
+    fns = set(registered_shape_fns())
+    allow = set(SHAPE_INFER_ALLOWLIST)
+    assert not (ops - fns - allow), (
+        f"ops with neither a register_shape_fn rule nor a "
+        f"SHAPE_INFER_ALLOWLIST entry: {sorted(ops - fns - allow)} — add a "
+        f"shape rule next to the lowering (preferred) or allowlist it with "
+        f"a reason")
+    assert not (fns & allow), (
+        f"ops BOTH ruled and allowlisted (drop the allowlist entry): "
+        f"{sorted(fns & allow)}")
+    assert not (allow - ops), (
+        f"stale allowlist entries for unregistered ops: "
+        f"{sorted(allow - ops)}")
+    assert not (fns - ops), (
+        f"shape rules for unregistered ops: {sorted(fns - ops)}")
+
+
+def test_coverage_floor():
+    n, total = coverage()
+    assert n / total >= 0.80, f"shape-rule coverage {n}/{total} below 80%"
+
+
+def test_stack_program_validates_clean():
+    # regression: the stack rule once referenced a helper missing from its
+    # module's import list, so validating ANY stack program raised
+    # NameError (masked into a spurious PT010 by the rule-crash guard)
+    main, _, _ = _build_clean()
+    b = main.global_block()
+    b.create_var(name="s1", shape=(-1, 4), dtype="float32")
+    b.append_op(type="scale", inputs={"X": ["x"]},
+                outputs={"Out": ["s1"]}, attrs={"scale": 2.0})
+    b.create_var(name="stacked", shape=(2, -1, 4), dtype="float32")
+    b.append_op(type="stack", inputs={"X": ["x", "s1"]},
+                outputs={"Out": ["stacked"]}, attrs={"axis": 0})
+    assert len(main.validate()) == 0
+
+
+def test_stack_shape_mismatch_rejected():
+    main, _, _ = _build_clean()
+    b = main.global_block()
+    w = _find_param(main, ndim=2)               # fc weight (4, 3) vs x (-1, 4)
+    b.create_var(name="stacked_bad", shape=None, dtype="float32")
+    b.append_op(type="stack", inputs={"X": ["x", w.name]},
+                outputs={"Out": ["stacked_bad"]}, attrs={"axis": 0})
+    assert _codes(main.validate()) == {"PT010"}
+
+
+def test_crop_rule_matches_lowering_offsets():
+    # regression: negative shape entries slice x[o:] in the lowering, so
+    # the inferred dim is input minus offset — the rule once returned the
+    # full input dim, spuriously PT012-ing correctly declared outputs
+    from paddle_tpu.core.registry import get_shape_fn
+    from paddle_tpu.analysis.shape_infer import VarInfo
+
+    rule = get_shape_fn("crop")
+    out = rule(None, {"X": [VarInfo((10, 8), "float32")]},
+               {"offsets": [2, 0], "shape": [-1, 5]})
+    assert out["Out"].shape == (8, 5)
+
+
+def test_pool_with_index_rule_floors_like_lowering():
+    # the patch-extraction lowering always floors; honoring ceil_mode
+    # here once mispredicted the runtime dims (spurious PT012)
+    from paddle_tpu.core.registry import get_shape_fn
+    from paddle_tpu.analysis.shape_infer import VarInfo
+
+    rule = get_shape_fn("max_pool2d_with_index")
+    out = rule(None, {"X": [VarInfo((1, 2, 7, 7), "float32")]},
+               {"ksize": [3, 3], "strides": [2, 2], "ceil_mode": True})
+    assert out["Out"].shape == (1, 2, 3, 3)
+    assert out["Mask"].shape == (1, 2, 3, 3)
+
+
+def test_where_rule_broadcasts_operands():
+    # jnp.where broadcasts Condition/X/Y; same_as("X") once inferred the
+    # unbroadcast X shape (spurious PT012 on correctly declared outputs)
+    main, _, _ = _build_clean()
+    b = main.global_block()
+    b.create_var(name="wc", shape=(-1, 4), dtype="bool", is_data=True)
+    b.create_var(name="wy", shape=(1, 1), dtype="float32", is_data=True)
+    b.create_var(name="wo", shape=(-1, 4), dtype="float32")
+    b.append_op(type="where",
+                inputs={"Condition": ["wc"], "X": ["x"], "Y": ["wy"]},
+                outputs={"Out": ["wo"]})
+    assert len(main.validate()) == 0
+
+
+def test_elementwise_rule_equal_shapes_any_axis():
+    # regression: _bcast short-circuits equal shapes before the axis
+    # check; the rule once raised 'bad axis' and PT010'd a valid program
+    main, _, _ = _build_clean()
+    b = main.global_block()
+    b.create_var(name="e1", shape=(-1, 4), dtype="float32")
+    b.append_op(type="elementwise_add", inputs={"X": ["x"], "Y": ["x"]},
+                outputs={"Out": ["e1"]}, attrs={"axis": 1})
+    assert len(main.validate()) == 0
+
+
+def test_shape_rules_resolve_all_globals():
+    # the static companion of the regression above: every LOAD_GLOBAL in
+    # every registered rule (and its nested code objects) must resolve in
+    # the rule's module globals or builtins, so a rule can never die with
+    # NameError at validation time
+    import builtins
+    import dis
+    from paddle_tpu.core.registry import get_shape_fn
+
+    def walk(code):
+        yield code
+        for const in code.co_consts:
+            if hasattr(const, "co_code"):
+                yield from walk(const)
+
+    seen, bad = set(), []
+    for name in registered_shape_fns():
+        fn = get_shape_fn(name)
+        if fn.__code__ in seen:
+            continue
+        seen.add(fn.__code__)
+        for code in walk(fn.__code__):
+            for ins in dis.get_instructions(code):
+                if (ins.opname == "LOAD_GLOBAL"
+                        and ins.argval not in fn.__globals__
+                        and not hasattr(builtins, ins.argval)):
+                    bad.append((name, fn.__qualname__, ins.argval))
+    assert not bad, f"shape rules with unresolvable globals: {bad}"
+
+
+def test_diagnostic_codes_are_frozen():
+    # the documented registry: removing or re-purposing a code is a break
+    assert set(CODES) == {
+        "PT001", "PT002", "PT003", "PT004", "PT005", "PT006", "PT007",
+        "PT010", "PT011", "PT012", "PT020", "PT021", "PT022",
+        "PT030", "PT031"}
+
+
+# ---------------------------------------------------------------------------
+# Clean bill of health for the model zoo
+# ---------------------------------------------------------------------------
+_MODEL_BUILDERS = {
+    "mnist_mlp": lambda: [models.mnist_mlp(
+        layers.data("img", shape=[784], dtype="float32"))],
+    "mnist_lenet": lambda: [models.mnist_lenet(
+        layers.data("img", shape=[1, 28, 28], dtype="float32"))],
+    "resnet_cifar": lambda: [models.resnet_cifar(
+        layers.data("img", shape=[3, 16, 16], dtype="float32"), depth=8)],
+    "resnet_imagenet": lambda: [models.resnet_imagenet(
+        layers.data("img", shape=[3, 64, 64], dtype="float32"), depth=18)],
+    "vgg16": lambda: [models.vgg16(
+        layers.data("img", shape=[3, 32, 32], dtype="float32"))],
+    "alexnet": lambda: [models.alexnet(
+        layers.data("img", shape=[3, 224, 224], dtype="float32"))],
+    "googlenet": lambda: [models.googlenet(
+        layers.data("img", shape=[3, 64, 64], dtype="float32"))],
+    "lstm_textcls": lambda: [models.lstm_text_classification(
+        layers.data("words", shape=[], dtype="int64", lod_level=1),
+        vocab_size=50, emb_dim=8, hidden_size=8)],
+    "seq2seq_attention": lambda: [models.seq2seq_attention(
+        layers.data("src", shape=[], dtype="int64", lod_level=1),
+        layers.data("tgt", shape=[], dtype="int64", lod_level=1),
+        src_vocab_size=30, tgt_vocab_size=30, emb_dim=8, hidden_dim=8)],
+    "wide_deep": lambda: [models.wide_deep(
+        [layers.data("f1", shape=[1], dtype="int64"),
+         layers.data("f2", shape=[1], dtype="int64")],
+        layers.data("dense", shape=[4], dtype="float32"),
+        vocab_sizes=[20, 30], emb_dim=4, deep_hidden=(8,))],
+}
+
+
+@pytest.mark.parametrize("name", sorted(_MODEL_BUILDERS))
+def test_model_zoo_validates_clean(name):
+    main, startup = Program(), Program()
+    with pt.program_guard(main, startup):
+        fetch = _MODEL_BUILDERS[name]()
+    rep = main.validate(fetch_list=fetch)
+    assert len(rep) == 0, f"{name}/main:\n{rep.render()}"
+    rep = startup.validate()
+    assert len(rep) == 0, f"{name}/startup:\n{rep.render()}"
+
+
+# ---------------------------------------------------------------------------
+# Executor wiring: memoization, flag deferral, reject-before-cache
+# ---------------------------------------------------------------------------
+def _feeds(rng):
+    return {"x": rng.rand(8, 4).astype("float32"),
+            "label": rng.randint(0, 3, (8, 1))}
+
+
+def test_validation_runs_once_per_signature(rng):
+    main, startup, loss = _build_clean()
+    stats = pt.profiler.compile_stats()
+    v0 = stats.counters["validations"]
+    exe = pt.Executor(validate=True)
+    exe.run(startup, feed={}, fetch_list=[])
+    for _ in range(4):
+        exe.run(main, feed=_feeds(rng), fetch_list=[loss])
+    # once for startup, once for (main, [loss]) — NOT once per step
+    assert stats.counters["validations"] - v0 == 2
+    # run_steps on the same (program, fetches) reuses the memo too
+    exe.run_steps(3, main, feed=_feeds(rng), fetch_list=[loss])
+    assert stats.counters["validations"] - v0 == 2
+    # a different fetch signature is a fresh validation
+    exe.run(main, feed=_feeds(rng), fetch_list=[])
+    assert stats.counters["validations"] - v0 == 3
+    # version churn does not accumulate memo entries: stale-version keys
+    # are swept, so a long-lived mutated program stays bounded
+    for _ in range(5):
+        main._bump_version()
+        exe.run(main, feed=_feeds(rng), fetch_list=[loss])
+    assert len(exe._validated[main]) == 1
+
+
+def test_validation_off_by_default(rng):
+    main, startup, loss = _build_clean()
+    stats = pt.profiler.compile_stats()
+    v0 = stats.counters["validations"]
+    exe = pt.Executor()
+    exe.run(startup, feed={}, fetch_list=[])
+    exe.run(main, feed=_feeds(rng), fetch_list=[loss])
+    assert stats.counters["validations"] - v0 == 0
+
+
+def test_validation_flag_deferral(rng):
+    from paddle_tpu import flags
+    main, startup, loss = _build_clean()
+    stats = pt.profiler.compile_stats()
+    v0 = stats.counters["validations"]
+    flags.set_flag("validate", True)
+    try:
+        exe = pt.Executor()            # validate=None defers to the flag
+        exe.run(startup, feed={}, fetch_list=[])
+        exe.run(main, feed=_feeds(rng), fetch_list=[loss])
+    finally:
+        flags.set_flag("validate", False)
+    assert stats.counters["validations"] - v0 == 2
+
+
+def test_invalid_program_rejected_before_cache(rng):
+    """The reject-before-fingerprint contract: a broken program must not
+    trace, must not enter the executor cache, and must keep failing on
+    retry (error reports are never memoized as 'validated')."""
+    main, startup, loss = _build_clean()
+    op = main.global_block().ops[-1]
+    slot = next(iter(op.inputs))
+    op.inputs[slot] = ["missing_var"]
+
+    stats = pt.profiler.compile_stats()
+    t0 = stats.counters["traces"]
+    exe = pt.Executor(validate=True)
+    with pytest.raises(ProgramVerificationError) as ei:
+        exe.run(main, feed=_feeds(rng), fetch_list=[loss])
+    assert "PT001" in ei.value.report.codes()
+    assert len(exe._cache) == 0
+    assert stats.counters["traces"] - t0 == 0
+    # still raises on the second attempt (not memoized as valid)
+    with pytest.raises(ProgramVerificationError):
+        exe.run(main, feed=_feeds(rng), fetch_list=[loss])
+
+
+def test_trainer_validate_kwarg(rng):
+    from paddle_tpu.trainer import SGD
+    x = layers.data("x", shape=[4], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = layers.fc(x, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    tr = SGD(loss)
+    assert tr.exe.validate is None
+    stats = pt.profiler.compile_stats()
+    v0 = stats.counters["validations"]
+    batch = [[rng.rand(4).astype("float32"),
+              rng.randint(0, 3, (1,)).astype("int64")] for _ in range(4)]
+    tr.train(lambda: iter([batch, batch]), num_passes=1,
+             feed_list=[x, label], validate=True)
+    # startup + train step validated exactly once despite two batches
+    assert stats.counters["validations"] - v0 == 2
+    # the override is per-call: a later train() with the default None
+    # defers to the flag again instead of inheriting True
+    assert tr.exe.validate is None
+    tr.train(lambda: iter([batch]), num_passes=1, feed_list=[x, label])
+    assert stats.counters["validations"] - v0 == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m paddle_tpu check
+# ---------------------------------------------------------------------------
+def test_cli_check(tmp_path):
+    main, _, loss = _build_clean()
+    ok = tmp_path / "prog_ok.json"
+    ok.write_text(main.to_json())
+    op = main.global_block().ops[-1]
+    slot = next(iter(op.inputs))
+    op.inputs[slot] = ["missing_var"]
+    bad = tmp_path / "prog_bad.json"
+    bad.write_text(main.to_json())
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "check", str(ok)],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd="/root/repo")
+    assert r.returncode == 0, r.stderr
+    assert '"check": "PASS"' in r.stdout
+
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "check", str(bad)],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd="/root/repo")
+    assert r.returncode == 1, r.stderr
+    assert "PT001" in r.stdout
+    assert '"check": "FAIL"' in r.stdout
+
+    # a zero/negative mesh size would silently skip the divisibility
+    # lints and PASS — reject it up front
+    from paddle_tpu.cli import _parse_mesh
+    assert _parse_mesh("dp=8,tp=2") == {"dp": 8, "tp": 2}
+    with pytest.raises(SystemExit):
+        _parse_mesh("dp=0")
+    with pytest.raises(SystemExit):
+        _parse_mesh("dp=eight")
+    with pytest.raises(SystemExit):
+        _parse_mesh("dp=8,dp=2")
+
+    # bad inputs get a one-line message, never a traceback
+    notjson = tmp_path / "notes.txt"
+    notjson.write_text("not a program")
+    for target in [str(tmp_path / "nope.json"), str(notjson),
+                   str(tmp_path)]:              # dir without __model__
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu", "check", target],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd="/root/repo")
+        assert r.returncode != 0, target
+        assert "Traceback" not in r.stderr, (target, r.stderr)
+        assert "check:" in r.stderr, (target, r.stderr)
+
+
+def test_sharded_executor_validates_against_mesh(rng):
+    """The ShardedExecutor wires its mesh + spec overrides into the
+    verifier: a param spec naming a non-mesh axis fails PT030 before any
+    trace, via the same validate-before-fingerprint path."""
+    from paddle_tpu.parallel import MeshConfig, ShardedExecutor, make_mesh
+
+    main, startup, loss = _build_clean()
+    w = _find_param(main, ndim=2)
+    mesh = make_mesh(MeshConfig(dp=8))
+    exe = ShardedExecutor(mesh=mesh, validate=True,
+                          param_specs={w.name: ("ghost_axis",)})
+    # the param is declared in the startup program too, so the bad spec
+    # is caught on the very first program that touches it
+    with pytest.raises(ProgramVerificationError) as ei:
+        exe.run(startup, feed={}, fetch_list=[])
+    assert "PT030" in ei.value.report.codes()
+
+    # with a real axis the same program runs clean
+    exe_ok = ShardedExecutor(mesh=mesh, validate=True)
+    exe_ok.run(startup, feed={}, fetch_list=[])
+    exe_ok.run(main, feed=_feeds(rng), fetch_list=[loss])
+
+
+def test_spec_mutation_invalidates_validation_memo(rng):
+    """The validation memo folds the sharding context into its key: a spec
+    override mutated AFTER a successful validation must re-run the
+    sharding lints, not ride the stale (version, fetches) memo into GSPMD."""
+    from paddle_tpu.parallel import MeshConfig, ShardedExecutor, make_mesh
+
+    main, startup, loss = _build_clean()
+    w = _find_param(main, ndim=2)
+    exe = ShardedExecutor(mesh=make_mesh(MeshConfig(dp=8)), validate=True)
+    exe.run(startup, feed={}, fetch_list=[])
+    exe.run(main, feed=_feeds(rng), fetch_list=[loss])      # memoized clean
+    exe.param_specs[w.name] = ("ghost_axis",)
+    with pytest.raises(ProgramVerificationError) as ei:
+        exe.run(main, feed=_feeds(rng), fetch_list=[loss])
+    assert "PT030" in ei.value.report.codes()
+
+
+def test_rule_crash_degrades_to_pt010():
+    """A shape rule blowing up on malformed inputs (wrong rank unpack,
+    missing attr) must surface as a PT010 diagnostic — never escape
+    Program.validate() as the opaque exception the verifier exists to
+    replace."""
+    main, _, _ = _build_clean()
+    b = main.global_block()
+    # rank-3 Input makes _conv2d_transpose_shape's `n, c, h, wd = x.shape`
+    # unpack fail (and ShapeError subclasses ValueError, so a crash here
+    # is otherwise indistinguishable from a diagnostic to callers)
+    b.create_var(name="im3", shape=(2, 3, 8), dtype="float32")
+    b.create_var(name="k", shape=(3, 4, 3, 3), dtype="float32",
+                 persistable=True)
+    b.create_var(name="convt_out", shape=(-1, 4, -1, -1), dtype="float32")
+    b.append_op(type="conv2d_transpose",
+                inputs={"Input": ["im3"], "Filter": ["k"]},
+                outputs={"Output": ["convt_out"]}, attrs={})
+    rep = main.validate()       # must not raise
+    # the malformed conv reports PT010; its never-produced inputs PT002
+    assert "PT010" in rep.codes()
+    assert all(c in ("PT010", "PT002") for c in rep.codes()), rep.render()
+
+
+def test_validation_memo_survives_id_reuse(rng):
+    """The validated-memo is keyed by live Program objects (weakly): a
+    new program allocated at a dead program's address with the same
+    version/fetches must still be validated — and rejected if invalid."""
+    exe = pt.Executor(validate=True)
+    ok_main, ok_startup, ok_loss = _build_clean()
+    exe.run(ok_startup, feed={}, fetch_list=[])
+    exe.run(ok_main, feed=_feeds(rng), fetch_list=[ok_loss])
+    loss_name = ok_loss.name
+    del ok_main, ok_startup, ok_loss            # free -> id() reusable
+    import gc
+    gc.collect()
+    for _ in range(20):                         # give id reuse many shots
+        pt.unique_name.reset()                  # reproduce the var names
+        bad_main, _, bad_loss = _build_clean()
+        assert bad_loss.name == loss_name       # same fetch signature
+        op = bad_main.global_block().ops[-1]
+        slot = next(iter(op.inputs))
+        op.inputs[slot] = ["missing_var"]
+        with pytest.raises(ProgramVerificationError):
+            exe.run(bad_main, feed=_feeds(rng), fetch_list=[bad_loss])
+        del bad_main, bad_loss
+
+
+def test_dead_op_lint_sees_nested_sub_blocks():
+    """Liveness flows through DOUBLY-nested sub-blocks: a global-block
+    producer consumed only inside block 2 (a body within a body) must not
+    be flagged PT020."""
+    main = Program()
+    b0 = main.global_block()
+    b0.create_var(name="x", shape=(-1, 4), dtype="float32", is_data=True)
+    b0.create_var(name="emb", shape=(-1, 4), dtype="float32")
+    b0.create_var(name="out", shape=(-1, 4), dtype="float32")
+    b1 = main.create_block(parent_idx=0)
+    b2 = main.create_block(parent_idx=b1.idx)
+    main.current_block_idx = 0
+    # produced in block 0, read ONLY in block 2
+    b0.append_op(type="scale", inputs={"X": ["x"]},
+                 outputs={"Out": ["emb"]}, attrs={"scale": 1.0})
+    b2.append_op(type="scale", inputs={"X": ["emb"]},
+                 outputs={"Out": ["inner"]}, attrs={"scale": 1.0})
+    b1.append_op(type="while", inputs={}, outputs={},
+                 attrs={"sub_block": b2.idx})
+    b0.append_op(type="while", inputs={"X": ["x"]},
+                 outputs={"Out": ["out"]}, attrs={"sub_block": b1.idx})
+    rep = main.validate(fetch_list=["out"])
+    assert "PT020" not in rep.codes(), rep.render()

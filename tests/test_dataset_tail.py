@@ -541,3 +541,38 @@ def test_cifar_imikolov_uci_parsers_hermetic(tmp_path, rng):
             fh.write(" ".join(f"{v:.4f}" for v in row) + "\n")
     train_rows, test_rows = uci_housing.load_data(str(f))
     assert train_rows.shape[0] == 8 and test_rows.shape[0] == 2
+
+
+def test_conll05_get_dict_prefers_published(tmp_path, monkeypatch):
+    """get_dict loads the reference's published wordDict/verbDict/
+    targetDict (line index == id) when cached, and falls back to the
+    synthetic vocabulary when nothing is available (ADVICE round 5:
+    corpus-derived ids are incompatible with the published embedding)."""
+    from paddle_tpu.dataset import common, conll05
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    # hermetic "published" files: content drives the md5 the probe checks
+    contents = {
+        conll05.WORDDICT_URL: "the\ncat\nsat\n",
+        conll05.VERBDICT_URL: "sit\nrun\n",
+        conll05.TRGDICT_URL: "O\nB-V\nB-A0\n",
+    }
+    d = tmp_path / "conll05st"
+    d.mkdir()
+    for url, text in contents.items():
+        fname = d / url.split("/")[-1]
+        fname.write_text(text)
+        md5 = common.md5file(str(fname))
+        for const in ("WORDDICT", "VERBDICT", "TRGDICT"):
+            if url == getattr(conll05, const + "_URL"):
+                monkeypatch.setattr(conll05, const + "_MD5", md5)
+
+    wd, vd, ld = conll05.get_dict(download=True)
+    assert wd == {"the": 0, "cat": 1, "sat": 2}
+    assert vd == {"sit": 0, "run": 1}
+    assert ld == {"O": 0, "B-V": 1, "B-A0": 2}
+
+    # nothing cached, no download permission -> synthetic vocabulary
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path / "empty"))
+    wd, vd, ld = conll05.get_dict()
+    assert len(wd) == conll05.WORD_VOCAB and "w0" in wd

@@ -1,0 +1,95 @@
+"""Hermetic parity tests for is_reverse recurrences on ragged batches.
+
+Contract (lstm_op.cc/gru_op.cc is_reverse semantics over the padded+@LEN
+representation): for each row with true length L, the reversed recurrence
+equals the forward recurrence run on that row's reversed valid prefix,
+with the output's valid prefix reversed back — and PAD positions never
+leak into valid ones.  The pre-PR-4 implementation reversed the padded
+arrays around the op, which re-reversed PAD positions for ragged batches
+and fed garbage steps first; these tests pin the fixed behavior.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.program import Program
+
+H = 8
+LENS = [5, 2, 7, 1]          # ragged on purpose: max T = 7
+
+
+def _build(kind):
+    """One program holding a forward and a reversed layer over SHARED
+    weights (named ParamAttr), so direction is the only difference."""
+    main, startup = Program(), Program()
+    with pt.program_guard(main, startup):
+        width = 4 * H if kind == "lstm" else 3 * H
+        x = layers.data("x", shape=[width], dtype="float32", lod_level=1)
+        wa = pt.ParamAttr(name=f"{kind}_rev_test.w")
+        ba = pt.ParamAttr(name=f"{kind}_rev_test.b")
+        if kind == "lstm":
+            fwd, _ = layers.dynamic_lstm(x, size=4 * H, param_attr=wa,
+                                         bias_attr=ba)
+            rev, _ = layers.dynamic_lstm(x, size=4 * H, is_reverse=True,
+                                         param_attr=wa, bias_attr=ba)
+        else:
+            fwd = layers.dynamic_gru(x, size=H, param_attr=wa,
+                                     bias_attr=ba)
+            rev = layers.dynamic_gru(x, size=H, is_reverse=True,
+                                     param_attr=wa, bias_attr=ba)
+    return main, startup, x, fwd, rev
+
+
+def _rows(width, rng):
+    return [rng.standard_normal((L, width)).astype("float32") for L in LENS]
+
+
+@pytest.mark.parametrize("kind", ["lstm", "gru"])
+def test_is_reverse_matches_rowwise_reversal(kind):
+    main, startup, x, fwd, rev = _build(kind)
+    exe = pt.Executor()
+    exe.run(startup, feed={}, fetch_list=[])
+    rng = np.random.default_rng(7)
+    rows = _rows(x.shape[-1], rng)
+    feeder = pt.DataFeeder([x], program=main)
+
+    (out_rev,) = exe.run(main, feed=feeder.feed([(r,) for r in rows]),
+                         fetch_list=[rev])
+    (out_fwd,) = exe.run(
+        main, feed=feeder.feed([(r[::-1],) for r in rows]),
+        fetch_list=[fwd])
+    for i, L in enumerate(LENS):
+        np.testing.assert_allclose(
+            np.asarray(out_rev)[i, :L], np.asarray(out_fwd)[i, :L][::-1],
+            rtol=1e-4, atol=1e-5,
+            err_msg=f"{kind} row {i} (len {L}): reversed recurrence != "
+                    f"reversed forward pass over the reversed row")
+
+
+@pytest.mark.parametrize("kind", ["lstm", "gru"])
+def test_is_reverse_pad_positions_do_not_leak(kind):
+    # same valid prefixes, different PAD garbage -> identical valid outputs
+    main, startup, x, _, rev = _build(kind)
+    exe = pt.Executor()
+    exe.run(startup, feed={}, fetch_list=[])
+    rng = np.random.default_rng(11)
+    rows = _rows(x.shape[-1], rng)
+    T, width = max(LENS), x.shape[-1]
+    lens = np.asarray(LENS, dtype="int64")
+
+    padded = np.zeros((len(LENS), T, width), "float32")
+    garbage = rng.standard_normal(padded.shape).astype("float32") * 100.0
+    for i, r in enumerate(rows):
+        padded[i, :len(r)] = r
+        garbage[i, :len(r)] = r
+    (clean,) = exe.run(main, feed={"x": padded, "x@LEN": lens},
+                       fetch_list=[rev])
+    (dirty,) = exe.run(main, feed={"x": garbage, "x@LEN": lens},
+                       fetch_list=[rev])
+    for i, L in enumerate(LENS):
+        np.testing.assert_allclose(
+            np.asarray(clean)[i, :L], np.asarray(dirty)[i, :L],
+            rtol=1e-5, atol=1e-6,
+            err_msg=f"{kind} row {i}: PAD contents leaked into the "
+                    f"reversed recurrence's valid outputs")
