@@ -65,6 +65,7 @@ from . import export_model
 from .export_model import export_compiled_model, load_compiled_model
 from .data_feeder import DataFeeder
 from .param_attr import ParamAttr
+from . import observability
 from . import profiler
 from . import parallel
 from . import distributed
@@ -81,7 +82,11 @@ from . import models
 from .trainer import infer
 from . import framework  # compat alias namespace
 
-__version__ = "0.1.0"
+# NOTE: the version is folded into every compile-cache fingerprint
+# (core/compile_cache.environment_key) — bump it whenever compiled-step
+# calling conventions change (0.2.0: check_nan_inf variants stopped
+# donating state buffers; older persisted executables still alias them)
+__version__ = "0.2.0"
 
 __all__ = [
     "Program", "Block", "Operator", "Variable", "Parameter",
@@ -91,7 +96,8 @@ __all__ = [
     "regularizer", "clip", "backward", "append_backward", "evaluator",
     "metrics", "io", "save_params", "load_params", "save_persistables",
     "load_persistables", "save_inference_model", "load_inference_model",
-    "DataFeeder", "ParamAttr", "profiler", "parallel", "distributed",
+    "DataFeeder", "ParamAttr", "observability", "profiler", "parallel",
+    "distributed",
     "reader", "dataset", "trainer", "models", "infer", "image", "utils",
     "compat", "stack_feeds",
 ]

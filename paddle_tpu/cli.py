@@ -333,18 +333,45 @@ def job_check(argv):
     return 1 if errors or (args.strict and warnings_) else 0
 
 
+def job_stats(argv):
+    """Summarize a JSONL observability log (PADDLE_TPU_METRICS_LOG)."""
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu stats",
+        description="summarize a structured observability log "
+                    "(paddle_tpu.observability, flag metrics_log / env "
+                    "PADDLE_TPU_METRICS_LOG): step-time statistics, "
+                    "pipeline stall/busy numbers, last metrics snapshot, "
+                    "NaN events")
+    ap.add_argument("log", help="JSONL metrics log file")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as ONE JSON object only")
+    args = ap.parse_args(argv)
+    from paddle_tpu.observability import export
+    try:
+        summary = export.summarize_log(args.log)
+    except OSError as e:
+        raise SystemExit(f"stats: cannot read {args.log!r}: {e}")
+    if not args.json:
+        print(export.render_summary(summary), flush=True)
+    print(json.dumps(summary, default=repr), flush=True)
+    return 0
+
+
 def main(argv=None):
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "check":
         return job_check(argv[1:])
+    if argv and argv[0] == "stats":
+        return job_stats(argv[1:])
     ap = argparse.ArgumentParser(
         prog="paddle_tpu",
         description="TrainerMain analog: run a v1 config on the TPU "
-                    "runtime.  A `check` subcommand also exists: "
-                    "`paddle_tpu check prog.json|__model__|dir` runs the "
-                    "static program verifier (see `paddle_tpu check "
-                    "--help`).")
+                    "runtime.  Subcommands also exist: `paddle_tpu check "
+                    "prog.json|__model__|dir` runs the static program "
+                    "verifier and `paddle_tpu stats run.jsonl` summarizes "
+                    "an observability metrics log (see `paddle_tpu "
+                    "check|stats --help`).")
     ap.add_argument("--config", required=True, help="v1 config file")
     ap.add_argument("--job", default="train",
                     choices=["train", "test", "time", "checkgrad"])

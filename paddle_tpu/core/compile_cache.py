@@ -465,8 +465,14 @@ class CachedStep:
 
     def __init__(self, fn, fingerprint: Optional[str],
                  compiler_options: Optional[dict] = None,
-                 in_shardings=None, label: Optional[str] = None):
-        kw = {"donate_argnums": (1,)}
+                 in_shardings=None, label: Optional[str] = None,
+                 donate: bool = True):
+        # donate=False: check_nan_inf variants keep the input state
+        # buffers alive so the NaN-provenance bisect can re-run the
+        # failing step from the true pre-step state without the executor
+        # paying a per-step host snapshot (check_nan_inf is part of the
+        # fingerprint, so donating and non-donating variants never mix)
+        kw = {"donate_argnums": (1,)} if donate else {}
         if in_shardings is not None:
             kw["in_shardings"] = in_shardings
         self._fn = fn

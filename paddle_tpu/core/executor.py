@@ -25,6 +25,7 @@ into a single ``jax.jit`` function per (program-version, feed-signature):
 from __future__ import annotations
 
 import logging
+import time
 import warnings
 import weakref
 from typing import Dict, List, Optional, Sequence
@@ -34,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import compile_cache
+from .. import observability as obs
 from .program import Block, Operator, Program, Variable, grad_var_name
 from .registry import get_op_impl
 from .scope import Scope, global_scope
@@ -450,7 +452,8 @@ class Executor:
                  compiler_options: Optional[Dict[str, object]] = None,
                  compute_dtype: Optional[str] = None,
                  conv1x1_pallas: Optional[bool] = None,
-                 validate: Optional[bool] = None):
+                 validate: Optional[bool] = None,
+                 observe: Optional[bool] = None):
         self.place = place or TPUPlace()
         self.use_jit = use_jit
         self.check_nan_inf = check_nan_inf
@@ -484,6 +487,13 @@ class Executor:
         self.validate = validate
         self._validated: "weakref.WeakKeyDictionary" = \
             weakref.WeakKeyDictionary()
+        # runtime observability (paddle_tpu.observability): per-dispatch
+        # step telemetry + XProf trace annotations.  None defers to the
+        # `observe` flag (PADDLE_TPU_OBSERVE).  HOST-SIDE ONLY by
+        # contract: never part of _config_sig/fingerprints, never inside
+        # the traced fn — flipping it can neither retrace nor change math
+        # (tier-1 asserts zero overhead and zero retraces when off).
+        self.observe = observe
         # compiled step variants keyed by CONTENT fingerprint (survives
         # process restarts via the persistent layer; content-identical
         # programs share an entry), LRU-bounded with dead-program sweeping
@@ -547,6 +557,98 @@ class Executor:
                 [k for k in seen if k[0] != program.version])
         seen.add(key)
 
+    # -- observability -------------------------------------------------------
+    def _observing(self) -> bool:
+        """Resolved observe switch: per-executor override, else flag."""
+        if self.observe is not None:
+            return bool(self.observe)
+        return obs.enabled()
+
+    def _observe_label(self) -> str:
+        """Extra context folded into trace annotations and step events
+        (ShardedExecutor reports its mesh)."""
+        return ""
+
+    def _trace_name(self, path: str, fp: Optional[str]) -> str:
+        """XProf annotation name: framework path + fingerprint prefix, so
+        device trace spans are attributable to framework programs."""
+        label = self._observe_label()
+        base = f"pt:{path}:{(fp or '')[:12]}"
+        return f"{base}:{label}" if label else base
+
+    def _record_dispatch(self, path: str, fp: Optional[str], steps: int,
+                         wall_s: float, fetch_block_s: float,
+                         feed_arrays: Dict[str, object], stacked: bool,
+                         compile_before: Optional[Dict[str, int]] = None):
+        """Registry writes + JSONL step event for one compiled dispatch.
+        Only reached when _observing() — the off path never touches the
+        registry (counter-delta tier-1 assertion).
+
+        ``compile_before`` is the CompileStats counter snapshot taken
+        before the dispatch: a trace or executable deserialize during the
+        call means this wall time is dominated by COMPILE, not compute —
+        the dispatch is tagged cold and kept OUT of the step-time
+        histogram and throughput gauge (compile cost already has its own
+        telemetry in compile_stats())."""
+        cold = False
+        if compile_before is not None:
+            after = compile_cache.stats().snapshot()
+            cold = (after.get("traces", 0) > compile_before.get("traces", 0)
+                    or after.get("disk_hits", 0)
+                    > compile_before.get("disk_hits", 0))
+        wall_ms = wall_s * 1e3
+        step_ms = wall_ms / max(steps, 1)
+        obs.inc_counter("executor/steps", steps)
+        obs.inc_counter("executor/dispatches")
+        obs.observe_hist("executor/dispatch_steps", steps)
+        obs.observe_hist("executor/fetch_block_ms", fetch_block_s * 1e3)
+        feed_bytes = int(sum(getattr(a, "nbytes", 0)
+                             for a in feed_arrays.values()))
+        if feed_bytes:
+            obs.inc_counter("executor/feed_bytes", float(feed_bytes))
+        examples_per_s = None
+        if not cold:
+            obs.observe_hist("executor/step_time_ms", step_ms)
+            lead = 1 if stacked else 0      # stacked feeds: [K, B, ...]
+            for _, a in sorted(feed_arrays.items()):
+                shp = np.shape(a)
+                if len(shp) > lead:
+                    if wall_s > 0:
+                        examples_per_s = shp[lead] * steps / wall_s
+                        obs.set_gauge("executor/examples_per_sec",
+                                      examples_per_s)
+                    break
+        obs.sample_device_memory()
+        obs.emit_event(
+            "step", path=path, fingerprint=(fp or "")[:12], steps=steps,
+            wall_ms=round(wall_ms, 3),
+            step_ms=None if cold else round(step_ms, 3),
+            cold_compile=cold, feed_bytes=feed_bytes,
+            fetch_block_ms=round(fetch_block_s * 1e3, 3),
+            examples_per_sec=round(examples_per_s, 2)
+            if examples_per_s else None,
+            label=self._observe_label() or None)
+
+    def _nan_diagnose(self, program: Program, feed_arrays, state,
+                      step: int, is_test: bool, err: FloatingPointError):
+        """Augment a check_nan_inf failure with eager op-bisect provenance
+        (observability.nanprov): one-shot re-run of the failing step under
+        run_op, naming the first op/var that produced a non-finite value.
+        ``state`` is the live pre-step state (check_nan_inf variants
+        compile without donation on every jit path).  Always emits a
+        structured 'nan' event when a metrics log is set."""
+        from ..observability import nanprov
+        diag = nanprov.bisect_step(self, program, feed_arrays, state,
+                                   step, is_test)
+        if self._observing():
+            obs.inc_counter("executor/nan_events")
+        obs.emit_event("nan", original=str(err), step=step, **(diag or {}))
+        if diag is None:
+            return err
+        return FloatingPointError(
+            f"{err}\n[paddle_tpu] NaN provenance (eager re-run of step "
+            f"{step}): {nanprov.format_diagnosis(diag)}")
+
     # -- public ------------------------------------------------------------
     def run(self, program: Optional[Program] = None,
             feed: Optional[Dict[str, object]] = None,
@@ -580,6 +682,10 @@ class Executor:
 
         state_keys = self._state_keys(program, scope)
         state = {k: scope.get(k) for k in state_keys}
+        # check_nan_inf step variants compile WITHOUT donation (_build,
+        # CachedStep/_AutoLayoutStep donate=False), so `state` itself
+        # survives the dispatch for the provenance bisect at zero
+        # per-step cost on the success path
 
         self._maybe_validate(program, fetch_names)
         fp = compile_cache.fingerprint_hex(self._entry_sig(
@@ -590,9 +696,19 @@ class Executor:
                              sorted(state_keys), is_test, fingerprint=fp)
             self._cache.put(fp, fn, program)
 
+        obs_on = self._observing()
+        t_start = time.perf_counter() if obs_on else 0.0
+        c0 = compile_cache.stats().snapshot() if obs_on else None
         step = self._step
         self._step += 1
-        fetches, new_state = fn(feed_arrays, state, step)
+        if obs_on:
+            with jax.profiler.StepTraceAnnotation("paddle_tpu/step",
+                                                  step_num=step), \
+                    jax.profiler.TraceAnnotation(
+                        self._trace_name("run", fp)):
+                fetches, new_state = fn(feed_arrays, state, step)
+        else:
+            fetches, new_state = fn(feed_arrays, state, step)
 
         finite_map = None
         if self.check_nan_inf and fetches and isinstance(fetches[-1], dict):
@@ -603,13 +719,25 @@ class Executor:
             scope.set(k, v)
 
         if self.check_nan_inf:
-            if finite_map is not None:
-                self._nan_localize(program, finite_map)
-            self._nan_check(fetch_names, fetches)
+            try:
+                if finite_map is not None:
+                    self._nan_localize(program, finite_map)
+                self._nan_check(fetch_names, fetches)
+            except FloatingPointError as e:
+                raise self._nan_diagnose(program, feed_arrays, state,
+                                         step, is_test, e) from e
 
+        t_fetch = time.perf_counter() if obs_on else 0.0
         if return_numpy:
             fetches = [np.asarray(f) if f is not None else None
                        for f in fetches]
+        if obs_on:
+            now = time.perf_counter()
+            self._record_dispatch("run", fp, steps=1,
+                                  wall_s=now - t_start,
+                                  fetch_block_s=now - t_fetch,
+                                  feed_arrays=feed_arrays, stacked=False,
+                                  compile_before=c0)
         return fetches
 
     def run_steps(self, num_steps: int,
@@ -680,15 +808,34 @@ class Executor:
                                     fingerprint=fp)
             self._cache.put(fp, jfn, program)
 
+        obs_on = self._observing()
+        t_start = time.perf_counter() if obs_on else 0.0
+        c0 = compile_cache.stats().snapshot() if obs_on else None
         step0 = self._step
         self._step += num_steps
-        fetches, new_state = jfn(feed_arrays, state, step0)
+        if obs_on:
+            with jax.profiler.StepTraceAnnotation("paddle_tpu/dispatch",
+                                                  step_num=step0), \
+                    jax.profiler.TraceAnnotation(
+                        self._trace_name("run_steps", fp)):
+                fetches, new_state = jfn(feed_arrays, state, step0)
+        else:
+            fetches, new_state = jfn(feed_arrays, state, step0)
         fetches = list(fetches)
         for k, v in new_state.items():
             scope.set(k, v)
+        t_fetch = time.perf_counter() if obs_on else 0.0
         if return_numpy:
             fetches = [np.asarray(f) if f is not None else None
                        for f in fetches]
+        if obs_on:
+            now = time.perf_counter()
+            self._record_dispatch("run_steps", fp, steps=num_steps,
+                                  wall_s=now - t_start,
+                                  fetch_block_s=now - t_fetch,
+                                  feed_arrays=feed_arrays,
+                                  stacked=feeds_stacked,
+                                  compile_before=c0)
         return fetches
 
     def run_pipelined(self, feed_iter,
@@ -739,19 +886,31 @@ class Executor:
             raise ValueError(
                 f"run_pipelined: steps_per_dispatch must be >= 1, got {K}")
 
+        # resolved once: the staging worker and the queue instrumentation
+        # below run for this generator's whole lifetime
+        obs_on = self._observing()
+
         def staged():
             """Chunks of the feed stream, already device-resident."""
             def ship_scan(pend):
+                t0 = time.perf_counter() if obs_on else 0.0
                 dev = {k: jax.device_put(v)
                        for k, v in stack_feeds(pend).items()}
+                if obs_on:
+                    obs.observe_hist("executor/stage_put_ms",
+                                     (time.perf_counter() - t0) * 1e3)
                 return ("scan", dev, len(pend))
 
             def ship_singles(pend):
                 for feed in pend:
-                    yield ("single",
-                           {k: v if isinstance(v, jax.Array)
-                            else jax.device_put(np.asarray(v))
-                            for k, v in feed.items()}, 1)
+                    t0 = time.perf_counter() if obs_on else 0.0
+                    dev = {k: v if isinstance(v, jax.Array)
+                           else jax.device_put(np.asarray(v))
+                           for k, v in feed.items()}
+                    if obs_on:
+                        obs.observe_hist("executor/stage_put_ms",
+                                         (time.perf_counter() - t0) * 1e3)
+                    yield ("single", dev, 1)
 
             pend, sig = [], None
             for feed in feed_iter:
@@ -771,7 +930,7 @@ class Executor:
 
         staged_reader = _prefetch(staged,
                                   buffer_size=max(1, int(prefetch_depth)),
-                                  num_workers=1)
+                                  num_workers=1, instrument=obs_on)
         for kind, dev, n in staged_reader():
             if kind == "scan":
                 outs = self.run_steps(
@@ -822,7 +981,8 @@ class Executor:
             return multi
         if self.auto_layout:
             return _AutoLayoutStep(multi, self._fmt_registry,
-                                   self.compiler_options)
+                                   self.compiler_options,
+                                   donate=not self.check_nan_inf)
         return compile_cache.CachedStep(
             multi, fingerprint, compiler_options=self.compiler_options,
             label="run_steps")
@@ -1018,10 +1178,11 @@ class Executor:
             return fn
         if self.auto_layout:
             return _AutoLayoutStep(fn, self._fmt_registry,
-                                   self.compiler_options)
+                                   self.compiler_options,
+                                   donate=not self.check_nan_inf)
         return compile_cache.CachedStep(
             fn, fingerprint, compiler_options=self.compiler_options,
-            label="run")
+            label="run", donate=not self.check_nan_inf)
 
     def _make_fn(self, program: Program, fetch_names: List[str],
                  is_test: bool):
@@ -1154,9 +1315,13 @@ class _AutoLayoutStep:
     plain jit if the layout API is unavailable.
     """
 
-    def __init__(self, fn, fmt_registry, compiler_options=None):
+    def __init__(self, fn, fmt_registry, compiler_options=None,
+                 donate=True):
         self._fn = fn
-        self._plain = jax.jit(fn, donate_argnums=(1,))
+        # donate=False: check_nan_inf variants (same contract as
+        # CachedStep) — pre-step state survives for the NaN bisect
+        self._donate_kw = {"donate_argnums": (1,)} if donate else {}
+        self._plain = jax.jit(fn, **self._donate_kw)
         self._compiled = None
         self._state_formats = None
         self._registry = fmt_registry  # shared across an Executor's variants
@@ -1182,7 +1347,7 @@ class _AutoLayoutStep:
         in_sh = (jax.tree.map(lambda _: dflt, feeds), in_state, dflt)
         lowered = jax.jit(
             self._fn, in_shardings=in_sh, out_shardings=(dflt, out_state),
-            donate_argnums=(1,),
+            **self._donate_kw,
         ).lower(feeds, state, step)
         comp = lowered.compile(
             compiler_options=self._opts if self._opts else None)

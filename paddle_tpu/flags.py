@@ -92,6 +92,17 @@ define_flag("validate", False,
 define_flag("executor_cache_entries", 64,
             "max compiled step variants held per Executor (LRU; evictions "
             "and dead-program sweeps count into profiler.compile_stats())")
+define_flag("observe", False,
+            "runtime observability (paddle_tpu.observability): per-step/"
+            "pipeline telemetry into the metrics registry, XProf trace "
+            "annotations on dispatches, and JSONL export when metrics_log "
+            "is set.  Zero overhead and zero retraces when off "
+            "(tier-1-enforced).  Per-executor override: "
+            "Executor(observe=...).  (PADDLE_TPU_OBSERVE=1)")
+define_flag("metrics_log", "",
+            "JSONL structured metrics/event log path "
+            "(PADDLE_TPU_METRICS_LOG); empty = off.  Summarize with "
+            "`python -m paddle_tpu stats <log.jsonl>`")
 define_flag("conv1x1_pallas", False,
             "route eligible 1x1 conv2d ops (groups=1, pad 0, dil 1, "
             "128-divisible dims) to the hand-written Pallas dot kernels "

@@ -1,0 +1,46 @@
+"""Unified runtime observability: metrics registry, step/pipeline
+telemetry sinks, XProf annotation labels, NaN provenance, and structured
+export.
+
+The v1 reference shipped with pervasive built-in telemetry — ``StatSet``
+per-layer timers printed every ``log_period`` (utils/Stat.h, Flags.cpp:62)
+— and this package is its TPU-native successor, one layer for every
+execution path:
+
+* :mod:`.metrics` — thread-safe typed registry (counters / gauges /
+  histograms with fixed buckets) behind the frozen ``METRIC_NAMES`` table;
+* :mod:`.export` — JSONL structured event log (``PADDLE_TPU_METRICS_LOG``),
+  ``metrics_snapshot()``, device-memory sampling, ``log_period`` periodic
+  reports, and the ``python -m paddle_tpu stats`` summarizer;
+* :mod:`.nanprov` — eager per-op bisect of a ``check_nan_inf`` failure.
+
+Producers: ``Executor.run/run_steps/run_pipelined`` (per-step wall time,
+dispatch size, feed bytes, staging/fetch-block time — gated by the
+``observe`` flag / ``Executor(observe=...)``), ``reader.pipeline`` (queue
+depth, worker busy/wait, consumer stalls), the trainer (periodic
+reports), and ``core.compile_cache`` (re-exported through
+``metrics_snapshot()['compile']``).  ``paddle_tpu.profiler.report()``
+renders the merged StatSet + CompileStats + Metrics view.
+
+**Zero overhead when off** is a hard contract: with ``observe`` false the
+hot paths never reach a registry write and never change a traced
+computation (tier-1 asserts both — no counter deltas, no retraces).
+"""
+from .metrics import (METRIC_NAMES, MetricsRegistry, enabled, inc_counter,
+                      observe_hist, registry, set_gauge)
+from .export import (emit_event, log_path, maybe_periodic_report,
+                     metrics_snapshot, periodic_report,
+                     sample_device_memory, summarize_log)
+
+__all__ = [
+    "METRIC_NAMES", "MetricsRegistry", "registry", "enabled",
+    "inc_counter", "set_gauge", "observe_hist",
+    "emit_event", "log_path", "metrics_snapshot", "sample_device_memory",
+    "periodic_report", "maybe_periodic_report", "summarize_log",
+    "report",
+]
+
+
+def report() -> str:
+    """StatSet-style text block of the metrics registry."""
+    return registry().report()

@@ -1,0 +1,318 @@
+"""Export & surfacing: JSONL structured log, merged snapshots, device
+memory sampling, periodic reports, and the ``stats`` CLI summarizer.
+
+The JSONL log (flag ``metrics_log`` / env ``PADDLE_TPU_METRICS_LOG``) is
+an append-only stream of one-line JSON events::
+
+    {"ts": <unix s>, "kind": "step",     ...per-dispatch telemetry}
+    {"ts": <unix s>, "kind": "snapshot", ...metrics_snapshot() payload}
+    {"ts": <unix s>, "kind": "nan",      ...NaN-provenance diagnostic}
+
+``python -m paddle_tpu stats run.jsonl`` (cli.py) replays a log into a
+run summary; :func:`summarize_log` is the library form.  The v1 analog of
+this file is ``Stat::printAllStatus`` driven by ``log_period``
+(utils/Stat.h:230, Flags.cpp:62) — here the period lives in the trainer
+(:func:`maybe_periodic_report`) and the sink is structured, not stdout.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import metrics as _metrics
+
+logger = logging.getLogger("paddle_tpu")
+
+__all__ = [
+    "log_path", "emit_event", "metrics_snapshot", "sample_device_memory",
+    "periodic_report", "maybe_periodic_report", "summarize_log",
+]
+
+
+def log_path() -> str:
+    """Active JSONL metrics log path ('' = disabled)."""
+    try:
+        from .. import flags
+        return str(flags.get_flag("metrics_log") or "")
+    except KeyError:
+        return ""
+
+
+class _Writer:
+    """Lazily-opened, thread-safe, line-buffered JSONL appender that
+    follows the ``metrics_log`` flag (a changed path reopens)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._path: Optional[str] = None
+        self._fh = None
+
+    def emit(self, kind: str, payload: dict):
+        path = log_path()
+        if not path:
+            return
+        line = json.dumps({"ts": round(time.time(), 6), "kind": kind,
+                           **payload}, default=repr)
+        with self._lock:
+            if self._path != path:
+                if self._fh is not None:
+                    self._fh.close()
+                self._fh, self._path = None, path
+                try:
+                    self._fh = open(path, "a")
+                except OSError as e:
+                    logger.warning("metrics log %r unwritable (%s); "
+                                   "disabling until the path changes",
+                                   path, e)
+            if self._fh is None:       # disabled: an earlier open/write
+                return                 # on this path failed
+            try:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            except OSError as e:
+                logger.warning("metrics log %r write failed (%s); "
+                               "disabling until the path changes", path, e)
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass               # already broken; disabling anyway
+                self._fh = None        # path unchanged -> stays disabled
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+            self._fh, self._path = None, None
+
+
+_writer = _Writer()
+
+
+def emit_event(kind: str, **payload):
+    """Append one structured event to the JSONL log (no-op when the
+    ``metrics_log`` flag is empty)."""
+    _writer.emit(kind, payload)
+
+
+def _reset_writer():
+    """Close the writer (tests; also safe any time — next emit reopens)."""
+    _writer.close()
+
+
+# ---------------------------------------------------------------------------
+# Snapshots
+# ---------------------------------------------------------------------------
+_mem_supported: Optional[bool] = None
+
+
+def sample_device_memory() -> Dict[str, dict]:
+    """Per-device ``memory_stats()`` where the backend provides them
+    (TPU/GPU PJRT backends do; CPU returns nothing).  Also mirrors
+    bytes_in_use/peak into the device/* gauges.  Returns {} when
+    unsupported and remembers that, so hot-path callers pay one probe."""
+    global _mem_supported
+    if _mem_supported is False:
+        return {}
+    import jax
+    out: Dict[str, dict] = {}
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats()
+        except Exception as e:   # backend without the PJRT memory API
+            logger.debug("memory_stats unavailable on %s: %s", d, e)
+            _mem_supported = False
+            return {}
+        if not ms:
+            _mem_supported = False
+            return {}
+        label = f"{d.platform}:{d.id}"
+        out[label] = {k: int(v) for k, v in ms.items()}
+        if "bytes_in_use" in ms:
+            _metrics.set_gauge("device/bytes_in_use", ms["bytes_in_use"],
+                               label=label)
+        if "peak_bytes_in_use" in ms:
+            _metrics.set_gauge("device/peak_bytes_in_use",
+                               ms["peak_bytes_in_use"], label=label)
+    _mem_supported = True
+    return out
+
+
+def metrics_snapshot() -> dict:
+    """One merged, JSON-serializable view of the whole runtime:
+
+    * ``metrics``  — every registry metric (counters/gauges/histograms),
+    * ``compile``  — ``CompileStats`` counters re-keyed ``compile/<name>``
+      (hits/misses/evictions/traces/... — see core/compile_cache.py),
+    * ``device_memory`` — per-device memory_stats where supported.
+    """
+    from ..core import compile_cache
+    return {
+        "metrics": _metrics.registry().snapshot(),
+        "compile": {f"compile/{k}": v
+                    for k, v in compile_cache.stats().snapshot().items()},
+        "device_memory": sample_device_memory(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Periodic reports (the log_period wiring)
+# ---------------------------------------------------------------------------
+def periodic_report(step: int):
+    """Emit one merged report: StatSet+CompileStats+Metrics text at INFO,
+    plus a ``snapshot`` event in the JSONL log."""
+    from .. import profiler
+    _metrics.inc_counter("trainer/reports")
+    logger.info("observability report @ step %d\n%s", step,
+                profiler.report())
+    emit_event("snapshot", step=step, **metrics_snapshot())
+
+
+def maybe_periodic_report(iters_done: int,
+                          observing: Optional[bool] = None) -> bool:
+    """Trainer hook: fire :func:`periodic_report` every ``log_period``
+    iterations (the hitherto-dead Flags.cpp:62 knob).  ``observing``
+    overrides the global flag (an ``Executor(observe=True)`` trainer
+    reports even when the process-wide flag is off).  Returns whether a
+    report fired."""
+    if not (_metrics.enabled() if observing is None else observing):
+        return False
+    try:
+        from .. import flags
+        period = int(flags.get_flag("log_period"))
+    except (KeyError, TypeError, ValueError):
+        return False
+    if period <= 0 or iters_done <= 0 or iters_done % period:
+        return False
+    periodic_report(iters_done)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Log summarization (the `python -m paddle_tpu stats` engine)
+# ---------------------------------------------------------------------------
+def summarize_log(path: str) -> dict:
+    """Aggregate a JSONL metrics log into one run summary dict.
+
+    Tolerates corrupt lines (counted, not fatal); raises OSError for an
+    unreadable file (the CLI wraps it)."""
+    steps: List[dict] = []
+    nans: List[dict] = []
+    last_snapshot: Optional[dict] = None
+    snapshots = corrupt = total = 0
+    t_first = t_last = None
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            total += 1
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                corrupt += 1
+                continue
+            ts = ev.get("ts")
+            if isinstance(ts, (int, float)):
+                t_first = ts if t_first is None else t_first
+                t_last = ts
+            kind = ev.get("kind")
+            if kind == "step":
+                steps.append(ev)
+            elif kind == "snapshot":
+                snapshots += 1
+                last_snapshot = ev
+            elif kind == "nan":
+                nans.append(ev)
+
+    summary: dict = {
+        "events": total, "corrupt_lines": corrupt,
+        "snapshots": snapshots, "nan_events": len(nans),
+        "wall_s": round(t_last - t_first, 3)
+        if t_first is not None and t_last is not None else None,
+    }
+    if steps:
+        n_steps = sum(int(e.get("steps", 1)) for e in steps)
+        # cold dispatches (trace/compile happened inside the call) carry
+        # step_ms=None by design — compile time must not read as step time
+        step_ms = sorted(float(e["step_ms"]) for e in steps
+                         if e.get("step_ms") is not None)
+        feed_b = sum(float(e.get("feed_bytes", 0)) for e in steps)
+        wall_s = sum(float(e.get("wall_ms", 0)) for e in steps) / 1e3
+        summary["steps"] = {
+            "dispatches": len(steps), "steps": n_steps,
+            "cold_dispatches": sum(1 for e in steps
+                                   if e.get("cold_compile")),
+            "step_ms_mean": round(sum(step_ms) / len(step_ms), 3)
+            if step_ms else None,
+            "step_ms_p50": round(step_ms[len(step_ms) // 2], 3)
+            if step_ms else None,
+            "step_ms_p90": round(step_ms[int(len(step_ms) * 0.9)
+                                         - (len(step_ms) == 1)], 3)
+            if step_ms else None,
+            "feed_mb": round(feed_b / 2 ** 20, 3),
+            "steps_per_sec": round(n_steps / wall_s, 2) if wall_s else None,
+        }
+    if last_snapshot is not None:
+        hists = {}
+        for name, snap in (last_snapshot.get("metrics") or {}).items():
+            if snap.get("kind") == "histogram" and snap.get("count"):
+                hists[name] = {
+                    "count": snap["count"],
+                    "mean": round(snap["sum"] / snap["count"], 3),
+                    "p50": round(_metrics.histogram_quantile(snap, 0.5), 3),
+                    "p90": round(_metrics.histogram_quantile(snap, 0.9), 3),
+                    "max": snap["max"],
+                }
+        counters = {
+            name: snap["value"]
+            for name, snap in (last_snapshot.get("metrics") or {}).items()
+            if snap.get("kind") == "counter" and snap.get("value")}
+        busy = counters.get("pipeline/worker_busy_s", 0.0)
+        wait = counters.get("pipeline/worker_wait_s", 0.0)
+        summary["last_snapshot"] = {
+            "histograms": hists, "counters": counters,
+            "compile": last_snapshot.get("compile") or {},
+            "worker_busy_fraction": round(busy / (busy + wait), 4)
+            if busy + wait > 0 else None,
+        }
+    if nans:
+        summary["nan"] = [{k: e.get(k) for k in
+                           ("op_index", "op_type", "var", "phase")}
+                          for e in nans[:5]]
+    return summary
+
+
+def render_summary(summary: dict) -> str:
+    """Human-readable rendering of :func:`summarize_log` output."""
+    lines = [f"events={summary['events']} "
+             f"snapshots={summary['snapshots']} "
+             f"nan_events={summary['nan_events']} "
+             f"corrupt_lines={summary['corrupt_lines']}"
+             + (f" wall_s={summary['wall_s']}"
+                if summary.get("wall_s") is not None else "")]
+    st = summary.get("steps")
+    if st:
+        lines.append(
+            f"steps: {st['steps']} in {st['dispatches']} dispatches, "
+            f"step_ms mean={st['step_ms_mean']} p50={st['step_ms_p50']} "
+            f"p90={st['step_ms_p90']}, feed={st['feed_mb']} MB"
+            + (f", {st['steps_per_sec']} steps/s"
+               if st.get("steps_per_sec") else ""))
+    snap = summary.get("last_snapshot")
+    if snap:
+        for name, h in sorted(snap["histograms"].items()):
+            lines.append(f"  {name}: count={h['count']} mean={h['mean']} "
+                         f"p50={h['p50']} p90={h['p90']} max={h['max']}")
+        for name, v in sorted(snap["counters"].items()):
+            lines.append(f"  {name}: {v:g}")
+        if snap.get("worker_busy_fraction") is not None:
+            lines.append(
+                f"  pipeline worker busy fraction: "
+                f"{snap['worker_busy_fraction']}")
+    for n in summary.get("nan", []):
+        lines.append(f"  NaN: op #{n.get('op_index')} {n.get('op_type')!r} "
+                     f"-> {n.get('var')!r} ({n.get('phase')})")
+    return "\n".join(lines)

@@ -1,0 +1,276 @@
+"""Typed, thread-safe metrics registry — the quantitative half of the
+observability layer (reference analog: v1's ``StatSet`` of named ``Stat``
+timers, utils/Stat.h:63,114,230, printed every ``log_period``).
+
+Three metric kinds, all namespaced ``<subsystem>/<name>``:
+
+* **counter** — monotonically increasing float (steps, bytes, seconds).
+* **gauge** — last-written value, optionally per label (examples/sec,
+  per-device memory).
+* **histogram** — fixed bucket boundaries chosen per metric at registry
+  definition time, plus count/sum/min/max (step times, queue depths).
+
+Every metric name is a LITERAL member of the frozen :data:`METRIC_NAMES`
+table below; the module-level helpers (:func:`inc_counter`,
+:func:`set_gauge`, :func:`observe_hist`) reject unknown names at runtime
+and ``tests/test_repo_lint.py`` rejects non-literal or unregistered names
+at lint time — a typo'd metric name is a test failure, not a silently
+empty time series.
+
+Writers are gated by their CALL SITES (``Executor._observing()``,
+``reader.pipeline``'s ``instrument`` resolution), not here: with the
+``observe`` flag off the hot paths never reach these helpers, which is
+what the zero-overhead-when-off tier-1 assertion pins.
+"""
+from __future__ import annotations
+
+import bisect as _bisect
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "METRIC_NAMES", "HISTOGRAM_BUCKETS", "MetricsRegistry", "registry",
+    "inc_counter", "set_gauge", "observe_hist", "enabled",
+]
+
+# ---------------------------------------------------------------------------
+# Frozen metric-name registry.  (name, kind, help) — names used through the
+# helpers below MUST appear here as literals (AST-gated in
+# tests/test_repo_lint.py; duplicates rejected at import AND lint time).
+# ---------------------------------------------------------------------------
+METRIC_NAMES = (
+    ("executor/steps", "counter",
+     "training/inference steps executed (a K-step scan counts K)"),
+    ("executor/dispatches", "counter",
+     "compiled dispatches issued (run=1 step, run_steps=K steps)"),
+    ("executor/step_time_ms", "histogram",
+     "per-step wall time: dispatch wall / steps in the dispatch"),
+    ("executor/dispatch_steps", "histogram",
+     "steps per compiled dispatch (K of run_steps / run_pipelined chunks)"),
+    ("executor/feed_bytes", "counter",
+     "feed bytes entering dispatches (after dtype coercion)"),
+    ("executor/fetch_block_ms", "histogram",
+     "host time blocked materializing fetches to numpy"),
+    ("executor/stage_put_ms", "histogram",
+     "device_put staging time per run_pipelined chunk (staging thread)"),
+    ("executor/examples_per_sec", "gauge",
+     "examples/sec of the most recent dispatch (batch * K / wall)"),
+    ("executor/nan_events", "counter",
+     "check_nan_inf trips that ran the NaN-provenance bisect"),
+    ("pipeline/queue_depth", "histogram",
+     "prefetch queue depth sampled at each consumer get"),
+    ("pipeline/consumer_stall_ms", "histogram",
+     "consumer time blocked on an empty prefetch queue"),
+    ("pipeline/worker_busy_s", "counter",
+     "pipeline-worker seconds spent producing (decode/stage work)"),
+    ("pipeline/worker_wait_s", "counter",
+     "pipeline-worker seconds blocked on a full queue (backpressure)"),
+    ("trainer/reports", "counter",
+     "periodic log_period reports emitted by the trainer"),
+    ("device/bytes_in_use", "gauge",
+     "live device memory per device (memory_stats, where supported)"),
+    ("device/peak_bytes_in_use", "gauge",
+     "peak device memory per device (memory_stats, where supported)"),
+)
+
+_MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+               100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+_COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+_DEPTH_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+# Fixed bucket boundaries per histogram (upper-inclusive edges; one
+# implicit overflow bucket past the last edge).
+HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
+    "executor/step_time_ms": _MS_BUCKETS,
+    "executor/dispatch_steps": _COUNT_BUCKETS,
+    "executor/fetch_block_ms": _MS_BUCKETS,
+    "executor/stage_put_ms": _MS_BUCKETS,
+    "pipeline/queue_depth": _DEPTH_BUCKETS,
+    "pipeline/consumer_stall_ms": _MS_BUCKETS,
+}
+_DEFAULT_BUCKETS = _MS_BUCKETS
+
+
+class _Counter:
+    kind = "counter"
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def snapshot(self):
+        return {"kind": "counter", "value": self.value}
+
+
+class _Gauge:
+    kind = "gauge"
+    __slots__ = ("values",)
+
+    def __init__(self):
+        self.values: Dict[str, float] = {}
+
+    def snapshot(self):
+        return {"kind": "gauge", "values": dict(self.values)}
+
+
+class _Histogram:
+    kind = "histogram"
+    __slots__ = ("boundaries", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, boundaries: Tuple[float, ...]):
+        self.boundaries = tuple(float(b) for b in boundaries)
+        self.counts: List[int] = [0] * (len(self.boundaries) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, value: float):
+        self.counts[_bisect.bisect_left(self.boundaries, value)] += 1
+        self.count += 1
+        self.sum += value
+        if self.min is None or value < self.min:
+            self.min = value
+        if self.max is None or value > self.max:
+            self.max = value
+
+    def snapshot(self):
+        return {"kind": "histogram", "count": self.count,
+                "sum": round(self.sum, 6), "min": self.min, "max": self.max,
+                "boundaries": list(self.boundaries),
+                "counts": list(self.counts)}
+
+
+class MetricsRegistry:
+    """All metrics from :data:`METRIC_NAMES`, behind ONE lock.
+
+    Writes come from the executor dispatch path, pipeline worker threads
+    and the run_pipelined staging thread concurrently; a single lock is
+    cheap at the write rates involved (per dispatch / per queue op, not
+    per tensor element)."""
+
+    def __init__(self, spec=METRIC_NAMES):
+        self._lock = threading.Lock()
+        self._spec = spec
+        self._metrics: Dict[str, object] = {}
+        seen = set()
+        for name, kind, _help in spec:
+            if name in seen:
+                raise ValueError(f"duplicate metric name {name!r} in "
+                                 f"METRIC_NAMES")
+            seen.add(name)
+            if kind == "counter":
+                self._metrics[name] = _Counter()
+            elif kind == "gauge":
+                self._metrics[name] = _Gauge()
+            elif kind == "histogram":
+                self._metrics[name] = _Histogram(
+                    HISTOGRAM_BUCKETS.get(name, _DEFAULT_BUCKETS))
+            else:
+                raise ValueError(f"metric {name!r}: unknown kind {kind!r}")
+
+    def _get(self, name: str, kind: str):
+        m = self._metrics.get(name)
+        if m is None:
+            raise KeyError(
+                f"unknown metric {name!r}; metric names are frozen in "
+                f"observability.metrics.METRIC_NAMES — add it there (the "
+                f"repo lint enforces literal, registered names)")
+        if m.kind != kind:
+            raise TypeError(f"metric {name!r} is a {m.kind}, not a {kind}")
+        return m
+
+    # -- writes ----------------------------------------------------------
+    def inc_counter(self, name: str, n: float = 1.0):
+        with self._lock:
+            self._get(name, "counter").value += n
+
+    def set_gauge(self, name: str, value: float, label: str = ""):
+        with self._lock:
+            self._get(name, "gauge").values[str(label)] = float(value)
+
+    def observe_hist(self, name: str, value: float):
+        with self._lock:
+            self._get(name, "histogram").observe(float(value))
+
+    # -- reads -----------------------------------------------------------
+    def snapshot(self) -> Dict[str, dict]:
+        """{name: snapshot-dict} for every registered metric (zero-valued
+        metrics included, so consumers see a stable schema)."""
+        with self._lock:
+            return {name: m.snapshot() for name, m in self._metrics.items()}
+
+    def report(self) -> str:
+        """StatSet-style text block of every non-empty metric."""
+        lines = ["======= Metrics ======="]
+        for name, snap in sorted(self.snapshot().items()):
+            if snap["kind"] == "counter" and snap["value"]:
+                lines.append(f"  {name}: {snap['value']:g}")
+            elif snap["kind"] == "gauge" and snap["values"]:
+                vals = " ".join(f"{k or '-'}={v:g}"
+                                for k, v in sorted(snap["values"].items()))
+                lines.append(f"  {name}: {vals}")
+            elif snap["kind"] == "histogram" and snap["count"]:
+                mean = snap["sum"] / snap["count"]
+                lines.append(
+                    f"  {name}: count={snap['count']} mean={mean:.3f} "
+                    f"min={snap['min']:.3f} max={snap['max']:.3f} "
+                    f"p50={histogram_quantile(snap, 0.5):.3f} "
+                    f"p90={histogram_quantile(snap, 0.9):.3f}")
+        return "\n".join(lines)
+
+    def reset(self):
+        with self._lock:
+            fresh = MetricsRegistry(self._spec)
+            self._metrics = fresh._metrics
+
+
+def histogram_quantile(snap: dict, q: float) -> float:
+    """Approximate quantile from a histogram snapshot: the upper edge of
+    the bucket containing the q-th observation (max for the overflow
+    bucket); 0.0 for an empty histogram."""
+    total = snap["count"]
+    if not total:
+        return 0.0
+    rank = q * total
+    acc = 0
+    for i, c in enumerate(snap["counts"]):
+        acc += c
+        if acc >= rank and c:
+            if i < len(snap["boundaries"]):
+                return float(snap["boundaries"][i])
+            return float(snap["max"])
+    return float(snap["max"])
+
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _registry
+
+
+def enabled() -> bool:
+    """The global ``observe`` flag (env ``PADDLE_TPU_OBSERVE``).  Per-
+    executor ``Executor(observe=...)`` overrides this for its own step
+    telemetry; the reader pipeline and trainer reports consult it."""
+    try:
+        from .. import flags
+        return bool(flags.get_flag("observe"))
+    except KeyError:
+        return False
+
+
+# Module-level write helpers — THE gated surface: tests/test_repo_lint.py
+# requires the name argument at every call site to be a string literal
+# registered in METRIC_NAMES.
+def inc_counter(name: str, n: float = 1.0):
+    _registry.inc_counter(name, n)
+
+
+def set_gauge(name: str, value: float, label: str = ""):
+    _registry.set_gauge(name, value, label)
+
+
+def observe_hist(name: str, value: float):
+    _registry.observe_hist(name, value)

@@ -58,6 +58,14 @@ class ShardedExecutor(Executor):
         # Parameter.sharding and these overrides against the mesh
         return self.mesh, self.param_specs, self.feed_specs
 
+    def _observe_label(self) -> str:
+        # folded into XProf annotation names and step events so multi-chip
+        # dispatches are attributable to their mesh in a device trace;
+        # size-1 axes are noise (make_mesh declares all five) — drop them
+        axes = [f"{a}{self.mesh.shape[a]}" for a in self.mesh.axis_names
+                if self.mesh.shape[a] > 1]
+        return "mesh=" + (",".join(axes) or "1")
+
     # -- sharding selection -------------------------------------------------
     def _find_var(self, program: Program, name: str):
         for b in program.blocks:
@@ -180,7 +188,7 @@ class ShardedExecutor(Executor):
                     in_shardings=(feed_sh,
                                   self._state_shardings(program, state),
                                   None),
-                    label=label)
+                    label=label, donate=not self.check_nan_inf)
             return jitted[key]
 
         def wrapper(feed_arrays, state, step):

@@ -12,6 +12,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from . import observability
 from . import optimizer as optimizer_mod
 from .core.executor import Executor
 from .core.program import (Program, Variable, default_main_program,
@@ -129,11 +130,21 @@ class SGD:
                 self._warmup(reader, feeding, feed_list, fetch,
                              steps_per_dispatch, pipeline)
 
+            # periodic observability reports every `log_period` iterations
+            # (the v1 Stat::printAllStatus cadence, Flags.cpp:62), counted
+            # across passes; no-op unless observing
+            iters_done = 0
+            observing = self.exe._observing()
+
             def emit_end(pass_id, batch_id, out):
+                nonlocal iters_done
                 metrics = {getattr(v, "name", str(i)): out[1 + i]
                            for i, v in enumerate(self.extra)}
                 event_handler(events.EndIteration(
                     pass_id, batch_id, float(out[0]), metrics))
+                iters_done += 1
+                observability.maybe_periodic_report(iters_done,
+                                                    observing=observing)
 
             if pipeline:
                 opts = dict(pipeline) if isinstance(pipeline, dict) else {}

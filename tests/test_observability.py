@@ -1,0 +1,478 @@
+"""Unified runtime observability (paddle_tpu.observability).
+
+Pins the PR's acceptance contract:
+
+* metrics registry semantics (typed, frozen names, thread-safe);
+* ZERO overhead when off — no registry writes and no retraces in the
+  stepped hot path with ``observe=False``;
+* with ``observe=True`` a run_pipelined training loop produces step-time
+  histograms, queue-depth/stall metrics, staging times, and a parseable
+  JSONL log that ``python -m paddle_tpu stats`` summarizes;
+* XProf annotations wrap dispatches with program-attributable names;
+* NaN provenance: a poisoned op is named by the eager bisect;
+* the trainer's periodic reports fire on the ``log_period`` cadence.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import flags, layers
+from paddle_tpu import observability as obs
+from paddle_tpu.core.compile_cache import retrace_guard
+from paddle_tpu.observability import export as obs_export
+from paddle_tpu.observability import metrics as obs_metrics
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    """Fresh registry + restored flags + closed JSONL writer per test."""
+    obs.registry().reset()
+    prev = {n: flags.get_flag(n)
+            for n in ("observe", "metrics_log", "log_period")}
+    yield
+    for n, v in prev.items():
+        flags.set_flag(n, v)
+    obs_export._reset_writer()
+    obs.registry().reset()
+
+
+def _counters_total(snap):
+    return sum(s["value"] for s in snap.values() if s["kind"] == "counter")
+
+
+def _hist_total(snap):
+    return sum(s["count"] for s in snap.values()
+               if s["kind"] == "histogram")
+
+
+def _gauges_total(snap):
+    return sum(len(s["values"]) for s in snap.values()
+               if s["kind"] == "gauge")
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+def test_registry_counter_gauge_histogram_roundtrip():
+    obs.inc_counter("executor/steps", 3)
+    obs.inc_counter("executor/steps")
+    obs.set_gauge("executor/examples_per_sec", 123.5)
+    obs.set_gauge("device/bytes_in_use", 10, label="tpu:0")
+    obs.set_gauge("device/bytes_in_use", 20, label="tpu:1")
+    for v in (0.3, 4.0, 4.0, 900.0):
+        obs.observe_hist("executor/step_time_ms", v)
+    snap = obs.registry().snapshot()
+    assert snap["executor/steps"]["value"] == 4
+    assert snap["executor/examples_per_sec"]["values"][""] == 123.5
+    assert snap["device/bytes_in_use"]["values"] == {"tpu:0": 10.0,
+                                                     "tpu:1": 20.0}
+    h = snap["executor/step_time_ms"]
+    assert h["count"] == 4 and h["min"] == 0.3 and h["max"] == 900.0
+    assert h["sum"] == pytest.approx(908.3)
+    assert sum(h["counts"]) == 4
+    assert len(h["counts"]) == len(h["boundaries"]) + 1
+    # fixed boundaries: 4.0 falls in the bucket with edge 5.0
+    assert h["counts"][h["boundaries"].index(5.0)] == 2
+
+
+def test_registry_rejects_unknown_names_and_kind_mismatch():
+    with pytest.raises(KeyError, match="frozen"):
+        obs.inc_counter("executor/step_tmie_ms")      # typo'd
+    with pytest.raises(TypeError, match="histogram"):
+        obs.inc_counter("executor/step_time_ms")      # wrong kind
+    with pytest.raises(TypeError, match="counter"):
+        obs.observe_hist("executor/steps", 1.0)
+
+
+def test_registry_thread_safety_exact_counts():
+    n_threads, n_iters = 8, 1000
+
+    def work():
+        for _ in range(n_iters):
+            obs.inc_counter("executor/steps")
+            obs.observe_hist("pipeline/queue_depth", 1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = obs.registry().snapshot()
+    assert snap["executor/steps"]["value"] == n_threads * n_iters
+    assert snap["pipeline/queue_depth"]["count"] == n_threads * n_iters
+
+
+def test_histogram_quantile_walks_buckets():
+    for v in [1.0] * 50 + [30.0] * 50:
+        obs.observe_hist("pipeline/queue_depth", v)
+    snap = obs.registry().snapshot()["pipeline/queue_depth"]
+    assert obs_metrics.histogram_quantile(snap, 0.25) == 1.0
+    assert obs_metrics.histogram_quantile(snap, 0.9) == 32.0
+
+
+def test_report_renders_nonempty_metrics():
+    obs.inc_counter("executor/steps", 2)
+    obs.observe_hist("executor/step_time_ms", 5.0)
+    rep = obs.report()
+    assert "executor/steps: 2" in rep
+    assert "executor/step_time_ms" in rep and "p50=" in rep
+
+
+# ---------------------------------------------------------------------------
+# zero overhead when off (acceptance-pinned)
+# ---------------------------------------------------------------------------
+def _build_net():
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    h = layers.fc(x, size=16, act="relu")
+    h = layers.dropout(h, dropout_prob=0.3)
+    pred = layers.fc(h, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _batches(n, batch=16):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.rand(batch, 8).astype("float32"),
+             "y": rng.randint(0, 3, (batch, 1))} for _ in range(n)]
+
+
+def test_observe_off_zero_registry_writes_and_zero_retrace():
+    flags.set_flag("observe", False)
+    loss = _build_net()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    feeds = _batches(5)
+    before = obs.registry().snapshot()
+    exe.run(feed=feeds[0], fetch_list=[loss])       # pays the one trace
+    with retrace_guard():                           # then: NO retraces
+        for f in feeds[1:]:
+            exe.run(feed=f, fetch_list=[loss])
+        outs = list(exe.run_pipelined(
+            iter(_batches(8)), pt.default_main_program(),
+            fetch_list=[loss], steps_per_dispatch=4))
+    assert len(outs) == 8
+    after = obs.registry().snapshot()
+    # the hot path never touched the registry: counter/histogram/gauge
+    # deltas are all EXACTLY zero
+    assert _counters_total(after) == _counters_total(before) == 0
+    assert _hist_total(after) == _hist_total(before) == 0
+    assert _gauges_total(after) == _gauges_total(before) == 0
+
+
+def test_observe_flip_does_not_retrace_or_change_math():
+    """observe=True must be host-side only: same fingerprints (no new
+    trace when flipped mid-run), bit-identical fetches."""
+    loss = _build_net()
+    exe = pt.Executor(observe=False)
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    feeds = _batches(4)
+    off = [exe.run(feed=f, fetch_list=[loss])[0] for f in feeds[:2]]
+    with retrace_guard():        # flipping observe may not re-trace
+        exe.observe = True
+        on = [exe.run(feed=f, fetch_list=[loss])[0] for f in feeds[2:]]
+    assert np.isfinite(off).all() and np.isfinite(on).all()
+    snap = obs.registry().snapshot()
+    assert snap["executor/steps"]["value"] == 2   # only observed steps
+    assert snap["executor/step_time_ms"]["count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# observe=True: pipelined loop -> histograms + JSONL + stats CLI
+# ---------------------------------------------------------------------------
+def test_pipelined_loop_metrics_jsonl_and_stats_cli(tmp_path, capsys):
+    log = tmp_path / "run.jsonl"
+    flags.set_flag("observe", True)
+    flags.set_flag("metrics_log", str(log))
+    loss = _build_net()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    with retrace_guard():       # instrumentation must not retrace either
+        outs = list(exe.run_pipelined(
+            iter(_batches(10)), pt.default_main_program(),
+            fetch_list=[loss], steps_per_dispatch=4))
+        # second chunked run hits the cached variants
+        list(exe.run_pipelined(
+            iter(_batches(10)), pt.default_main_program(),
+            fetch_list=[loss], steps_per_dispatch=4))
+    assert len(outs) == 10
+    snap = obs.registry().snapshot()
+    # step-time histograms from the scan dispatches + tail singles
+    assert snap["executor/step_time_ms"]["count"] >= 4
+    assert snap["executor/dispatch_steps"]["max"] == 4
+    assert snap["executor/steps"]["value"] == 21  # startup + 2x10
+    assert snap["executor/feed_bytes"]["value"] > 0
+    assert snap["executor/stage_put_ms"]["count"] >= 4
+    # pipeline engine signals: sampled depth + consumer stalls + busy split
+    assert snap["pipeline/queue_depth"]["count"] > 0
+    assert snap["pipeline/consumer_stall_ms"]["count"] > 0
+    assert snap["pipeline/worker_busy_s"]["value"] > 0
+    assert snap["executor/examples_per_sec"]["values"][""] > 0
+    obs.periodic_report(step=20)           # snapshot event for the CLI
+
+    lines = [json.loads(ln) for ln in log.read_text().splitlines()]
+    kinds = {ln["kind"] for ln in lines}
+    assert "step" in kinds and "snapshot" in kinds
+    step_events = [ln for ln in lines if ln["kind"] == "step"]
+    # cold dispatches (compile inside the call) are tagged and excluded
+    # from step timing; warm ones carry real per-step times
+    assert any(ln["cold_compile"] for ln in step_events)
+    warm = [ln for ln in step_events if not ln["cold_compile"]]
+    assert warm and all(ln["step_ms"] > 0 for ln in warm)
+    assert all(ln["step_ms"] is None for ln in step_events
+               if ln["cold_compile"])
+    assert any(ln["steps"] == 4 and ln["path"] == "run_steps"
+               for ln in step_events)
+
+    from paddle_tpu.cli import main as cli_main
+    assert cli_main(["stats", str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "dispatches" in out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["steps"]["steps"] == 21
+    assert summary["snapshots"] == 1
+    assert summary["last_snapshot"]["histograms"][
+        "executor/step_time_ms"]["count"] >= 4
+    assert summary["last_snapshot"]["worker_busy_fraction"] is not None
+
+
+def test_run_steps_metrics_report_per_step_time():
+    flags.set_flag("observe", True)
+    loss = _build_net()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    stacked = pt.stack_feeds(_batches(6))
+    exe.run_steps(6, feed=stacked, fetch_list=[loss], feeds_stacked=True)
+    snap = obs.registry().snapshot()
+    # both dispatches so far were COLD (first trace of each variant):
+    # their wall time is compile-dominated and stays out of the histogram
+    assert snap["executor/steps"]["value"] == 7        # startup + 6
+    assert snap["executor/dispatch_steps"]["max"] == 6
+    assert snap["executor/step_time_ms"]["count"] == 0
+    exe.run_steps(6, feed=stacked, fetch_list=[loss], feeds_stacked=True)
+    snap = obs.registry().snapshot()
+    # the warm re-dispatch records real step time + throughput
+    assert snap["executor/step_time_ms"]["count"] == 1
+    # examples/sec uses the PER-STEP batch dim of stacked feeds (16), not
+    # the leading K axis: 16*6 examples over a sub-second dispatch
+    assert snap["executor/examples_per_sec"]["values"][""] > 0
+
+
+def test_xprof_annotations_wrap_dispatch(monkeypatch):
+    import jax
+    names = []
+
+    class FakeAnn:
+        def __init__(self, name, **kw):
+            names.append((name, kw))
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation", FakeAnn)
+    monkeypatch.setattr(jax.profiler, "StepTraceAnnotation", FakeAnn)
+    flags.set_flag("observe", True)
+    loss = _build_net()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    exe.run(feed=_batches(1)[0], fetch_list=[loss])
+    ann = [n for n, _ in names if n.startswith("pt:run:")]
+    assert ann, f"no pt:run annotation in {names}"
+    # program-attributable: carries a fingerprint prefix
+    assert len(ann[-1].split(":")[2]) == 12
+    assert any(n == "paddle_tpu/step" and "step_num" in kw
+               for n, kw in names)
+
+
+def test_sharded_observe_label_names_mesh():
+    from paddle_tpu.parallel import MeshConfig, ShardedExecutor, make_mesh
+    mesh = make_mesh(MeshConfig(dp=8))
+    exe = ShardedExecutor(mesh=mesh)
+    assert exe._observe_label() == "mesh=dp8"
+    assert exe._trace_name("run", "abcdef0123456789").endswith(":mesh=dp8")
+
+
+# ---------------------------------------------------------------------------
+# NaN provenance
+# ---------------------------------------------------------------------------
+def test_nan_provenance_names_poisoned_forward_op(tmp_path):
+    flags.set_flag("metrics_log", str(tmp_path / "nan.jsonl"))
+    x = layers.data("x", shape=[4], dtype="float32")
+    h = layers.scale(x, scale=0.0)
+    bad = layers.log(h)                     # log(0) -> -inf
+    loss = layers.mean(bad)
+    exe = pt.Executor(check_nan_inf=True)
+    with pytest.raises(FloatingPointError) as ei:
+        exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+    msg = str(ei.value)
+    assert "NaN provenance" in msg
+    assert "'log'" in msg and bad.name in msg
+    assert "8 Inf" in msg
+    events = [json.loads(ln)
+              for ln in (tmp_path / "nan.jsonl").read_text().splitlines()]
+    nan_ev = [e for e in events if e["kind"] == "nan"]
+    assert nan_ev and nan_ev[0]["op_type"] == "log"
+    assert nan_ev[0]["var"] == bad.name
+    assert nan_ev[0]["phase"] == "forward"
+
+
+def test_nan_provenance_bisects_training_program():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=4, act="relu")
+    z = layers.log(layers.scale(h, scale=0.0))   # poisoned forward slice
+    pred = layers.reduce_sum(z, dim=1, keep_dim=True)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor(check_nan_inf=True)
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    with pytest.raises(FloatingPointError) as ei:
+        exe.run(feed={"x": np.ones((2, 4), np.float32),
+                      "y": np.ones((2, 1), np.float32)},
+                fetch_list=[loss])
+    msg = str(ei.value)
+    assert "NaN provenance" in msg and "'log'" in msg
+    assert "phase forward" in msg
+
+
+def test_nan_provenance_reports_poisoned_feed():
+    x = layers.data("x", shape=[4], dtype="float32")
+    out = layers.scale(x, scale=2.0)
+    exe = pt.Executor(check_nan_inf=True)
+    feed = np.ones((2, 4), np.float32)
+    feed[0, 0] = np.nan
+    with pytest.raises(FloatingPointError) as ei:
+        exe.run(feed={"x": feed}, fetch_list=[out])
+    assert "phase feed" in str(ei.value)
+    assert "'x'" in str(ei.value)
+
+
+def test_nan_event_counter_gated_by_observe():
+    flags.set_flag("observe", True)
+    x = layers.data("x", shape=[2], dtype="float32")
+    bad = layers.log(layers.scale(x, scale=0.0))
+    exe = pt.Executor(check_nan_inf=True)
+    with pytest.raises(FloatingPointError):
+        exe.run(feed={"x": np.ones((1, 2), np.float32)}, fetch_list=[bad])
+    assert obs.registry().snapshot()["executor/nan_events"]["value"] == 1
+
+
+# ---------------------------------------------------------------------------
+# trainer log_period wiring
+# ---------------------------------------------------------------------------
+def test_trainer_periodic_reports_fire_on_log_period(tmp_path):
+    from paddle_tpu import trainer as trainer_mod
+    log = tmp_path / "train.jsonl"
+    flags.set_flag("observe", True)
+    flags.set_flag("metrics_log", str(log))
+    flags.set_flag("log_period", 5)
+
+    def reader():
+        rng = np.random.RandomState(1)
+        for _ in range(12):
+            xb = rng.rand(8, 5).astype("float32")
+            yb = (xb.sum(axis=1, keepdims=True)
+                  + 0.01 * rng.randn(8, 1)).astype("float32")
+            yield [(xb[i], yb[i]) for i in range(8)]
+
+    x = layers.data("x", shape=[5], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    cost = layers.mean(layers.square_error_cost(pred, y))
+    sgd = trainer_mod.SGD(cost, update_equation=pt.optimizer.SGD(
+        learning_rate=0.01))
+    sgd.train(reader, num_passes=1, feed_list=[x, y])
+    # 12 iterations at log_period=5 -> reports after #5 and #10
+    assert obs.registry().snapshot()["trainer/reports"]["value"] == 2
+    events = [json.loads(ln) for ln in log.read_text().splitlines()]
+    snaps = [e for e in events if e["kind"] == "snapshot"]
+    assert [s["step"] for s in snaps] == [5, 10]
+
+
+def test_periodic_report_noop_when_not_observing(tmp_path):
+    flags.set_flag("observe", False)
+    flags.set_flag("log_period", 1)
+    flags.set_flag("metrics_log", str(tmp_path / "off.jsonl"))
+    assert obs.maybe_periodic_report(5) is False
+    assert not (tmp_path / "off.jsonl").exists()
+    # explicit observing=True overrides the off flag (Executor(observe=..))
+    assert obs.maybe_periodic_report(5, observing=True) is True
+    assert (tmp_path / "off.jsonl").exists()
+
+
+# ---------------------------------------------------------------------------
+# snapshot / export plumbing
+# ---------------------------------------------------------------------------
+def test_metrics_snapshot_merges_compile_counters():
+    snap = obs.metrics_snapshot()
+    assert set(snap) == {"metrics", "compile", "device_memory"}
+    assert all(k.startswith("compile/") for k in snap["compile"])
+    assert set(snap["metrics"]) == {n for n, _, _ in obs.METRIC_NAMES}
+    json.dumps(snap)                     # JSON-serializable end to end
+
+
+def test_stats_cli_rejects_missing_file(capsys):
+    from paddle_tpu.cli import main as cli_main
+    with pytest.raises(SystemExit, match="cannot read"):
+        cli_main(["stats", "/nonexistent/run.jsonl"])
+
+
+def test_metrics_log_unwritable_path_disables_quietly():
+    """An unwritable log path must disable export, not crash the observed
+    hot path on the SECOND event (regression: the disabled writer used to
+    raise AttributeError on every emit after the first failure)."""
+    flags.set_flag("metrics_log", "/nonexistent_dir/obs/x.jsonl")
+    obs.emit_event("step", steps=1)      # open fails -> disables
+    obs.emit_event("step", steps=2)      # must be a silent no-op
+    obs.emit_event("nan", op_type="log")
+
+
+def test_worker_busy_counters_visible_mid_run(monkeypatch):
+    """Busy/wait counters flush periodically, not only at worker exit —
+    a live pipeline's snapshot must carry them."""
+    from paddle_tpu.reader import pipeline as pl
+    from paddle_tpu.reader.pipeline import prefetch
+    monkeypatch.setattr(pl, "_FLUSH_EVERY", 1)
+    g = prefetch(lambda: iter(range(10 ** 6)), buffer_size=2,
+                 num_workers=1, instrument=True)()
+    try:
+        for _ in range(8):
+            next(g)
+        snap = obs.registry().snapshot()
+        assert snap["pipeline/worker_busy_s"]["value"] > 0
+    finally:
+        g.close()
+
+
+def test_check_nan_inf_steps_do_not_donate_state():
+    """check_nan_inf variants keep state buffers alive (donate=False), so
+    the provenance bisect sees true pre-step values with no per-step host
+    snapshot — and healthy steps keep training normally."""
+    loss = _build_net()
+    exe = pt.Executor(check_nan_inf=True)
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    scope = pt.global_scope()
+    key = next(k for k in scope.keys() if k.endswith("w_0"))
+    for f in _batches(3):
+        before = scope.get(key)
+        exe.run(feed=f, fetch_list=[loss])
+        assert not (hasattr(before, "is_deleted") and before.is_deleted())
+        assert not np.array_equal(np.asarray(before),
+                                  np.asarray(scope.get(key)))
+
+
+def test_summarize_log_tolerates_corrupt_lines(tmp_path):
+    p = tmp_path / "x.jsonl"
+    p.write_text('{"ts": 1.0, "kind": "step", "steps": 2, "step_ms": 3.0,'
+                 ' "wall_ms": 6.0}\nnot json\n')
+    s = obs.summarize_log(str(p))
+    assert s["corrupt_lines"] == 1
+    assert s["steps"]["steps"] == 2

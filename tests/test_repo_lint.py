@@ -1,6 +1,6 @@
 """Repo-wide custom lint gate (tier-1).
 
-Two AST lints over every ``paddle_tpu/`` source file, no imports needed:
+Three AST lints over every ``paddle_tpu/`` source file, no imports needed:
 
 1. **Broad except swallows** — an ``except``/``except Exception``/
    ``except BaseException`` handler whose body does nothing (only
@@ -15,6 +15,12 @@ Two AST lints over every ``paddle_tpu/`` source file, no imports needed:
    duplicate at import time, but only for modules the package actually
    imports; the AST scan also covers flag-gated or lazily imported files,
    and duplicate ``register_shape_fn`` names identically.
+3. **Metric-name gate** — every metric name passed to the observability
+   registry helpers (``inc_counter``/``set_gauge``/``observe_hist``) must
+   be a string LITERAL registered in the frozen
+   ``observability.metrics.METRIC_NAMES`` table (duplicates rejected): a
+   typo'd or free-form name would otherwise create a silently empty time
+   series.  Mirrors the duplicate-op-registration gate.
 """
 import ast
 import collections
@@ -132,6 +138,94 @@ def test_no_duplicate_register_op_names():
             f"raise at import time, or silently never load if the module "
             f"is flag-gated): {dupes}")
         assert by_name, f"AST scan found no {call} calls — lint is broken"
+
+
+# ---------------------------------------------------------------------------
+# Metric-name gate (paddle_tpu.observability.metrics.METRIC_NAMES)
+# ---------------------------------------------------------------------------
+_METRIC_HELPERS = ("inc_counter", "set_gauge", "observe_hist")
+# the registry module itself delegates name -> self._registry.<helper>(name)
+# with a variable, by construction — it is the ONE place free-form names
+# are allowed (its own METRIC_NAMES table is what the gate checks against)
+_METRIC_DEFINING_FILE = "paddle_tpu/observability/metrics.py"
+
+
+def _metric_names_table():
+    """(name, kind) rows parsed from the METRIC_NAMES literal — no import,
+    so the gate also covers a syntactically valid but unimportable state."""
+    path = os.path.join(ROOT, "observability", "metrics.py")
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "METRIC_NAMES"
+                for t in node.targets):
+            rows = ast.literal_eval(node.value)
+            return [(name, kind) for name, kind, _help in rows]
+    raise AssertionError("METRIC_NAMES literal not found in metrics.py")
+
+
+def _iter_lint_sources():
+    """Everything the metric gate covers: the package plus the driver."""
+    yield from _iter_sources()
+    bench = os.path.join(ROOT, os.pardir, "bench.py")
+    with open(bench) as fh:
+        yield "bench.py", ast.parse(fh.read(), filename="bench.py")
+
+
+def test_metric_names_table_well_formed():
+    rows = _metric_names_table()
+    names = [n for n, _ in rows]
+    dupes = {n for n in names if names.count(n) > 1}
+    assert not dupes, f"duplicate METRIC_NAMES entries: {sorted(dupes)}"
+    assert names, "METRIC_NAMES is empty — the gate has nothing to check"
+    for name, kind in rows:
+        assert "/" in name, f"metric {name!r} is not namespaced (sub/name)"
+        assert kind in ("counter", "gauge", "histogram"), \
+            f"metric {name!r}: unknown kind {kind!r}"
+
+
+def test_metric_helper_names_are_registered_literals():
+    registered = {n for n, _ in _metric_names_table()}
+    problems, used = [], set()
+    for rel, tree in _iter_lint_sources():
+        if rel == _METRIC_DEFINING_FILE:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            target = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if target not in _METRIC_HELPERS:
+                continue
+            if not node.args:
+                problems.append(f"{rel}:{node.lineno}: {target} without a "
+                                f"positional metric name")
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                problems.append(
+                    f"{rel}:{node.lineno}: {target} metric name must be a "
+                    f"string literal (free-form names defeat the typo "
+                    f"gate)")
+                continue
+            used.add(arg.value)
+            if arg.value not in registered:
+                problems.append(
+                    f"{rel}:{node.lineno}: metric {arg.value!r} is not in "
+                    f"observability.metrics.METRIC_NAMES — register it "
+                    f"there (typo?)")
+    assert not problems, "\n".join(problems)
+    assert used, "AST scan found no metric-helper calls — lint is broken"
+
+
+def test_metric_gate_matches_live_registry():
+    """The parsed table and the imported module agree (guards against the
+    literal-eval scan drifting from what the registry actually builds)."""
+    from paddle_tpu.observability.metrics import METRIC_NAMES
+    assert [(n, k) for n, k, _ in METRIC_NAMES] == _metric_names_table()
 
 
 def test_registry_matches_ast_scan():
