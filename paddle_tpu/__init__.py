@@ -81,6 +81,11 @@ from . import api
 from . import models
 from .trainer import infer
 from . import framework  # compat alias namespace
+from . import faults
+from .faults import EXIT_PREEMPTED, Preempted, RetryPolicy
+from . import train_state
+from .train_state import TrainState
+from . import testing
 
 # NOTE: the version is folded into every compile-cache fingerprint
 # (core/compile_cache.environment_key) — bump it whenever compiled-step
@@ -100,4 +105,6 @@ __all__ = [
     "distributed",
     "reader", "dataset", "trainer", "models", "infer", "image", "utils",
     "compat", "stack_feeds",
+    "faults", "EXIT_PREEMPTED", "Preempted", "RetryPolicy",
+    "train_state", "TrainState", "testing",
 ]

@@ -35,7 +35,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import compile_cache
+from .. import faults as _faults
 from .. import observability as obs
+from ..testing import faultinject as _fi
 from .program import Block, Operator, Program, Variable, grad_var_name
 from .registry import get_op_impl
 from .scope import Scope, global_scope
@@ -453,7 +455,8 @@ class Executor:
                  compute_dtype: Optional[str] = None,
                  conv1x1_pallas: Optional[bool] = None,
                  validate: Optional[bool] = None,
-                 observe: Optional[bool] = None):
+                 observe: Optional[bool] = None,
+                 retry_policy=None):
         self.place = place or TPUPlace()
         self.use_jit = use_jit
         self.check_nan_inf = check_nan_inf
@@ -494,6 +497,15 @@ class Executor:
         # the traced fn — flipping it can neither retrace nor change math
         # (tier-1 asserts zero overhead and zero retraces when off).
         self.observe = observe
+        # transient-error retry at the dispatch rim (paddle_tpu.faults.
+        # RetryPolicy): retryable failures (RPC drops, transient runtime
+        # errors, injected faults) re-dispatch with deterministic backoff;
+        # fatal ones (OOM, shape errors, NaN trips) raise immediately.
+        # HOST-SIDE ONLY like `observe`: never in fingerprints, and with
+        # the default None (plus fault injection unset) the dispatch path
+        # is byte-for-byte the old direct call — no new per-step work
+        # (tier-1 counter-delta assertion).
+        self.retry_policy = retry_policy
         # compiled step variants keyed by CONTENT fingerprint (survives
         # process restarts via the persistent layer; content-identical
         # programs share an entry), LRU-bounded with dead-program sweeping
@@ -629,6 +641,53 @@ class Executor:
             if examples_per_s else None,
             label=self._observe_label() or None)
 
+    def _dispatch(self, fn, feed_arrays, state, step, path: str):
+        """One compiled-step dispatch through the fault-tolerance rim.
+
+        With no retry policy and fault injection off this is a direct
+        call (the zero-overhead off path).  Otherwise: the
+        ``executor.dispatch`` injection site fires inside the retried
+        region, retryable failures back off per the policy (counting
+        ``fault/retries`` + emitting JSONL fault events), and retrying is
+        refused once any state buffer has been donated away by a failed
+        attempt — re-running on deleted buffers would turn a transient
+        hiccup into undefined behavior.
+        """
+        policy = self.retry_policy
+        if policy is None and not _fi.ENABLED:
+            return fn(feed_arrays, state, step)
+
+        def attempt():
+            if _fi.ENABLED:
+                action = _fi.check("executor.dispatch")
+                if action is not None:
+                    _fi.raise_for(action, "executor.dispatch")
+            return fn(feed_arrays, state, step)
+
+        if policy is None:
+            # injection active but no retry policy: fail loudly (the
+            # chaos suite tests the unprotected path this way too)
+            return attempt()
+
+        def cls(e):
+            kind = _faults.classify(e)
+            if kind == "retryable" and any(
+                    getattr(v, "is_deleted", lambda: False)()
+                    for v in state.values()):
+                return "fatal"
+            return kind
+
+        def on_retry(i, e, d):
+            obs.inc_counter("fault/retries")
+            obs.emit_event("fault", event="retry",
+                           site="executor.dispatch", step=int(step),
+                           attempt=i + 1, delay_s=round(d, 4),
+                           error=f"{type(e).__name__}: {e}")
+
+        return _faults.retry_call(attempt, policy,
+                                  what=f"dispatch {path}",
+                                  classify_fn=cls, on_retry=on_retry)
+
     def _nan_diagnose(self, program: Program, feed_arrays, state,
                       step: int, is_test: bool, err: FloatingPointError):
         """Augment a check_nan_inf failure with eager op-bisect provenance
@@ -706,9 +765,11 @@ class Executor:
                                                   step_num=step), \
                     jax.profiler.TraceAnnotation(
                         self._trace_name("run", fp)):
-                fetches, new_state = fn(feed_arrays, state, step)
+                fetches, new_state = self._dispatch(fn, feed_arrays, state,
+                                                    step, "run")
         else:
-            fetches, new_state = fn(feed_arrays, state, step)
+            fetches, new_state = self._dispatch(fn, feed_arrays, state,
+                                                step, "run")
 
         finite_map = None
         if self.check_nan_inf and fetches and isinstance(fetches[-1], dict):
@@ -818,9 +879,11 @@ class Executor:
                                                   step_num=step0), \
                     jax.profiler.TraceAnnotation(
                         self._trace_name("run_steps", fp)):
-                fetches, new_state = jfn(feed_arrays, state, step0)
+                fetches, new_state = self._dispatch(jfn, feed_arrays, state,
+                                                    step0, "run_steps")
         else:
-            fetches, new_state = jfn(feed_arrays, state, step0)
+            fetches, new_state = self._dispatch(jfn, feed_arrays, state,
+                                                step0, "run_steps")
         fetches = list(fetches)
         for k, v in new_state.items():
             scope.set(k, v)

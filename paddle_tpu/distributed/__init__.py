@@ -12,14 +12,19 @@ On TPU there is no parameter server — gradient exchange is XLA collectives
                    (go/master/service.go:89-472).
 * ``transpiler`` — DistributeTranspiler API-parity shim mapping programs onto
                    dp meshes instead of pserver endpoints.
+* ``supervisor`` — bounded-restart relaunch loop for preempted runs (the
+                   cluster-launcher/k8s-controller keep-alive role).
 """
 from .launch import init_distributed, is_initialized
-from .checkpoint import CheckpointManager, save_checkpoint, load_checkpoint
+from .checkpoint import (CheckpointManager, CheckpointTimeoutError,
+                         save_checkpoint, load_checkpoint)
 from .master import Master, Task, TaskQueueClient
+from .supervisor import Supervisor, SupervisorGaveUp
 from .transpiler import DistributeTranspiler
 
 __all__ = [
     "init_distributed", "is_initialized", "CheckpointManager",
-    "save_checkpoint", "load_checkpoint", "Master", "Task",
-    "TaskQueueClient", "DistributeTranspiler",
+    "CheckpointTimeoutError", "save_checkpoint", "load_checkpoint",
+    "Master", "Task", "TaskQueueClient", "Supervisor", "SupervisorGaveUp",
+    "DistributeTranspiler",
 ]

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import shutil
 import threading
@@ -29,6 +30,26 @@ from typing import Optional
 import numpy as np
 
 from ..core.scope import Scope, global_scope
+from ..testing import faultinject as _fi
+
+logger = logging.getLogger("paddle_tpu")
+
+# default for the cross-process commit/manifest barrier (overridable per
+# manager and via PADDLE_TPU_CKPT_TIMEOUT_S)
+DEFAULT_BARRIER_TIMEOUT_S = 600.0
+
+
+class CheckpointTimeoutError(TimeoutError):
+    """A checkpoint file-barrier (shard-manifest wait or commit wait)
+    timed out.  ``tag`` names the pending barrier (e.g. ``"ckpt-30 shard
+    manifests"``) so a supervisor/operator can tell WHICH side of the
+    protocol stalled; ``timeout_s`` is the budget that lapsed."""
+
+    def __init__(self, tag: str, timeout_s: float):
+        super().__init__(
+            f"checkpoint barrier timed out after {timeout_s:g}s: {tag}")
+        self.tag = tag
+        self.timeout_s = timeout_s
 
 
 def _index_to_json(index, shape):
@@ -85,10 +106,19 @@ def _shard_snapshot(name, arr):
 class CheckpointManager:
     def __init__(self, root: str, max_to_keep: int = 3, async_save: bool = True,
                  process_index: Optional[int] = None,
-                 process_count: Optional[int] = None, barrier=None):
+                 process_count: Optional[int] = None, barrier=None,
+                 barrier_timeout_s: Optional[float] = None):
         self.root = root
         self.max_to_keep = max_to_keep
         self.async_save = async_save
+        # cross-process file-barrier budget: constructor > env > default
+        # (a big sharded model on slow storage legitimately needs more
+        # than the default; a unit test wants far less)
+        if barrier_timeout_s is None:
+            env = os.environ.get("PADDLE_TPU_CKPT_TIMEOUT_S")
+            barrier_timeout_s = float(env) if env \
+                else DEFAULT_BARRIER_TIMEOUT_S
+        self.barrier_timeout_s = float(barrier_timeout_s)
         # process identity/barrier are injectable so the multi-process
         # protocol (manifest merge, nonce fencing, commit wait) is testable
         # in one process; defaults come from jax.distributed
@@ -99,6 +129,10 @@ class CheckpointManager:
         self._process_count = process_count
         self._barrier = barrier
         self._thread: Optional[threading.Thread] = None
+        # a failure in the async writer thread is held here and re-raised
+        # from the next wait()/save() on the calling thread — an
+        # uncommitted checkpoint must never be silently recorded as saved
+        self._write_failure: Optional[BaseException] = None
         os.makedirs(root, exist_ok=True)
 
     def _proc(self):
@@ -136,10 +170,19 @@ class CheckpointManager:
         nonce = self._begin_attempt(step)
         if self.async_save and not blocking:
             self._thread = threading.Thread(
-                target=self._write, args=(step, snap, nonce), daemon=True)
+                target=self._write_guarded, args=(step, snap, nonce),
+                daemon=True)
             self._thread.start()
         else:
             self._write(step, snap, nonce)
+
+    def _write_guarded(self, step, snap, nonce):
+        try:
+            self._write(step, snap, nonce)
+        except BaseException as e:  # noqa: BLE001 — re-raised in wait()
+            logger.error("async checkpoint write for ckpt-%s failed: "
+                         "%s: %s", step, type(e).__name__, e)
+            self._write_failure = e
 
     def _begin_attempt(self, step: int) -> str:
         """Synchronous (main-thread) attempt setup: clear stale artifacts of
@@ -182,6 +225,20 @@ class CheckpointManager:
                 shards.append({"file": fn, "md5": _file_md5(path),
                                "index": idx,
                                "shard_shape": list(data.shape)})
+                if _fi.ENABLED:
+                    action = _fi.check("ckpt.write")
+                    if action == "truncate":
+                        # torn-write simulation: the manifest md5 above
+                        # was computed from the full file, so restore's
+                        # verify pass must detect this shard as corrupt
+                        with open(path, "r+b") as fh:
+                            fh.truncate(
+                                max(os.path.getsize(path) // 2, 1))
+                    elif action is not None:
+                        # generic actions (error/transient/drop) raise
+                        # like every other site — a consumed spec entry
+                        # must never be a silent no-op
+                        _fi.raise_for(action, "ckpt.write")
             manifest[n] = {"shape": list(shape), "dtype": dtype,
                            "shards": shards}
         with open(os.path.join(d, f"shards-{proc}.json"), "w") as f:
@@ -223,8 +280,17 @@ class CheckpointManager:
             with open(os.path.join(d, "meta.json"), "w") as f:
                 json.dump(meta, f)
             if os.path.exists(final):
-                shutil.rmtree(final)
-            os.rename(d, final)
+                # re-save of the same step (emergency over periodic):
+                # never a window with NO copy on disk — shelve the old
+                # one aside (".tmp" suffix keeps it out of all_steps),
+                # land the new, then drop the shelf
+                prev = final + ".prev.tmp"
+                shutil.rmtree(prev, ignore_errors=True)
+                os.rename(final, prev)
+                os.rename(d, final)
+                shutil.rmtree(prev, ignore_errors=True)
+            else:
+                os.rename(d, final)
             self._gc()
         elif nprocs > 1:
             # non-zero processes return once THIS attempt's commit
@@ -237,32 +303,59 @@ class CheckpointManager:
                     return False
             self._wait_for(_committed, f"ckpt-{step} commit")
 
-    @staticmethod
-    def _wait_for(cond, what, timeout_s: float = 600.0,
+    def _wait_for(self, cond, what, timeout_s: Optional[float] = None,
                   poll_s: float = 0.05):
+        timeout_s = self.barrier_timeout_s if timeout_s is None \
+            else timeout_s
         deadline = time.time() + timeout_s
         while not cond():
             if time.time() > deadline:
-                raise TimeoutError(f"checkpoint barrier timed out: {what}")
+                raise CheckpointTimeoutError(what, timeout_s)
             time.sleep(poll_s)
 
     def wait(self):
+        """Join a pending async write; re-raise its failure (if any) on
+        this thread, so 'saved' is never silently a lie."""
         if self._thread is not None and self._thread.is_alive():
             self._thread.join()
+        err, self._write_failure = self._write_failure, None
+        if err is not None:
+            raise err
 
     def _gc(self):
         steps = sorted(self.all_steps())
         for s in steps[:-self.max_to_keep]:
+            # a step's data may live in the committed dir and/or an
+            # orphaned re-commit shelf — retention retires both
             shutil.rmtree(os.path.join(self.root, f"ckpt-{s}"),
                           ignore_errors=True)
+            shutil.rmtree(os.path.join(self.root, f"ckpt-{s}.prev.tmp"),
+                          ignore_errors=True)
+        # orphaned re-commit shelves (crash between the shelve renames)
+        # for steps whose committed dir exists again are just leaks
+        for d in os.listdir(self.root):
+            if d.endswith(".prev.tmp") and os.path.exists(
+                    os.path.join(self.root, d[:-len(".prev.tmp")])):
+                shutil.rmtree(os.path.join(self.root, d),
+                              ignore_errors=True)
 
     # -- restore -----------------------------------------------------------
     def all_steps(self):
-        out = []
+        out = set()
         for d in os.listdir(self.root):
-            if d.startswith("ckpt-") and not d.endswith(".tmp") and \
-                    os.path.exists(os.path.join(self.root, d, "meta.json")):
-                out.append(int(d.split("-")[1]))
+            if not d.startswith("ckpt-"):
+                continue
+            # a committed dir, or a re-commit shelf orphaned by a crash
+            # between the shelve renames (the data is intact — restore
+            # knows to read it; see _candidate_dirs)
+            if d.endswith(".prev.tmp"):
+                name = d[:-len(".prev.tmp")]
+            elif d.endswith(".tmp"):
+                continue
+            else:
+                name = d
+            if os.path.exists(os.path.join(self.root, d, "meta.json")):
+                out.add(int(name.split("-")[1]))
         return sorted(out)
 
     def latest_step(self) -> Optional[int]:
@@ -283,8 +376,8 @@ class CheckpointManager:
         scope = global_scope() if scope is None else scope
         candidates = ([step] if step is not None
                       else list(reversed(self.all_steps())))
-        for s in candidates:
-            d = os.path.join(self.root, f"ckpt-{s}")
+        for s, d in ((s, d) for s in candidates
+                     for d in self._candidate_dirs(s)):
             try:
                 with open(os.path.join(d, "meta.json")) as f:
                     meta = json.load(f)
@@ -299,9 +392,37 @@ class CheckpointManager:
                 for n, arr in loaded.items():
                     scope.set(n, arr)
                 return s
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — any corruption mode
+                # (truncated shard, md5 mismatch, garbled meta) must fall
+                # back to the previous checkpoint, never fail the restore
+                # — the pserver recover-on-restart behavior.  Loudly: the
+                # skipped step is a durability incident worth alerting on.
+                from ..observability import emit_event, inc_counter
+                logger.warning(
+                    "checkpoint ckpt-%s is corrupt/unreadable (%s: %s); "
+                    "falling back to the previous checkpoint", s,
+                    type(e).__name__, e)
+                inc_counter("fault/checkpoint_fallbacks")
+                emit_event("fault", event="checkpoint_fallback", step=s,
+                           error=f"{type(e).__name__}: {e}")
                 continue
         raise FileNotFoundError(f"no valid checkpoint under {self.root}")
+
+    def _candidate_dirs(self, step: int):
+        """EXISTING directories that may hold step's data, preferred
+        first: the committed dir, then a re-commit shelf left by a crash
+        between the same-step shelve renames (its content is the
+        previous intact commit).  Missing dirs are excluded so the
+        orphaned-shelf case does not log a spurious corrupt-checkpoint
+        fallback for the absent committed dir."""
+        final = os.path.join(self.root, f"ckpt-{step}")
+        out = []
+        if os.path.exists(os.path.join(final, "meta.json")):
+            out.append(final)
+        shelf = final + ".prev.tmp"
+        if os.path.exists(os.path.join(shelf, "meta.json")):
+            out.append(shelf)
+        return out
 
     def _load_var(self, d, name, info, scope):
         import jax

@@ -17,11 +17,17 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import socket
 import socketserver
 import threading
 import time
 from typing import Callable, List, Optional
+
+from ..faults import RetryPolicy, classify
+from ..testing import faultinject as _fi
+
+logger = logging.getLogger("paddle_tpu")
 
 
 @dataclasses.dataclass
@@ -161,6 +167,39 @@ class Master:
                 else:
                     self.done.append(t)
 
+    def snapshot(self):
+        """Write the queue state to ``snapshot_path`` NOW (public, locked
+        form of the per-``task_finished`` snapshot — the etcd snapshot of
+        go/master/service.go:207)."""
+        with self._lock:
+            self._snapshot()
+
+    def state_dict(self) -> dict:
+        """JSON-serializable queue state (locked).  The trainer embeds
+        this in its checkpoint's TrainState so the queue position commits
+        ATOMICALLY with the model (a separate snapshot file can be
+        durably newer than the checkpoint it belongs to — restoring it
+        would mark chunks done that the restored model never trained on).
+        Pending tasks serialize into todo: a lease held at snapshot time
+        must be re-served after a restore."""
+        with self._lock:
+            return {"epoch": self.epoch,
+                    "todo": [dataclasses.asdict(t) for t in self.todo],
+                    "pending": [dataclasses.asdict(t)
+                                for t, _ in self.pending.values()],
+                    "done": [dataclasses.asdict(t) for t in self.done]}
+
+    def load_state_dict(self, state: dict):
+        """Restore queue state captured by :meth:`state_dict` (locked)."""
+        with self._lock:
+            self.epoch = state["epoch"]
+            self.todo = [Task(**t) for t in
+                         state["todo"] + state["pending"]]
+            self.pending = {}
+            self.done = [Task(**t) for t in state["done"]]
+            self._next_id = max(
+                [t.task_id for t in self.todo + self.done] + [-1]) + 1
+
     def _snapshot(self):
         if not self.snapshot_path:
             return
@@ -264,12 +303,20 @@ class MasterClient:
     master_client / v2 master.client analog)."""
 
     def __init__(self, address: str, timeout_s: float = 30.0,
-                 retries: int = 3, retry_wait_s: float = 0.5):
+                 retries: int = 3, retry_wait_s: float = 0.5,
+                 retry_policy: Optional[RetryPolicy] = None):
         host, port = address.rsplit(":", 1)
         self._addr = (host, int(port))
         self._timeout = timeout_s
-        self._retries = retries
-        self._retry_wait = retry_wait_s
+        # exponential backoff + deterministic jitter between reconnect
+        # attempts (a flat retry_wait hammers a restarting master); the
+        # default derives from the legacy knobs so existing callers keep
+        # their first-retry latency.  An explicit policy owns BOTH the
+        # delays and the attempt count.
+        self._retry_policy = retry_policy or RetryPolicy(
+            max_attempts=max(retries, 1), backoff_base_s=retry_wait_s,
+            backoff_max_s=8.0, jitter=0.1, seed=0)
+        self._retries = self._retry_policy.max_attempts
         self._sock = None
         self._file = None
         self._lock = threading.Lock()
@@ -296,8 +343,14 @@ class MasterClient:
                     pass
             try:
                 last = None
-                for _ in range(retries):
+                for attempt in range(retries):
                     try:
+                        if _fi.ENABLED:
+                            action = _fi.check("master.call")
+                            if action == "drop":
+                                self.close()   # the wire really went away
+                            if action is not None:
+                                _fi.raise_for(action, "master.call")
                         if self._file is None:
                             self._connect(_timeout)
                         self._file.write((json.dumps(
@@ -315,8 +368,17 @@ class MasterClient:
                             json.JSONDecodeError) as e:
                         last = e
                         self.close()
-                        if retries > 1:
-                            time.sleep(self._retry_wait)
+                        if attempt + 1 < retries:
+                            d = self._retry_policy.delay(attempt)
+                            from ..observability import (emit_event,
+                                                         inc_counter)
+                            inc_counter("fault/retries")
+                            emit_event(
+                                "fault", event="retry", site="master.call",
+                                attempt=attempt + 1,
+                                delay_s=round(d, 4),
+                                error=f"{type(e).__name__}: {e}")
+                            time.sleep(d)
                 raise ConnectionError(
                     f"master at {self._addr} unreachable: {last}")
             finally:
@@ -407,6 +469,18 @@ def task_loop_reader(client, chunk_reader: Callable,
     in-process behavior) instead of re-raising."""
 
     def _r():
+        from ..observability import inc_counter
+
+        # ONE budget-free return per task (the documented exactly-once
+        # contract): the first retryable failure hands the task back
+        # without burning budget; any further failure of the same task
+        # burns real failure budget (and drops it at failure_max) — a
+        # chunk that fails every time can never ping-pong through todo
+        # forever.  `fails` counts every retryable failure per task and
+        # drives the escalating swallow-mode backoff.
+        free_returns = {}
+        fails = {}
+
         while True:
             task = client.get_task()
             if task is None:
@@ -424,12 +498,38 @@ def task_loop_reader(client, chunk_reader: Callable,
                               client.task_returned)
                 try:
                     ret(task.task_id)
+                    inc_counter("fault/tasks_returned")
                 except Exception:
                     pass
                 raise
-            except Exception:
+            except Exception as e:
+                n = free_returns.get(task.task_id, 0)
+                nf = fails.get(task.task_id, 0)
+                if classify(e) == "retryable":
+                    fails[task.task_id] = nf + 1
+                if classify(e) == "retryable" and n < 1:
+                    # Transient failure mid-chunk: the work is NOT
+                    # idempotent from here (records already yielded), so
+                    # the task goes back to the master EXACTLY ONCE —
+                    # budget-free — before anyone retries it; re-serving
+                    # from the top is the retry.
+                    free_returns[task.task_id] = n + 1
+                    try:
+                        client.task_returned(task.task_id)
+                        inc_counter("fault/tasks_returned")
+                    except Exception as re:  # noqa: BLE001
+                        logger.warning(
+                            "could not return task %s after transient "
+                            "failure (%s); its lease will lapse",
+                            task.task_id, re)
+                    if swallow_failures:
+                        time.sleep(0.05 * (2 ** min(nf, 4)))   # escalate
+                        continue
+                    raise
                 client.task_failed(task.task_id)
                 if swallow_failures:
+                    if classify(e) == "retryable":
+                        time.sleep(0.05 * (2 ** min(nf, 4)))
                     continue
                 raise
             client.task_finished(task.task_id)

@@ -1,0 +1,168 @@
+"""Process supervisor: bounded relaunch of preempted/transiently-failed
+training runs.
+
+On a real TPU fleet preemption is the *dominant* failure mode: the
+scheduler SIGTERMs the worker, the trainer finishes its in-flight step,
+commits an emergency checkpoint (``trainer.SGD.train(checkpoint_dir=...)``)
+and exits :data:`~paddle_tpu.faults.EXIT_PREEMPTED`.  Something has to
+notice and start it again — in the reference that role is split between
+the cluster launcher and the k8s controller keeping trainer pods alive
+(doc/design/cluster_train); here it is one small, deterministic loop:
+
+* :meth:`Supervisor.run` — supervise an in-process callable: retryable
+  exceptions (``faults.classify``) and :class:`~paddle_tpu.faults.Preempted`
+  restart it with exponential backoff + seeded jitter, up to
+  ``max_restarts`` times; fatal errors propagate immediately.
+* :meth:`Supervisor.run_command` — supervise a subprocess: exit 0 is
+  done; ``EXIT_PREEMPTED`` and signal deaths (negative returncode — the
+  SIGKILL case where no handler could run) relaunch; any other status is
+  fatal.  The relaunched command is identical, so the training script
+  itself must resume idempotently — which ``train(resume=True)`` is: it
+  restores the newest checkpoint when one exists and starts fresh
+  otherwise.
+
+Every restart increments ``fault/restarts`` and emits a ``fault`` JSONL
+event, so ``python -m paddle_tpu stats`` shows the relaunch history next
+to the retries and preemptions.
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Callable, Optional, Sequence
+
+from ..faults import EXIT_PREEMPTED, Preempted
+from ..observability import emit_event, inc_counter
+
+__all__ = ["Supervisor", "SupervisorGaveUp"]
+
+
+class SupervisorGaveUp(RuntimeError):
+    """The supervised run kept dying retryably past ``max_restarts``."""
+
+    def __init__(self, what: str, restarts: int, last):
+        super().__init__(
+            f"{what}: gave up after {restarts} restart(s); last outcome: "
+            f"{last}")
+        self.restarts = restarts
+        self.last = last
+
+
+class Supervisor:
+    """Bounded-restart loop with exponential backoff + deterministic jitter.
+
+    ``max_restarts`` counts RELAUNCHES (a run that succeeds first try
+    restarts zero times).  ``sleep`` is injectable so tests assert the
+    backoff schedule instead of waiting it out.
+    """
+
+    def __init__(self, max_restarts: int = 3, backoff_base_s: float = 0.5,
+                 backoff_max_s: float = 30.0, jitter: float = 0.1,
+                 seed: int = 0, sleep: Callable[[float], None] = time.sleep):
+        from ..faults import RetryPolicy
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        self.max_restarts = int(max_restarts)
+        self._policy = RetryPolicy(
+            max_attempts=self.max_restarts + 1, backoff_base_s=backoff_base_s,
+            backoff_max_s=backoff_max_s, jitter=jitter, seed=seed)
+        self._sleep = sleep
+        self.restarts = 0          # relaunches performed by the last run()
+
+    def _note_restart(self, what: str, outcome: str, delay_s: float):
+        """Restart accounting shared by run() and run_command()."""
+        self.restarts += 1
+        inc_counter("fault/restarts")
+        emit_event("fault", event="restart", site=what,
+                   attempt=self.restarts, delay_s=round(delay_s, 4),
+                   error=outcome)
+
+    def _backoff(self, what: str, outcome: str):
+        d = self._policy.delay(self.restarts)
+        self._note_restart(what, outcome, d)
+        if d > 0:
+            self._sleep(d)
+
+    # -- in-process ---------------------------------------------------------
+    def run(self, fn: Callable, what: str = "supervised run"):
+        """Call ``fn()``; relaunch on :class:`Preempted` or retryable
+        exceptions (``faults.classify``), up to ``max_restarts``
+        relaunches; fatal errors propagate; returns ``fn``'s value.
+        Thin wrapper over :func:`faults.retry_call` — one retry
+        implementation in the package, plus restart accounting.  Gives
+        up with :class:`SupervisorGaveUp` (same surface as
+        :meth:`run_command`)."""
+        from ..faults import RetriesExhausted, retry_call
+
+        self.restarts = 0
+
+        def on_retry(i, e, d):
+            self._note_restart(what, f"{type(e).__name__}: {e}", d)
+
+        try:
+            return retry_call(fn, self._policy, what=what,
+                              retryable_extra=(Preempted,),
+                              on_retry=on_retry, sleep=self._sleep)
+        except RetriesExhausted as e:
+            raise SupervisorGaveUp(what, self.restarts, e.last) from e
+
+    # -- subprocess ---------------------------------------------------------
+    def run_command(self, argv: Sequence[str], what: Optional[str] = None,
+                    retryable_codes: Sequence[int] = (EXIT_PREEMPTED,),
+                    check: bool = True, **popen_kw) -> int:
+        """Run ``argv`` to completion, relaunching while it exits with a
+        retryable status.
+
+        Retryable: ``retryable_codes`` (default: the preemption exit) and
+        negative returncodes (killed by a signal before any handler ran —
+        the hard-preemption/SIGKILL case; the relaunch resumes from the
+        last *periodic* checkpoint).  Exit 0 returns 0; any other status
+        raises :class:`SupervisorGaveUp` when ``check`` else returns it.
+        """
+        what = what or f"command {argv[0]!r}"
+        self.restarts = 0
+        while True:
+            proc = subprocess.run(list(argv), **popen_kw)
+            rc = proc.returncode
+            if rc == 0:
+                return 0
+            retryable = rc in tuple(retryable_codes) or rc < 0
+            if not retryable or self.restarts >= self.max_restarts:
+                if check:
+                    raise SupervisorGaveUp(what, self.restarts,
+                                           f"exit status {rc}")
+                return rc
+            self._backoff(what, f"exit status {rc}")
+
+
+def main(argv=None):  # pragma: no cover - thin CLI shim
+    """``python -m paddle_tpu.distributed.supervisor [--max-restarts N] --
+    cmd args...`` — supervise an arbitrary training command."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.supervisor",
+        description="relaunch a training command on preemption "
+                    f"(exit {EXIT_PREEMPTED}) or signal death, with "
+                    "bounded exponential backoff")
+    ap.add_argument("--max-restarts", type=int, default=3)
+    ap.add_argument("--backoff-base-s", type=float, default=0.5)
+    ap.add_argument("--backoff-max-s", type=float, default=30.0)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="command to supervise (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        ap.error("no command given")
+    sup = Supervisor(max_restarts=args.max_restarts,
+                     backoff_base_s=args.backoff_base_s,
+                     backoff_max_s=args.backoff_max_s)
+    try:
+        return sup.run_command(cmd)
+    except SupervisorGaveUp as e:
+        print(f"supervisor: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

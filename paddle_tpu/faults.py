@@ -1,0 +1,227 @@
+"""Fault taxonomy and retry policy for the fault-tolerant runtime.
+
+The reference framework's whole distributed story is built around
+surviving failure: the go/master re-queues timed-out task chunks with a
+per-task failure budget (go/master/service.go:455-472) and the go/pserver
+checkpoints shards so a dead trainer can rejoin (service.go:120-227).
+This module is the shared vocabulary that lets the TPU-native runtime
+make the same promises end to end:
+
+* a **typed classifier** (:func:`classify`) splitting exceptions into
+  ``retryable`` (RPC drops, transient runtime errors, master timeouts)
+  and ``fatal`` (OOM, shape/type errors — anything the static verifier
+  would reject, plus NaN trips: retrying deterministic math reproduces
+  the same failure);
+* a **deterministic retry policy** (:class:`RetryPolicy` /
+  :func:`retry_call`) with exponential backoff and *seeded* jitter, used
+  at the two dispatch rims — ``Executor`` compiled-step dispatch and
+  ``MasterClient`` RPCs — and by the process supervisor
+  (``distributed/supervisor.py``);
+* the **preemption protocol** constants: :data:`EXIT_PREEMPTED` (the
+  distinguishable exit status after an emergency checkpoint) and
+  :class:`Preempted` (a ``SystemExit`` carrying it), which the
+  supervisor treats as "relaunch and resume", not "give up".
+
+Every retry/fault event flows through the ``fault/*`` metrics
+(observability.metrics.METRIC_NAMES) and the JSONL event log, so
+``python -m paddle_tpu stats`` can reconstruct a run's fault history.
+"""
+from __future__ import annotations
+
+import random
+import socket as _socket
+import time
+from typing import Callable, Optional, Sequence
+
+__all__ = [
+    "TransientError", "TransientDispatchError", "InjectedFault",
+    "RetriesExhausted", "Preempted", "EXIT_PREEMPTED",
+    "classify", "RetryPolicy", "retry_call",
+]
+
+# Exit status of a training process that was preempted (SIGTERM/SIGINT),
+# finished its in-flight step and committed an emergency checkpoint.
+# EX_TEMPFAIL from sysexits.h: "temporary failure, retry later" — exactly
+# the supervisor contract.  Distinguishable from 0 (done), 1 (fatal) and
+# 128+signum (killed before the handler could checkpoint).
+EXIT_PREEMPTED = 75
+
+
+class TransientError(RuntimeError):
+    """Base class for errors that are safe to retry: the operation is
+    expected to succeed on a later attempt without any state repair."""
+
+
+class TransientDispatchError(TransientError):
+    """A compiled-step dispatch failed transiently (device/runtime hiccup,
+    or an injected fault) *before* producing results."""
+
+
+class InjectedFault(RuntimeError):
+    """A deterministic fault fired by :mod:`paddle_tpu.testing.faultinject`
+    (`action=error`).  Deliberately NOT transient: injection specs that
+    want a retryable failure use `action=transient`."""
+
+
+class RetriesExhausted(RuntimeError):
+    """A retryable operation kept failing past ``RetryPolicy.max_attempts``.
+    ``last`` carries the final underlying exception."""
+
+    def __init__(self, what: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"{what}: still failing after {attempts} attempt(s): "
+            f"{type(last).__name__}: {last}")
+        self.attempts = attempts
+        self.last = last
+
+
+class Preempted(SystemExit):
+    """Raised by the trainer after a SIGTERM/SIGINT emergency checkpoint;
+    unhandled, the process exits :data:`EXIT_PREEMPTED` so a supervisor
+    relaunches with ``resume=True`` instead of declaring failure."""
+
+    def __init__(self, step: int, checkpoint_dir: Optional[str] = None):
+        super().__init__(EXIT_PREEMPTED)
+        self.step = step
+        self.checkpoint_dir = checkpoint_dir
+
+    def __str__(self):
+        return (f"training preempted at step {self.step}; emergency "
+                f"checkpoint in {self.checkpoint_dir!r} (exit "
+                f"{EXIT_PREEMPTED})")
+
+
+# ---------------------------------------------------------------------------
+# Classification
+# ---------------------------------------------------------------------------
+# OSError errnos that describe the wire, not the host: retry is expected
+# to succeed once the peer/net recovers.
+import errno as _errno
+_TRANSIENT_ERRNOS = frozenset(
+    getattr(_errno, n) for n in (
+        "ECONNREFUSED", "ECONNRESET", "ECONNABORTED", "EPIPE", "ETIMEDOUT",
+        "EHOSTUNREACH", "EHOSTDOWN", "ENETUNREACH", "ENETDOWN", "ENETRESET",
+        "EAGAIN", "EINTR") if hasattr(_errno, n))
+
+# XLA runtime errors surface as jax's XlaRuntimeError with a gRPC-style
+# status prefix.  These statuses describe the *channel*, not the program —
+# retrying is expected to succeed once the fleet hiccup passes.
+_TRANSIENT_XLA_MARKERS = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED", "CANCELLED",
+)
+# These describe the program or its resources: retrying the same dispatch
+# deterministically reproduces them.
+_FATAL_XLA_MARKERS = ("RESOURCE_EXHAUSTED", "INVALID_ARGUMENT",
+                      "FAILED_PRECONDITION", "UNIMPLEMENTED",
+                      "OUT_OF_MEMORY", "OUT OF MEMORY")
+
+
+def classify(exc: BaseException) -> str:
+    """``"retryable"`` or ``"fatal"`` for one exception instance.
+
+    Retryable: :class:`TransientError`, connection/timeout families (the
+    master RPC rim), and XLA runtime errors whose status names a channel
+    condition.  Fatal: everything the static verifier would catch
+    (shape/type/value errors), OOM, NaN trips, and unknown exceptions —
+    when in doubt, failing loudly beats retrying a poisoned step.
+    """
+    if isinstance(exc, TransientError):
+        return "retryable"
+    if isinstance(exc, (FloatingPointError, MemoryError)):
+        return "fatal"          # NaN trip / host OOM: deterministic
+    if isinstance(exc, (ConnectionError, TimeoutError, BrokenPipeError)):
+        return "retryable"
+    if isinstance(exc, _socket.gaierror):
+        # getaddrinfo failures carry EAI_* codes in errno (not real
+        # errnos) — a DNS blip is the canonical wire transient
+        return "retryable"
+    # Plain OSError is retryable ONLY for the network/socket flavors
+    # (socket.timeout carries errno None) — deterministic host failures
+    # like ENOSPC/EIO/EMFILE must fail loudly, not spin a supervisor
+    # against a full disk.
+    if isinstance(exc, OSError) and not isinstance(
+            exc, (PermissionError, FileNotFoundError, IsADirectoryError)):
+        if exc.errno is None or exc.errno in _TRANSIENT_ERRNOS:
+            return "retryable"
+        return "fatal"
+    name = type(exc).__name__
+    if name == "XlaRuntimeError":
+        msg = str(exc).upper()
+        if any(m in msg for m in _FATAL_XLA_MARKERS):
+            return "fatal"
+        if any(m in msg for m in _TRANSIENT_XLA_MARKERS):
+            return "retryable"
+        return "fatal"
+    return "fatal"
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+# ---------------------------------------------------------------------------
+class RetryPolicy:
+    """Bounded retry with exponential backoff and deterministic jitter.
+
+    ``delay(i)`` for attempt ``i`` (0-based failure count) is
+    ``min(backoff_max_s, backoff_base_s * 2**i) * (1 + U(-jitter, jitter))``
+    where ``U`` is drawn from a :class:`random.Random` seeded at
+    construction — two policies built with the same arguments produce the
+    same schedule, which is what makes the chaos suite's timing
+    assertions (and kill-matrix reproductions) deterministic.
+    """
+
+    def __init__(self, max_attempts: int = 3, backoff_base_s: float = 0.1,
+                 backoff_max_s: float = 30.0, jitter: float = 0.1,
+                 seed: int = 0):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        self.max_attempts = int(max_attempts)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.jitter = float(jitter)
+        self.seed = int(seed)
+        self._rng = random.Random(seed)
+
+    def delay(self, failure_index: int) -> float:
+        base = min(self.backoff_max_s,
+                   self.backoff_base_s * (2.0 ** failure_index))
+        if not self.jitter:
+            return base
+        return base * (1.0 + self._rng.uniform(-self.jitter, self.jitter))
+
+    def __repr__(self):
+        return (f"RetryPolicy(max_attempts={self.max_attempts}, "
+                f"backoff_base_s={self.backoff_base_s}, "
+                f"backoff_max_s={self.backoff_max_s}, "
+                f"jitter={self.jitter}, seed={self.seed})")
+
+
+def retry_call(fn: Callable, policy: RetryPolicy, what: str = "operation",
+               classify_fn: Callable[[BaseException], str] = classify,
+               on_retry: Optional[Callable] = None,
+               sleep: Callable[[float], None] = time.sleep,
+               retryable_extra: Sequence[type] = ()):
+    """Call ``fn()`` under ``policy``: fatal errors re-raise immediately;
+    retryable ones back off and retry up to ``policy.max_attempts`` total
+    attempts, then raise :class:`RetriesExhausted`.
+
+    ``on_retry(failure_index, exc, delay_s)`` fires before each backoff
+    sleep — the hook the rims use to count ``fault/retries`` and emit the
+    JSONL fault event.  ``sleep`` is injectable for tests.
+    """
+    last: Optional[BaseException] = None
+    for i in range(policy.max_attempts):
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — classified, re-raised
+            if not (classify_fn(e) == "retryable"
+                    or isinstance(e, tuple(retryable_extra))):
+                raise
+            last = e
+            if i + 1 >= policy.max_attempts:
+                break
+            d = policy.delay(i)
+            if on_retry is not None:
+                on_retry(i, e, d)
+            if d > 0:
+                sleep(d)
+    raise RetriesExhausted(what, policy.max_attempts, last) from last

@@ -200,6 +200,7 @@ def summarize_log(path: str) -> dict:
     unreadable file (the CLI wraps it)."""
     steps: List[dict] = []
     nans: List[dict] = []
+    faults: List[dict] = []
     last_snapshot: Optional[dict] = None
     snapshots = corrupt = total = 0
     t_first = t_last = None
@@ -226,6 +227,8 @@ def summarize_log(path: str) -> dict:
                 last_snapshot = ev
             elif kind == "nan":
                 nans.append(ev)
+            elif kind == "fault":
+                faults.append(ev)
 
     summary: dict = {
         "events": total, "corrupt_lines": corrupt,
@@ -282,6 +285,20 @@ def summarize_log(path: str) -> dict:
         summary["nan"] = [{k: e.get(k) for k in
                            ("op_index", "op_type", "var", "phase")}
                           for e in nans[:5]]
+    if faults:
+        by_event: Dict[str, int] = {}
+        for e in faults:
+            key = str(e.get("event", "unknown"))
+            by_event[key] = by_event.get(key, 0) + 1
+        summary["faults"] = {
+            "events": len(faults), "by_event": by_event,
+            # first few, enough to see a run's failure story at a glance
+            "timeline": [{k: e.get(k) for k in
+                          ("event", "site", "index", "action", "step",
+                           "attempt", "error", "delay_s")
+                          if e.get(k) is not None}
+                         for e in faults[:10]],
+        }
     return summary
 
 
@@ -315,4 +332,14 @@ def render_summary(summary: dict) -> str:
     for n in summary.get("nan", []):
         lines.append(f"  NaN: op #{n.get('op_index')} {n.get('op_type')!r} "
                      f"-> {n.get('var')!r} ({n.get('phase')})")
+    fl = summary.get("faults")
+    if fl:
+        kinds = " ".join(f"{k}={v}" for k, v in sorted(
+            fl["by_event"].items()))
+        lines.append(f"faults: {fl['events']} event(s): {kinds}")
+        for e in fl["timeline"]:
+            lines.append("  fault: " + " ".join(
+                f"{k}={e[k]}" for k in ("event", "site", "index", "action",
+                                        "step", "attempt", "delay_s",
+                                        "error") if k in e))
     return "\n".join(lines)

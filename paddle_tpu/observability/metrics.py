@@ -71,6 +71,26 @@ METRIC_NAMES = (
      "live device memory per device (memory_stats, where supported)"),
     ("device/peak_bytes_in_use", "gauge",
      "peak device memory per device (memory_stats, where supported)"),
+    # fault-tolerance events (cold paths: written unconditionally — the
+    # zero-overhead-when-off contract covers per-step hot paths, and a
+    # run's fault history must survive into `stats` regardless of observe)
+    ("fault/injected", "counter",
+     "deterministic fault injections fired (testing.faultinject)"),
+    ("fault/retries", "counter",
+     "transient-error retries at the dispatch and master RPC rims"),
+    ("fault/preemptions", "counter",
+     "SIGTERM/SIGINT preemptions that took an emergency checkpoint"),
+    ("fault/restarts", "counter",
+     "supervisor relaunches of a preempted/transiently-failed run"),
+    ("fault/checkpoint_saves", "counter",
+     "trainer checkpoint commits (periodic + emergency)"),
+    ("fault/checkpoint_restores", "counter",
+     "successful checkpoint restores into a training run"),
+    ("fault/checkpoint_fallbacks", "counter",
+     "restores that skipped a corrupt/truncated checkpoint for an older "
+     "intact one"),
+    ("fault/tasks_returned", "counter",
+     "in-flight master tasks handed back before a retry/shutdown"),
 )
 
 _MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,

@@ -1,0 +1,9 @@
+"""Deterministic testing utilities for the fault-tolerant runtime.
+
+:mod:`paddle_tpu.testing.faultinject` is the seed-driven fault-injection
+harness behind ``PADDLE_TPU_FAULT_SPEC`` — see that module for the spec
+grammar and the registered injection sites.
+"""
+from . import faultinject
+
+__all__ = ["faultinject"]

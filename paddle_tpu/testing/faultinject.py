@@ -1,0 +1,210 @@
+"""Deterministic fault injection behind ``PADDLE_TPU_FAULT_SPEC``.
+
+Every failure path the fault-tolerant runtime promises to survive has an
+**injection site** — a named host-side hook on the real code path — so the
+chaos suite can make "the master dropped the connection at call 3" or
+"the process was preempted at step 7" *reproducible facts* instead of
+rare coincidences.  With no spec configured the harness is compiled out
+to a single module-attribute check (``if faultinject.ENABLED:``) at each
+site: the off path does no parsing, no locking, no counting — pinned by
+the same counter-delta tier-1 test that guards the observability layer.
+
+Spec grammar (``;``-separated entries)::
+
+    PADDLE_TPU_FAULT_SPEC = "<site>@<when>=<action>[;...]"
+
+* ``site``  — dotted site name (see table below).
+* ``when``  — ``N`` (integer): fire when the site's *index* equals N.
+  Sites called with an explicit ``index`` (e.g. the trainer's global
+  batch counter) match on that index, so a resumed run that starts past
+  N does NOT re-trigger; sites without a natural index match on their
+  1-based per-process hit count.  ``*`` fires on every hit.
+* ``action`` — interpreted by the site.  Generic actions every site
+  understands through :func:`raise_for`: ``error`` (InjectedFault),
+  ``transient`` (TransientDispatchError — classified retryable), ``drop``
+  (ConnectionError).  Site-specific actions: ``truncate`` (ckpt.write:
+  torn shard file), ``preempt`` (trainer.step: graceful preemption flag,
+  as if SIGTERM arrived; an error when train() has no checkpoint_dir),
+  ``sigterm`` (trainer.step: a real SIGTERM to this process), ``kill``
+  (trainer.step: a real SIGKILL to this process — no handler, no
+  emergency checkpoint, returncode ``-9`` exactly like hard preemption,
+  which supervisors treat as relaunchable signal death).
+
+Registered sites:
+
+========================  ==================================================
+``trainer.step``          per completed batch in ``trainer.SGD.train``
+                          (index = global batch counter)
+``reader.item``           per batch pulled from the reader (index = global
+                          batch counter) — fires *before* the step runs
+``executor.dispatch``     per compiled-step dispatch in ``Executor.run`` /
+                          ``run_steps`` (inside the retry rim)
+``master.call``           per ``MasterClient`` RPC attempt (inside the
+                          retry rim; ``drop`` closes the live socket too)
+``ckpt.write``            per shard file written by ``CheckpointManager``
+                          (``truncate`` corrupts the file after its md5 is
+                          recorded, simulating a torn write)
+========================  ==================================================
+
+Every firing increments the ``fault/injected`` counter and emits a
+``fault`` JSONL event, so an injected run's history is visible to
+``python -m paddle_tpu stats``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..faults import InjectedFault, TransientDispatchError
+
+__all__ = [
+    "ENABLED", "configure", "clear", "active_spec", "check", "raise_for",
+    "hits", "fired", "KNOWN_SITES",
+]
+
+KNOWN_SITES = ("trainer.step", "reader.item", "executor.dispatch",
+               "master.call", "ckpt.write")
+
+# THE zero-overhead gate: call sites guard every hook with
+# ``if faultinject.ENABLED:`` — one attribute load when off.
+ENABLED = False
+
+_lock = threading.Lock()
+_entries: List[Tuple[str, Optional[int], str]] = []   # (site, when, action)
+_hit_counts: Dict[str, int] = {}
+_fired_counts: Dict[str, int] = {}
+_spec_text = ""
+
+
+def _parse(spec: str) -> List[Tuple[str, Optional[int], str]]:
+    entries = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        head, sep, action = raw.partition("=")
+        if not sep or not action:
+            raise ValueError(
+                f"fault spec entry {raw!r}: want site@when=action")
+        site, sep, when_s = head.partition("@")
+        site = site.strip()
+        if not sep or not site:
+            raise ValueError(
+                f"fault spec entry {raw!r}: want site@when=action")
+        when_s = when_s.strip()
+        if when_s == "*":
+            when: Optional[int] = None
+        else:
+            try:
+                when = int(when_s)
+            except ValueError:
+                raise ValueError(
+                    f"fault spec entry {raw!r}: when must be an integer "
+                    f"or '*', got {when_s!r}")
+        entries.append((site, when, action.strip()))
+    return entries
+
+
+def configure(spec: str):
+    """Parse and activate a fault spec (replaces any active one; resets
+    all hit counters).  An empty spec is equivalent to :func:`clear`."""
+    global ENABLED, _entries, _spec_text
+    parsed = _parse(spec)
+    with _lock:
+        _entries = parsed
+        _spec_text = spec
+        _hit_counts.clear()
+        _fired_counts.clear()
+        ENABLED = bool(parsed)
+
+
+def clear():
+    """Deactivate injection entirely (the default state)."""
+    global ENABLED, _entries, _spec_text
+    with _lock:
+        _entries = []
+        _spec_text = ""
+        _hit_counts.clear()
+        _fired_counts.clear()
+        ENABLED = False
+
+
+def active_spec() -> str:
+    return _spec_text
+
+
+def hits(site: str) -> int:
+    """Times ``site`` was checked since :func:`configure` (counter-indexed
+    sites only advance this when called without an explicit index)."""
+    with _lock:
+        return _hit_counts.get(site, 0)
+
+
+def fired(site: str) -> int:
+    """Times an injection actually fired at ``site``."""
+    with _lock:
+        return _fired_counts.get(site, 0)
+
+
+def check(site: str, index: Optional[int] = None) -> Optional[str]:
+    """Return the action to inject at this hit of ``site``, or None.
+
+    Only call behind an ``if faultinject.ENABLED:`` guard — this function
+    takes the lock and counts, which is exactly the work the off path
+    must not do.  ``index``: the site's natural position (global batch
+    counter etc.); without one, the 1-based per-process hit count is the
+    match key.
+    """
+    with _lock:
+        if not _entries:
+            return None
+        if index is None:
+            index = _hit_counts.get(site, 0) + 1
+            _hit_counts[site] = index
+        action = None
+        for s, when, a in _entries:
+            if s == site and (when is None or when == int(index)):
+                action = a
+                break
+        if action is None:
+            return None
+        _fired_counts[site] = _fired_counts.get(site, 0) + 1
+    _record(site, int(index), action)
+    return action
+
+
+def _record(site: str, index: int, action: str):
+    # cold path (an injection is firing): unconditional registry write +
+    # JSONL event so the fault history survives into `stats`
+    from ..observability import emit_event, inc_counter
+    inc_counter("fault/injected")
+    emit_event("fault", event="injected", site=site, index=index,
+               action=action)
+
+
+def raise_for(action: str, site: str, index: Optional[int] = None):
+    """Raise the exception a generic action maps to.  Call sites handle
+    their site-specific actions FIRST and route everything else here, so
+    an action this function does not recognize is a spec mistake (typo,
+    or a site-specific action aimed at the wrong site) — it raises
+    ValueError rather than silently no-opping after :func:`check` already
+    counted the injection as fired."""
+    at = f"{site}" + (f"#{index}" if index is not None else "")
+    if action == "error":
+        raise InjectedFault(f"injected fault at {at}")
+    if action == "transient":
+        raise TransientDispatchError(f"injected transient fault at {at}")
+    if action == "drop":
+        raise ConnectionError(f"injected connection drop at {at}")
+    raise ValueError(
+        f"fault spec: action {action!r} is not understood at site {at} "
+        f"(generic actions: error/transient/drop; site-specific actions "
+        f"must target their own site)")
+
+
+# Environment activation: one parse at import.  configure()/clear() from
+# tests override freely afterwards.
+_env_spec = os.environ.get("PADDLE_TPU_FAULT_SPEC", "")
+if _env_spec:
+    configure(_env_spec)

@@ -1,0 +1,335 @@
+"""Versioned training-loop state for full-fidelity checkpoint/resume.
+
+A model checkpoint (scope vars: parameters, optimizer moments, evaluator
+states — ``distributed.checkpoint.CheckpointManager``) is not enough to
+*resume* a run bit-identically: the loop's own counters decide which
+batch comes next and which PRNG keys every random op derives
+(``Executor`` folds the step counter into the program seed, so the step
+counter IS the RNG derivation state).  :class:`TrainState` captures that
+remainder — step/pass/batch counters, the periodic-report cursor, an
+optimizer-config fingerprint — and rides INSIDE the checkpoint as a
+synthetic uint8 var (:data:`TRAIN_STATE_VAR`), so it shares the manager's
+atomic tmp+rename commit, per-file md5 verification and corrupt-fallback
+for free: a checkpoint either has a consistent (vars, TrainState) pair or
+it is skipped entirely.
+
+:class:`Checkpointer` is the trainer-side coordinator: periodic saves at
+**dispatch boundaries** (the only points where the scope provably
+reflects exactly the batches emitted so far — a K-step scan updates the
+scope once per chunk), SIGTERM/SIGINT preemption handling (finish the
+in-flight dispatch, commit an emergency checkpoint, exit
+:data:`~paddle_tpu.faults.EXIT_PREEMPTED`), and restore-with-fallback on
+resume.  The reference analog is the pserver checkpoint + etcd task
+snapshot pair (go/pserver/service.go:120-227, go/master/service.go:207).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .core.scope import Scope
+from .distributed.checkpoint import CheckpointManager
+from .faults import EXIT_PREEMPTED, Preempted  # noqa: F401  (re-export)
+from .observability import emit_event, inc_counter
+
+logger = logging.getLogger("paddle_tpu")
+
+__all__ = ["TRAIN_STATE_VERSION", "TRAIN_STATE_VAR", "TrainState",
+           "Checkpointer"]
+
+TRAIN_STATE_VERSION = 1
+# the synthetic scope var the loop state rides in (never a program var,
+# so it can never thread into a compiled step)
+TRAIN_STATE_VAR = "__train_state__"
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Everything the training loop needs beyond the scope vars to
+    continue as if never interrupted.
+
+    ``exe_step`` is ``Executor._step`` at the boundary — restoring it
+    restores the per-step RNG stream exactly (keys derive from
+    (program.random_seed, step)).  ``pass_id``/``batch_id`` name the NEXT
+    batch to process; ``emitted`` counts batches completed across passes
+    (the global step the checkpoint is labeled with); ``iters_done`` is
+    the log_period cursor.  ``optimizer`` is a config fingerprint checked
+    on resume (the optimizer's *moments* are scope vars and travel in the
+    checkpoint proper)."""
+
+    version: int = TRAIN_STATE_VERSION
+    exe_step: int = 0
+    pass_id: int = 0
+    batch_id: int = 0
+    emitted: int = 0
+    iters_done: int = 0
+    random_seed: int = 0
+    optimizer: dict = dataclasses.field(default_factory=dict)
+    emergency: bool = False
+    # Master.state_dict() captured at the same boundary — commits
+    # ATOMICALLY with the model (None when no master rides along)
+    master: Optional[dict] = None
+
+    def to_array(self) -> np.ndarray:
+        payload = json.dumps(dataclasses.asdict(self), sort_keys=True)
+        return np.frombuffer(payload.encode("utf-8"), dtype=np.uint8)
+
+    @classmethod
+    def from_array(cls, arr) -> "TrainState":
+        d = json.loads(bytes(np.asarray(arr, dtype=np.uint8)).decode(
+            "utf-8"))
+        version = int(d.get("version", 0))
+        if version > TRAIN_STATE_VERSION:
+            raise ValueError(
+                f"checkpoint TrainState version {version} is newer than "
+                f"this runtime supports ({TRAIN_STATE_VERSION})")
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+class Checkpointer:
+    """Trainer-side checkpoint/preemption coordinator (one per
+    ``train(checkpoint_dir=...)`` call).
+
+    The trainer reports every completed batch through
+    :meth:`on_batch_done`; the coordinator detects dispatch boundaries by
+    comparing the executor's step counter against batches emitted, takes
+    periodic saves every ``save_every_n_steps`` completed batches, and —
+    when a SIGTERM/SIGINT arrived — commits a blocking emergency
+    checkpoint and raises :class:`~paddle_tpu.faults.Preempted`.
+    """
+
+    def __init__(self, checkpoint_dir: str, exe,
+                 save_every_n_steps: Optional[int] = None,
+                 master=None, max_to_keep: int = 3,
+                 handle_signals: bool = True):
+        if save_every_n_steps is not None and save_every_n_steps < 1:
+            raise ValueError(f"save_every_n_steps must be >= 1, got "
+                             f"{save_every_n_steps}")
+        self.dir = checkpoint_dir
+        self.exe = exe
+        self.save_every = save_every_n_steps
+        self.master = master
+        self.manager = CheckpointManager(checkpoint_dir,
+                                         max_to_keep=max_to_keep)
+        self.handle_signals = handle_signals
+        self._old_handlers: dict = {}
+        self._preempt_sig: Optional[int] = None
+        self._base_step: Optional[int] = None
+        self.emitted = 0
+        self.iters_done = 0
+        self.last_saved = 0
+        self._scope: Optional[Scope] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def restore(self, scope: Scope,
+                expect_seed: Optional[int] = None,
+                expect_optimizer: Optional[dict] = None
+                ) -> Optional[TrainState]:
+        """Restore the newest intact checkpoint into ``scope`` and return
+        its :class:`TrainState` (None when the directory holds no
+        checkpoint — resume on a fresh directory starts fresh, which is
+        what makes ``train(resume=True)`` idempotent under a supervisor).
+        """
+        if not self.manager.all_steps():
+            return None
+        step = self.manager.restore(scope=scope)
+        if not scope.has(TRAIN_STATE_VAR):
+            raise ValueError(
+                f"checkpoint ckpt-{step} in {self.dir!r} carries no "
+                f"TrainState — it was not written by "
+                f"train(checkpoint_dir=...); restore it with "
+                f"CheckpointManager.restore instead of resume=True")
+        ts = TrainState.from_array(scope.get(TRAIN_STATE_VAR))
+        scope.delete(TRAIN_STATE_VAR)
+        if expect_seed is not None and ts.random_seed != expect_seed:
+            logger.warning(
+                "resume: checkpoint was written with program seed %s but "
+                "this program uses %s — the RNG stream will NOT be "
+                "bit-identical to the original run", ts.random_seed,
+                expect_seed)
+        if expect_optimizer is not None and ts.optimizer and \
+                ts.optimizer != expect_optimizer:
+            logger.warning(
+                "resume: optimizer config changed across restarts "
+                "(checkpoint %s vs current %s)", ts.optimizer,
+                expect_optimizer)
+        inc_counter("fault/checkpoint_restores")
+        emit_event("fault", event="checkpoint_restore", step=ts.emitted,
+                   index=step)
+        return ts
+
+    def begin(self, scope: Scope, state: Optional[TrainState],
+              random_seed: int, optimizer_fp: dict):
+        """Arm the coordinator at training-loop entry: record the
+        dispatch-boundary base, adopt resumed counters, install signal
+        handlers."""
+        self._scope = scope
+        self._seed = int(random_seed)
+        self._opt_fp = dict(optimizer_fp)
+        self._restored = state
+        if state is not None:
+            self.emitted = state.emitted
+            self.iters_done = state.iters_done
+            self.last_saved = state.emitted
+        # boundary invariant: exe._step - base == emitted, exactly when
+        # the scope reflects every emitted batch (no half-applied chunk)
+        self._base_step = self.exe._step - self.emitted
+        if self.handle_signals:
+            self._install_signals()
+
+    def close(self):
+        """Flush pending async saves and restore signal handlers.
+
+        Runs in the trainer's ``finally``: a write failure here is
+        LOGGED, not raised — raising would mask the in-flight exception
+        (a ``Preempted`` turned into a fatal status would stop the
+        supervisor from relaunching).  On the success path any async
+        failure already surfaced through the next blocking save's
+        internal ``wait()`` (``final_save`` is blocking)."""
+        try:
+            self.manager.wait()
+        except Exception as e:  # noqa: BLE001
+            logger.error(
+                "pending checkpoint write failed during shutdown "
+                "(%s: %s); the latest checkpoint on disk is older than "
+                "the counters suggest", type(e).__name__, e)
+        finally:
+            for sig, old in self._old_handlers.items():
+                try:
+                    signal.signal(sig, old)
+                except (ValueError, OSError):   # non-main thread/teardown
+                    pass
+            self._old_handlers.clear()
+
+    def _install_signals(self):
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning("checkpointer: not on the main thread; "
+                           "SIGTERM/SIGINT preemption handling disabled")
+            return
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._old_handlers[sig] = signal.signal(
+                    sig, self._on_signal)
+            except (ValueError, OSError):
+                logger.warning("checkpointer: cannot install handler for "
+                               "signal %s", sig)
+
+    def _on_signal(self, signum, frame):
+        # async-signal context: just set the flag; the loop finishes the
+        # in-flight dispatch and takes the emergency checkpoint at the
+        # next boundary.  Only a REPEAT of the same signal escalates to
+        # the previous handler (impatient operators keep Ctrl-C); a
+        # different signal while one is pending must not kill the
+        # process during the grace window (Ctrl-C followed by the
+        # scheduler's routine SIGTERM would otherwise skip the save).
+        if self._preempt_sig == signum:
+            old = self._old_handlers.get(signum)
+            if callable(old):
+                old(signum, frame)
+            elif old == signal.SIG_DFL:
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+            return
+        if self._preempt_sig is None:
+            self._preempt_sig = signum
+
+    def resync(self):
+        """Re-anchor the boundary base at a known-quiescent point (pass
+        start): event handlers that run EXTRA executor work mid-pass
+        (e.g. ``trainer.test()`` inside ``EndIteration``) advance the
+        step counter past the loop's own dispatches, which suppresses
+        boundary detection until this re-anchor — checkpoint cadence
+        degrades to at-least-once-per-pass, never silently to zero."""
+        self._base_step = self.exe._step - self.emitted
+
+    def request_preempt(self, signum: int = signal.SIGTERM):
+        """Programmatic preemption (the faultinject `preempt` action):
+        behave exactly as if ``signum`` had arrived."""
+        if self._preempt_sig is None:
+            self._preempt_sig = signum
+
+    @property
+    def preempt_requested(self) -> bool:
+        return self._preempt_sig is not None
+
+    # -- per-batch hook -----------------------------------------------------
+    def on_batch_done(self, pass_id: int, batch_id: int,
+                      step_now: Optional[int] = None):
+        """Count one completed batch; at dispatch boundaries, honor a
+        pending preemption (emergency save + raise Preempted) or the
+        periodic save cadence.  ``step_now``: the executor step counter
+        snapshotted before this batch's event handler ran (handler-side
+        executor work must not blur boundary detection)."""
+        self.emitted += 1
+        self.iters_done += 1
+        if step_now is None:
+            step_now = self.exe._step
+        if step_now - self._base_step != self.emitted:
+            return                       # mid-chunk: scope is ahead of us
+        if self._preempt_sig is not None:
+            self._save(pass_id, batch_id + 1, emergency=True,
+                       blocking=True)
+            inc_counter("fault/preemptions")
+            emit_event("fault", event="preemption", step=self.emitted,
+                       action=f"signal {self._preempt_sig}")
+            logger.warning(
+                "preempted (signal %s): emergency checkpoint ckpt-%d "
+                "committed in %r; exiting %d for the supervisor",
+                self._preempt_sig, self.emitted, self.dir, EXIT_PREEMPTED)
+            raise Preempted(self.emitted, self.dir)
+        if self.save_every is not None and \
+                self.emitted - self.last_saved >= self.save_every:
+            self._save(pass_id, batch_id + 1)
+
+    def final_save(self, num_passes: int):
+        """Commit the end-of-training state (pass_id == num_passes), so a
+        supervisor relaunch resumes into an empty pass range and exits 0
+        immediately — completion is idempotent.  A relaunch that restored
+        an already-final state and ran zero batches skips the re-commit:
+        rewriting an identical checkpoint would briefly expose the only
+        copy to a crash window for no benefit."""
+        r = getattr(self, "_restored", None)
+        if r is not None and r.pass_id >= num_passes \
+                and self.emitted == r.emitted:
+            return
+        self._save(num_passes, 0, blocking=True)
+
+    # -- save ---------------------------------------------------------------
+    def _save(self, next_pass: int, next_batch: int,
+              emergency: bool = False, blocking: bool = False):
+        # Task-queue position rides INSIDE the checkpoint (state_dict
+        # captured here, committed by the same atomic tmp+rename) — a
+        # separate snapshot file could be durably newer than the model
+        # it belongs to, marking chunks done the restored model never
+        # saw.  Remaining caveat, inherent to chunk-granular tracking
+        # with a prefetching reader: records a finished chunk fed into
+        # the pipeline but not yet trained at this boundary are lost on
+        # resume — the reference's task-level at-least-once, not
+        # record-level exactly-once.
+        master_state = None
+        if self.master is not None and hasattr(self.master, "state_dict"):
+            master_state = self.master.state_dict()
+        ts = TrainState(
+            exe_step=self.exe._step, pass_id=next_pass,
+            batch_id=next_batch, emitted=self.emitted,
+            iters_done=self.iters_done, random_seed=self._seed,
+            optimizer=self._opt_fp, emergency=emergency,
+            master=master_state)
+        scope = self._scope
+        scope.set(TRAIN_STATE_VAR, ts.to_array())
+        try:
+            self.manager.save(self.emitted, scope, blocking=blocking)
+        finally:
+            scope.delete(TRAIN_STATE_VAR)
+        self.last_saved = self.emitted
+        inc_counter("fault/checkpoint_saves")
+        emit_event("fault", event="checkpoint_save", step=self.emitted,
+                   action="emergency" if emergency else "periodic")

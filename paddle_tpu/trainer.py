@@ -8,6 +8,8 @@ generations collapse into one path here.
 """
 from __future__ import annotations
 
+import os
+import signal as _signal
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -19,6 +21,7 @@ from .core.program import (Program, Variable, default_main_program,
                            default_startup_program)
 from .core.scope import global_scope
 from .data_feeder import DataFeeder
+from .testing import faultinject as _fi
 
 
 class events:
@@ -71,7 +74,10 @@ class SGD:
               event_handler: Optional[Callable] = None,
               feeding=None, feed_list: Optional[Sequence[Variable]] = None,
               steps_per_dispatch: int = 1, pipeline=False,
-              warmup: bool = False, validate: Optional[bool] = None):
+              warmup: bool = False, validate: Optional[bool] = None,
+              checkpoint_dir: Optional[str] = None, resume: bool = False,
+              save_every_n_steps: Optional[int] = None, master=None,
+              handle_signals: bool = True):
         """reader yields batches (lists of rows); feeding maps data-layer
         names to row positions (v2 trainer.py feeding) or pass feed_list.
 
@@ -113,18 +119,87 @@ class SGD:
         ``validate`` flag (``PADDLE_TPU_VALIDATE=1``).  The override
         applies to this call only — the executor's own setting is
         restored afterwards.
+
+        ``checkpoint_dir`` turns on the fault-tolerant runtime
+        (``paddle_tpu.train_state``): every ``save_every_n_steps``
+        completed batches a checkpoint of the full scope PLUS the loop's
+        :class:`~paddle_tpu.train_state.TrainState` (step/pass/batch
+        counters — the RNG derivation state) commits atomically, and a
+        SIGTERM/SIGINT finishes the in-flight dispatch, commits an
+        emergency checkpoint and exits
+        :data:`~paddle_tpu.faults.EXIT_PREEMPTED` (raise:
+        :class:`~paddle_tpu.faults.Preempted`) so a supervisor
+        (``distributed.supervisor``) relaunches it.  ``resume=True``
+        restores the newest intact checkpoint and continues — with a
+        deterministic, restartable ``reader`` and an order-preserving
+        pipeline config (``num_workers <= 1``) the resumed run's fetches
+        are BIT-IDENTICAL to an uninterrupted one (the chaos suite pins
+        this with subprocess kills); an empty directory starts fresh, so
+        a supervised command can always pass ``resume=True``.  Saves
+        happen only at dispatch boundaries (scope consistency); with
+        chunked dispatch the effective cadence rounds up to the chunk.
+        ``master``: an in-process ``distributed.Master`` whose task-queue
+        snapshot should commit alongside each checkpoint (and be restored
+        on resume).  ``handle_signals=False`` skips installing handlers
+        (e.g. when embedding the trainer in a host that owns them).
         """
         event_handler = event_handler or (lambda e: None)
+        if not checkpoint_dir:
+            # fail loudly, not silently unprotected: every one of these
+            # asks for checkpointing machinery that needs a directory
+            if resume:
+                raise ValueError("train(resume=True) requires "
+                                 "checkpoint_dir")
+            if save_every_n_steps is not None:
+                raise ValueError("train(save_every_n_steps=...) requires "
+                                 "checkpoint_dir")
+            if master is not None:
+                raise ValueError("train(master=...) snapshots the task "
+                                 "queue into checkpoints — pass "
+                                 "checkpoint_dir")
         # validate is a PER-CALL override: restore the executor's own
         # setting afterwards so a later train() with the default None
         # defers to the flag again
         prev_validate = self.exe.validate
         if validate is not None:
             self.exe.validate = validate
+        ckpt = None
         try:
             if not self._initialized:
                 self.exe.run(default_startup_program(), feed={}, fetch_list=[])
                 self._initialized = True
+
+            start_pass, resume_skip = 0, 0
+            if checkpoint_dir:
+                from .train_state import Checkpointer
+                opt_fp = {"type": type(self.optimizer).__name__}
+                lr = getattr(self.optimizer, "_learning_rate", None)
+                if isinstance(lr, (int, float)):
+                    opt_fp["learning_rate"] = float(lr)
+                ckpt = Checkpointer(checkpoint_dir, self.exe,
+                                    save_every_n_steps=save_every_n_steps,
+                                    master=master,
+                                    handle_signals=handle_signals)
+                ts = None
+                if resume:
+                    ts = ckpt.restore(
+                        global_scope(),
+                        expect_seed=self.main_program.random_seed,
+                        expect_optimizer=opt_fp)
+                if ts is not None:
+                    # the step counter IS the per-step RNG derivation
+                    # state: restoring it restores every random op's
+                    # key stream exactly
+                    self.exe._step = ts.exe_step
+                    start_pass, resume_skip = ts.pass_id, ts.batch_id
+                    if master is not None and ts.master is not None \
+                            and hasattr(master, "load_state_dict"):
+                        # queue position from INSIDE the checkpoint —
+                        # atomically consistent with the model restored
+                        master.load_state_dict(ts.master)
+                ckpt.begin(global_scope(), ts,
+                           self.main_program.random_seed, opt_fp)
+
             fetch = [self.cost] + self.extra
             if warmup:
                 self._warmup(reader, feeding, feed_list, fetch,
@@ -132,12 +207,21 @@ class SGD:
 
             # periodic observability reports every `log_period` iterations
             # (the v1 Stat::printAllStatus cadence, Flags.cpp:62), counted
-            # across passes; no-op unless observing
-            iters_done = 0
+            # across passes (and across restarts when resuming); no-op
+            # unless observing
+            iters_done = ckpt.iters_done if ckpt is not None else 0
             observing = self.exe._observing()
+            # global batch cursor (across passes AND restarts): the index
+            # key of the trainer.step/reader.item injection sites, so a
+            # resumed run never re-fires a spec entry it already passed
+            gcount = [ckpt.emitted if ckpt is not None else 0]
 
             def emit_end(pass_id, batch_id, out):
                 nonlocal iters_done
+                # step snapshot BEFORE the handler runs: a handler that
+                # does extra executor work (trainer.test) must not blur
+                # this batch's dispatch-boundary detection
+                step_now = self.exe._step
                 metrics = {getattr(v, "name", str(i)): out[1 + i]
                            for i, v in enumerate(self.extra)}
                 event_handler(events.EndIteration(
@@ -145,6 +229,50 @@ class SGD:
                 iters_done += 1
                 observability.maybe_periodic_report(iters_done,
                                                     observing=observing)
+                gcount[0] += 1
+                if _fi.ENABLED:
+                    action = _fi.check("trainer.step", index=gcount[0])
+                    if action == "preempt":
+                        if ckpt is None:
+                            # fail loudly: the spec asked for a graceful
+                            # preemption this run cannot perform
+                            raise _fi.InjectedFault(
+                                "trainer.step=preempt injected but "
+                                "train() has no checkpoint_dir")
+                        ckpt.request_preempt()
+                    elif action == "sigterm":
+                        os.kill(os.getpid(), _signal.SIGTERM)
+                    elif action == "kill":
+                        # REAL SIGKILL: dies with returncode -9, which a
+                        # supervisor treats as relaunchable signal death
+                        os.kill(os.getpid(), _signal.SIGKILL)
+                    elif action is not None:
+                        _fi.raise_for(action, "trainer.step", gcount[0])
+                if ckpt is not None:
+                    ckpt.on_batch_done(pass_id, batch_id, step_now)
+
+            # reader wrapper: resume skip for the first resumed pass +
+            # the reader.item injection site.  The plain path stays the
+            # raw reader — zero new per-step work when fault tolerance
+            # and injection are off.
+            rcount = [gcount[0]]
+
+            def pass_reader(pass_id):
+                skip = resume_skip if pass_id == start_pass else 0
+                if skip == 0 and not _fi.ENABLED:
+                    return reader, 0
+
+                def _r():
+                    for i, b in enumerate(reader()):
+                        if i < skip:
+                            continue
+                        rcount[0] += 1
+                        if _fi.ENABLED:
+                            a = _fi.check("reader.item", index=rcount[0])
+                            if a is not None:
+                                _fi.raise_for(a, "reader.item", rcount[0])
+                        yield b
+                return _r, skip
 
             if pipeline:
                 opts = dict(pipeline) if isinstance(pipeline, dict) else {}
@@ -156,21 +284,27 @@ class SGD:
                 # shipped — K pending plus in-flight slack bounds liveness
                 feeder = self._feeder(feeding, feed_list, staging_slots=K + 2)
                 from .reader.pipeline import prefetch
-                for pass_id in range(num_passes):
+                for pass_id in range(start_pass, num_passes):
                     event_handler(events.BeginPass(pass_id))
+                    if ckpt is not None:
+                        ckpt.resync()
                     # num_workers=0: no reader prefetch stage — decode runs in
                     # run_pipelined's staging thread (one host thread total;
                     # right when host cores are scarce)
-                    src = prefetch(reader, buffer_size=buf,
+                    r, skip = pass_reader(pass_id)
+                    src = prefetch(r, buffer_size=buf,
                                    num_workers=workers) if workers > 0 \
-                        else reader
+                        else r
                     feed_iter = (feeder.feed(b) for b in src())
                     for batch_id, out in enumerate(self.exe.run_pipelined(
                             feed_iter, self.main_program, fetch_list=fetch,
-                            steps_per_dispatch=K, prefetch_depth=depth)):
+                            steps_per_dispatch=K, prefetch_depth=depth),
+                            start=skip):
                         event_handler(events.BeginIteration(pass_id, batch_id))
                         emit_end(pass_id, batch_id, out)
                     event_handler(events.EndPass(pass_id))
+                if ckpt is not None:
+                    ckpt.final_save(num_passes)
                 return
 
             feeder = self._feeder(feeding, feed_list)
@@ -191,10 +325,13 @@ class SGD:
                     event_handler(events.BeginIteration(pass_id, first_id + i))
                     emit_end(pass_id, first_id + i, [o[i] for o in outs])
 
-            for pass_id in range(num_passes):
+            for pass_id in range(start_pass, num_passes):
                 event_handler(events.BeginPass(pass_id))
+                if ckpt is not None:
+                    ckpt.resync()
+                r, skip = pass_reader(pass_id)
                 if steps_per_dispatch <= 1:
-                    for batch_id, batch in enumerate(reader()):
+                    for batch_id, batch in enumerate(r(), start=skip):
                         event_handler(events.BeginIteration(pass_id, batch_id))
                         out = self.exe.run(self.main_program,
                                            feed=feeder.feed(batch),
@@ -203,7 +340,7 @@ class SGD:
                     event_handler(events.EndPass(pass_id))
                     continue
                 chunk, first_id, sig = [], 0, None
-                for batch_id, batch in enumerate(reader()):
+                for batch_id, batch in enumerate(r(), start=skip):
                     feed = feeder.feed(batch)
                     fsig = tuple(sorted(
                         (k, np.shape(v), str(np.asarray(v).dtype))
@@ -220,8 +357,12 @@ class SGD:
                 if chunk:
                     flush(pass_id, first_id, chunk)
                 event_handler(events.EndPass(pass_id))
+            if ckpt is not None:
+                ckpt.final_save(num_passes)
         finally:
             self.exe.validate = prev_validate
+            if ckpt is not None:
+                ckpt.close()
 
     def test(self, reader: Callable, feeding=None, feed_list=None):
         """Average cost (+extras) over a reader without updating params."""

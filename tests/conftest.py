@@ -26,6 +26,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: full benchmark A/Bs (minutes); deselect with -m 'not slow'")
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): per-test budget (advisory when pytest-timeout "
+        "is absent; chaos subprocess tests ALSO pass hard timeouts to "
+        "every subprocess call)")
 
 
 @pytest.fixture(autouse=True)

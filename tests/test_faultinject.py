@@ -1,0 +1,137 @@
+"""Deterministic fault-injection harness (paddle_tpu.testing.faultinject):
+spec grammar, index- vs hit-count matching, counters, and the
+zero-overhead off state."""
+import pytest
+
+from paddle_tpu.faults import (InjectedFault, TransientDispatchError)
+from paddle_tpu.testing import faultinject as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean_spec():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+def test_off_by_default():
+    assert fi.ENABLED is False
+    assert fi.active_spec() == ""
+
+
+def test_configure_and_clear():
+    fi.configure("executor.dispatch@3=transient")
+    assert fi.ENABLED
+    assert fi.active_spec() == "executor.dispatch@3=transient"
+    fi.clear()
+    assert not fi.ENABLED
+    fi.configure("")          # empty spec == clear
+    assert not fi.ENABLED
+
+
+def test_spec_parse_errors():
+    for bad in ("dispatch", "dispatch=x", "@3=x", "dispatch@x=boom",
+                "dispatch@3"):
+        with pytest.raises(ValueError):
+            fi.configure(bad)
+
+
+def test_hit_count_matching():
+    """Sites without a natural index match on their 1-based hit count."""
+    fi.configure("master.call@2=drop")
+    assert fi.check("master.call") is None          # hit 1
+    assert fi.check("master.call") == "drop"        # hit 2
+    assert fi.check("master.call") is None          # hit 3
+    assert fi.hits("master.call") == 3
+    assert fi.fired("master.call") == 1
+
+
+def test_index_matching_survives_restart_semantics():
+    """Index-matched sites key on the caller's position, not process hit
+    count — a resumed run starting past N must NOT re-fire N's entry."""
+    fi.configure("trainer.step@5=preempt")
+    # "resumed" process: first observed indexes are 6, 7, ...
+    assert fi.check("trainer.step", index=6) is None
+    assert fi.check("trainer.step", index=7) is None
+    assert fi.fired("trainer.step") == 0
+    # the original run would have fired exactly at 5
+    assert fi.check("trainer.step", index=5) == "preempt"
+
+
+def test_star_fires_every_hit():
+    fi.configure("reader.item@*=error")
+    for i in range(3):
+        assert fi.check("reader.item", index=i + 1) == "error"
+    assert fi.fired("reader.item") == 3
+
+
+def test_multiple_entries_and_sites():
+    fi.configure("reader.item@2=error;executor.dispatch@1=transient")
+    assert fi.check("reader.item", index=1) is None
+    assert fi.check("executor.dispatch") == "transient"
+    assert fi.check("reader.item", index=2) == "error"
+
+
+def test_raise_for_mapping():
+    with pytest.raises(InjectedFault):
+        fi.raise_for("error", "reader.item", 3)
+    with pytest.raises(TransientDispatchError):
+        fi.raise_for("transient", "executor.dispatch")
+    with pytest.raises(ConnectionError):
+        fi.raise_for("drop", "master.call")
+    # call sites handle their own site-specific actions BEFORE routing
+    # here; anything unrecognized (typo, wrong site) fails loudly rather
+    # than counting as fired while doing nothing
+    with pytest.raises(ValueError, match="not understood"):
+        fi.raise_for("premept", "trainer.step")       # typo'd action
+    with pytest.raises(ValueError, match="not understood"):
+        fi.raise_for("preempt", "executor.dispatch")  # wrong site
+
+
+def test_configure_resets_counters():
+    fi.configure("master.call@1=drop")
+    assert fi.check("master.call") == "drop"
+    fi.configure("master.call@1=drop")
+    assert fi.hits("master.call") == 0
+    assert fi.fired("master.call") == 0
+    assert fi.check("master.call") == "drop"   # counts restarted
+
+
+def test_firing_counts_metric_and_emits_event(tmp_path):
+    from paddle_tpu import flags
+    from paddle_tpu.observability import registry, summarize_log
+    from paddle_tpu.observability.export import _reset_writer
+
+    log = tmp_path / "faults.jsonl"
+    old = flags.get_flag("metrics_log")
+    flags.set_flag("metrics_log", str(log))
+    try:
+        before = registry().snapshot()["fault/injected"]["value"]
+        fi.configure("reader.item@1=error")
+        assert fi.check("reader.item", index=1) == "error"
+        after = registry().snapshot()["fault/injected"]["value"]
+        assert after - before == 1
+        _reset_writer()
+        summary = summarize_log(str(log))
+        assert summary["faults"]["events"] == 1
+        assert summary["faults"]["by_event"] == {"injected": 1}
+        assert summary["faults"]["timeline"][0]["site"] == "reader.item"
+    finally:
+        flags.set_flag("metrics_log", old)
+        _reset_writer()
+
+
+def test_env_spec_activates_in_subprocess(tmp_path):
+    import subprocess
+    import sys
+    code = ("from paddle_tpu.testing import faultinject as fi;"
+            "assert fi.ENABLED;"
+            "assert fi.check('reader.item', index=4) == 'error';"
+            "print('armed')")
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=60, env={"PATH": "/usr/bin:/bin",
+                         "PYTHONPATH": "/root/repo",
+                         "PADDLE_TPU_FAULT_SPEC": "reader.item@4=error"})
+    assert r.returncode == 0, r.stderr
+    assert "armed" in r.stdout

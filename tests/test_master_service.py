@@ -132,6 +132,55 @@ def test_generator_close_prompt_when_master_dead():
     c.close()
 
 
+def test_task_failed_max_failure_drop():
+    """A task that keeps failing is dropped after failure_max failures
+    (service.go:455-472): re-queued failure_max-1 times, then moved to
+    done and NEVER re-served this pass."""
+    m = Master(chunks_per_task=1, timeout_s=30.0, failure_max=2)
+    m.set_dataset([["poison"]])
+    t1 = m.get_task()
+    assert t1 is not None
+    m.task_failed(t1.task_id)            # failure 1: re-queued
+    assert m.stats() == {"todo": 1, "pending": 0, "done": 0, "epoch": 0}
+    t2 = m.get_task()
+    assert t2 is not None and t2.task_id == t1.task_id
+    assert t2.num_failures == 1
+    m.task_failed(t2.task_id)            # failure 2 == failure_max: drop
+    st = m.stats()
+    assert st == {"todo": 0, "pending": 0, "done": 1, "epoch": 0}
+    assert m.get_task() is None          # dropped, not re-served
+    # failing an unknown/already-dropped id is a no-op, not an error
+    m.task_failed(t2.task_id)
+    assert m.stats()["done"] == 1
+
+
+def test_requeue_timeouts_redispatch_exactly_once():
+    """A task whose holder dies (lease lapses) is re-served to another
+    client EXACTLY once: one timeout -> one budget tick -> one re-serve,
+    and the re-served copy is not duplicated in any queue."""
+    m = Master(chunks_per_task=1, timeout_s=0.15, failure_max=3)
+    m.set_dataset([["c0"], ["c1"]])
+    dead = m.get_task()                  # "holder" that will never finish
+    assert dead is not None
+    time.sleep(0.25)                     # lease lapses
+    # survivor pulls twice: gets the fresh task and the timed-out one,
+    # each exactly once
+    got = [m.get_task(), m.get_task()]
+    ids = sorted(t.task_id for t in got)
+    assert ids == sorted({dead.task_id} |
+                         {t.task_id for t in got})
+    assert len(ids) == 2                 # no duplicate serve
+    redispatched = next(t for t in got if t.task_id == dead.task_id)
+    assert redispatched.num_failures == 1    # exactly one budget tick
+    assert m.get_task() is None          # nothing left to serve
+    st = m.stats()
+    assert st["pending"] == 2 and st["todo"] == 0
+    for t in got:
+        m.task_finished(t.task_id)
+    st = m.stats()
+    assert st["done"] == 2 and st["pending"] == 0
+
+
 def test_task_returned_nowait_succeeds_against_live_master():
     """The fast path is not only for dead masters: against a live one it
     really returns the task (re-queued immediately, no budget burn)."""
