@@ -16,8 +16,17 @@ Four passes (each its own module):
    registered alongside the lowerings (``register_shape_fn``), with
    ``-1``-batch symbolic dims (PT010-PT012).
 3. :mod:`.lints` — dead ops, retrace hazards, sharding-spec consistency
-   for ``ShardedExecutor`` meshes (PT020-PT022, PT030-PT031).
+   for ``ShardedExecutor`` meshes (PT020-PT022, PT030-PT031, PT040).
 4. :mod:`.diagnostics` — the stable code registry and report rendering.
+
+On top of the verification passes sits the auto-sharding stack (one module
+each, same IR, still chip-free): :mod:`.shard_prop` propagates per-dim
+sharding annotations through per-op ``register_shard_fn`` rules
+(PT041/PT042 conflicts), :mod:`.cost_model` prices a plan statically
+(FLOPs/bytes/collectives/peak-HBM), and :mod:`.planner` enumerates, scores
+and validates candidate ``param_specs``/``feed_specs`` for a mesh —
+consumed by ``ShardedExecutor(auto_shard=True)`` and the
+``python -m paddle_tpu plan`` CLI.
 
 Entry points: :func:`validate_program` here, ``Program.validate()``,
 ``Executor(validate=True)`` / the ``validate`` flag
@@ -38,12 +47,15 @@ from .lints import (mesh_axes_of, run_dead_op_lint, run_retrace_lints,
                     run_sharding_lints)
 from .shape_infer import (SHAPE_INFER_ALLOWLIST, ShapeError, VarInfo,
                           coverage, run_shape_inference)
+from .shard_prop import (PropagationResult, ShardConflict, ShardInfo,
+                         propagate_sharding)
 from .verifier import run_verifier
 
 __all__ = [
     "CODES", "Diagnostic", "ProgramVerificationError", "ValidationReport",
     "ShapeError", "VarInfo", "SHAPE_INFER_ALLOWLIST", "coverage",
-    "validate_program", "diag",
+    "validate_program", "diag", "propagate_sharding", "PropagationResult",
+    "ShardConflict", "ShardInfo",
 ]
 
 

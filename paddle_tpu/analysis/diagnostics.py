@@ -30,6 +30,9 @@ PT021     warning   retrace hazard: feed signature cannot stay stable
 PT022     warning   retrace hazard: persistable var rebound per step
 PT030     error     sharding spec names an axis the mesh does not have
 PT031     error     sharded dim not divisible by its mesh axis size
+PT040     error     sharding spec double-books a mesh axis across dims
+PT041     warning   sharding conflict at an op: a reshard is required
+PT042     warning   sharding propagation blind spot: op has no shard rule
 ========  ========  =====================================================
 """
 from __future__ import annotations
@@ -57,6 +60,9 @@ CODES = {
     "PT022": (WARNING, "retrace hazard: persistable var rebound"),
     "PT030": (ERROR, "sharding spec names unknown mesh axis"),
     "PT031": (ERROR, "sharded dim not divisible by axis size"),
+    "PT040": (ERROR, "mesh axis double-booked across dims of one spec"),
+    "PT041": (WARNING, "sharding conflict at an op (reshard required)"),
+    "PT042": (WARNING, "sharding propagation blind spot (no shard rule)"),
 }
 
 

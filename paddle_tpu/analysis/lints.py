@@ -199,6 +199,19 @@ def run_sharding_lints(program, mesh_axes: Optional[Dict[str, int]],
 
     for name, (origin, spec, shape) in sorted(specs.items()):
         entries = _spec_entries(spec)
+        booked: Dict[str, int] = {}
+        for dim_idx, entry in enumerate(entries):
+            for ax in _axes_of(entry):
+                if ax in booked:
+                    # GSPMD rejects a spec that uses one mesh axis to shard
+                    # two different dims of the same tensor
+                    report.add(diag(
+                        "PT040",
+                        f"{origin} for {name!r}: mesh axis {ax!r} shards "
+                        f"both dim {booked[ax]} and dim {dim_idx} — an "
+                        f"axis can partition at most one dim", var=name))
+                else:
+                    booked[ax] = dim_idx
         if shape is not None and len(entries) > len(shape):
             report.add(diag(
                 "PT031",

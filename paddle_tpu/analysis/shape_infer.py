@@ -322,10 +322,10 @@ SHAPE_INFER_ALLOWLIST = frozenset({
     # lowered specially by the executor (jax.value_and_grad section);
     # its Grads outputs are declared by append_backward with param shapes
     "backward",
-    # detection post-processing: box counts are data-dependent in the
-    # reference semantics; the static forms here are placeholder-shaped
-    "roi_pool", "prior_box", "box_coder", "ssd_loss",
-    "multiclass_nms", "detection_output",
+    # (the detection post-processing family — roi_pool, prior_box,
+    # box_coder, ssd_loss, multiclass_nms, detection_output — moved OFF
+    # this list: their static-shape TPU lowerings have exact rules in
+    # ops/detection_ops.py, unlike the reference's ragged LoD outputs)
 })
 
 

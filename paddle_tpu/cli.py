@@ -21,6 +21,13 @@ prints the ``PT0xx`` report (exit 1 on errors, and on warnings too with
 v1 config (``check --config conf.py``) it verifies the built main and
 startup programs instead.
 
+``python -m paddle_tpu plan prog.json --mesh dp=8`` runs the static
+auto-sharding planner (paddle_tpu.analysis.planner): it prints proposed
+``param_specs``/``feed_specs`` for the mesh, the static cost breakdown
+and the per-device peak-HBM estimate, and ``--out plan.json`` writes a
+plan file that ``check --specs plan.json`` can later re-validate against
+the program — a CI gate needing no Python config import.
+
 Feeds come from ``--feed-npz`` (named arrays matching the config's data
 layers, with ``name@LEN`` companions for sequences); ``time`` and
 ``checkgrad`` synthesize random feeds from the declared shapes when none
@@ -285,6 +292,25 @@ def _load_check_target(path: str):
                          f"Program: {type(e).__name__}: {e}")
 
 
+def _load_plan_file(path: str):
+    """plan.json (analysis.planner.Plan.to_dict output) -> Plan."""
+    from paddle_tpu.analysis.planner import Plan
+
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except OSError as e:
+        raise SystemExit(f"check: cannot read plan {path!r}: {e}")
+    except json.JSONDecodeError as e:
+        raise SystemExit(f"check: {path!r} is not a plan JSON "
+                         f"(paddle_tpu plan --out output): {e}")
+    try:
+        return Plan.from_dict(d)
+    except (KeyError, TypeError, ValueError) as e:
+        raise SystemExit(f"check: {path!r} does not deserialize as a "
+                         f"sharding plan: {type(e).__name__}: {e}")
+
+
 def job_check(argv):
     ap = argparse.ArgumentParser(
         prog="paddle_tpu check",
@@ -301,7 +327,12 @@ def job_check(argv):
                     help="k=v,... forwarded to get_config_arg")
     ap.add_argument("--mesh", default=None,
                     help="axis=size,... — enables the sharding lints "
-                         "(PT030/PT031) against this mesh")
+                         "(PT030/PT031/PT040) against this mesh")
+    ap.add_argument("--specs", default=None,
+                    help="plan.json (from `paddle_tpu plan --out`): "
+                         "validate its param/feed specs against the "
+                         "program — a CI gate for a committed plan; the "
+                         "plan's own mesh applies when --mesh is omitted")
     ap.add_argument("--strict", action="store_true",
                     help="exit nonzero on warnings too")
     args = ap.parse_args(argv)
@@ -309,6 +340,13 @@ def job_check(argv):
         ap.error("give exactly one of a program file or --config")
 
     mesh = _parse_mesh(args.mesh)
+    param_specs = feed_specs = None
+    if args.specs is not None:
+        plan_obj = _load_plan_file(args.specs)
+        param_specs = plan_obj.param_specs
+        feed_specs = plan_obj.feed_specs
+        if mesh is None:
+            mesh = plan_obj.mesh_axes
     targets = []                 # (label, program, fetch_list)
     if args.config is not None:
         from paddle_tpu.trainer_config_helpers import load_v1_config
@@ -322,7 +360,9 @@ def job_check(argv):
 
     errors = warnings_ = 0
     for label, program, fetch_list in targets:
-        report = program.validate(fetch_list=fetch_list, mesh=mesh)
+        report = program.validate(fetch_list=fetch_list, mesh=mesh,
+                                  param_specs=param_specs,
+                                  feed_specs=feed_specs)
         errors += len(report.errors)
         warnings_ += len(report.warnings)
         print(f"== {label}: {report.render()}", flush=True)
@@ -331,6 +371,62 @@ def job_check(argv):
                       "errors": errors, "warnings": warnings_}),
           flush=True)
     return 1 if errors or (args.strict and warnings_) else 0
+
+
+def job_plan(argv):
+    """Auto-sharding planner CLI: propose specs for a program + mesh."""
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu plan",
+        description="static auto-sharding planner "
+                    "(paddle_tpu.analysis.planner): propose "
+                    "param_specs/feed_specs for a serialized program and "
+                    "a mesh, print the cost breakdown and the per-device "
+                    "peak-HBM estimate — pure static analysis, no chip "
+                    "required.  The emitted plan passes the PT030/PT031 "
+                    "sharding lints by construction; validate a committed "
+                    "plan later with `paddle_tpu check prog.json --specs "
+                    "plan.json`.")
+    ap.add_argument("program",
+                    help="Program.to_json file, save_inference_model "
+                         "__model__ meta, or a directory containing one")
+    ap.add_argument("--mesh", required=True,
+                    help="axis=size,... (e.g. dp=8 or dp=4,tp=2)")
+    ap.add_argument("--batch", type=int, default=64,
+                    help="batch assumed for symbolic -1 dims in the cost "
+                         "model (default 64)")
+    ap.add_argument("--batch-axis", default="dp",
+                    help="mesh axis feeds shard their batch dim on "
+                         "(default dp)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the plan as ONE JSON object only")
+    ap.add_argument("--out", default=None,
+                    help="also write the plan JSON to this file")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis import planner
+
+    mesh = _parse_mesh(args.mesh)
+    program, _fetch_names = _load_check_target(args.program)
+    try:
+        plan_obj = planner.plan(program, mesh, batch_axis=args.batch_axis,
+                                assume_batch=args.batch)
+    except ValueError as e:
+        raise SystemExit(f"plan: {e}")
+    if args.out:
+        try:
+            with open(args.out, "w") as f:
+                f.write(plan_obj.to_json())
+        except OSError as e:
+            raise SystemExit(f"plan: cannot write {args.out!r}: {e}")
+    if args.json:
+        print(json.dumps(plan_obj.to_dict(), sort_keys=True), flush=True)
+    else:
+        print(plan_obj.render(), flush=True)
+        print(json.dumps({"plan": "OK", "candidate": plan_obj.candidate,
+                          "params_sharded": len(plan_obj.param_specs),
+                          "feeds_sharded": len(plan_obj.feed_specs)}),
+              flush=True)
+    return 0
 
 
 def job_stats(argv):
@@ -362,6 +458,8 @@ def main(argv=None):
         argv = sys.argv[1:]
     if argv and argv[0] == "check":
         return job_check(argv[1:])
+    if argv and argv[0] == "plan":
+        return job_plan(argv[1:])
     if argv and argv[0] == "stats":
         return job_stats(argv[1:])
     ap = argparse.ArgumentParser(
@@ -369,9 +467,11 @@ def main(argv=None):
         description="TrainerMain analog: run a v1 config on the TPU "
                     "runtime.  Subcommands also exist: `paddle_tpu check "
                     "prog.json|__model__|dir` runs the static program "
-                    "verifier and `paddle_tpu stats run.jsonl` summarizes "
-                    "an observability metrics log (see `paddle_tpu "
-                    "check|stats --help`).")
+                    "verifier, `paddle_tpu plan prog.json --mesh dp=8` "
+                    "proposes auto-sharding specs with a static cost "
+                    "breakdown, and `paddle_tpu stats run.jsonl` "
+                    "summarizes an observability metrics log (see "
+                    "`paddle_tpu check|plan|stats --help`).")
     ap.add_argument("--config", required=True, help="v1 config file")
     ap.add_argument("--job", default="train",
                     choices=["train", "test", "time", "checkgrad"])

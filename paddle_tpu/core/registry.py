@@ -29,6 +29,7 @@ from typing import Callable, Dict, Optional
 
 _OP_IMPLS: Dict[str, Callable] = {}
 _SHAPE_FNS: Dict[str, Callable] = {}
+_SHARD_FNS: Dict[str, Callable] = {}
 
 
 def register_op(*names: str):
@@ -101,3 +102,48 @@ def has_shape_fn(name: str) -> bool:
 
 def registered_shape_fns():
     return sorted(_SHAPE_FNS)
+
+
+def register_shard_fn(*names: str):
+    """Register a sharding-propagation rule for one or more op type names —
+    the distributed companion of :func:`register_shape_fn`, consumed by the
+    auto-sharding planner (``paddle_tpu.analysis.shard_prop``).
+
+    A rule has the signature ``fn(op, ins, attrs) -> {out_slot: spec}``
+    where ``ins`` maps input slot -> list of
+    :class:`paddle_tpu.analysis.shard_prop.ShardInfo` (current per-dim
+    sharding + static shape) and each returned spec is a tuple with one
+    entry per output dim (``None`` = replicated, an axis name, or a tuple
+    of axis names).  Rules raise
+    :class:`paddle_tpu.analysis.shard_prop.ShardConflict` when the inputs
+    carry shardings the op cannot realize without a reshard (surfaced as
+    PT041).  A rule built by the helper factories in ``shard_prop`` also
+    carries a ``.backward`` attribute used by the reverse propagation
+    sweep; hand-written rules may attach one.
+
+    Ops without a rule are propagation blind spots: a sharded value
+    flowing into one is reported PT042 and treated as replicated
+    downstream.  Rules run at planning/validation time only — never in
+    the stepped hot path.
+    """
+
+    def deco(fn):
+        for n in names:
+            if n in _SHARD_FNS:
+                raise ValueError(f"shard fn for op {n!r} registered twice")
+            _SHARD_FNS[n] = fn
+        return fn
+
+    return deco
+
+
+def get_shard_fn(name: str) -> Optional[Callable]:
+    return _SHARD_FNS.get(name)
+
+
+def has_shard_fn(name: str) -> bool:
+    return name in _SHARD_FNS
+
+
+def registered_shard_fns():
+    return sorted(_SHARD_FNS)

@@ -132,3 +132,19 @@ register_shape_fn(
     "hard_shrink", "soft_shrink", "softshrink", "thresholded_relu",
     "hard_sigmoid", "prelu",
 )(same_as("X"))
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rules (analysis.shard_prop): activations are
+# elementwise, so outputs carry their input's per-dim sharding unchanged.
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import shard_same_as  # noqa: E402
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn(
+    "sigmoid", "logsigmoid", "tanh", "relu", "relu6", "abs", "sqrt",
+    "rsqrt", "square", "exp", "log", "floor", "ceil", "round",
+    "reciprocal", "softsign", "softplus", "softrelu", "sin", "cos",
+    "gelu", "silu", "swish", "brelu", "leaky_relu", "elu", "stanh",
+    "hard_shrink", "soft_shrink", "softshrink", "thresholded_relu",
+    "hard_sigmoid", "prelu",
+)(shard_same_as("X"))

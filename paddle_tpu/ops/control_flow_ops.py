@@ -324,3 +324,17 @@ def _lod_rank_table_shape(op, ins, attrs):
     x = first(ins, "X")
     b = x.shape[0] if x.shape is not None else -1
     return {"Out": VarInfo((b,), "int32")}
+
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rules (analysis.shard_prop): memory helpers are
+# shape-preserving; print/assert are transparent; the tensor-array and
+# lod-rank machinery is data-dependent (deliberately unregistered — a
+# sharded value reaching it is a real planner blind spot worth a PT042).
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import shard_noop, shard_same_as  # noqa: E402
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn("shrink_rnn_memory", "rnn_memory_helper")(
+    shard_same_as("X"))
+register_shard_fn("print", "assert")(shard_noop())

@@ -349,3 +349,18 @@ def _chunk_eval_shape(op, ins, attrs):
     return {"Precision": f, "Recall": f, "F1-Score": f,
             "NumInferChunks": i, "NumLabelChunks": i,
             "NumCorrectChunks": i}
+
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rules (analysis.shard_prop): CRF ops keep the batch
+# sharding of their emissions; copy_len is a metadata marker.
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import shard_batch_only, shard_noop  # noqa: E402
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn("copy_len")(shard_noop())
+register_shard_fn("crf_decoding")(
+    shard_batch_only("Emission", out="ViterbiPath"))
+register_shard_fn("linear_chain_crf")(
+    shard_batch_only("Emission", out="LogLikelihood",
+                     also=("EmissionExps", "Alpha")))

@@ -321,10 +321,11 @@ def _detection_output(ctx, ins, attrs):
 
 
 # ---------------------------------------------------------------------------
-# Static shape/dtype rule.  The data-dependent detection ops (roi_pool,
-# prior_box, box_coder, ssd_loss, multiclass_nms, detection_output) are
-# allowlisted in analysis.shape_infer — their output layout is placeholder-
-# shaped by design — but IoU is statically exact.
+# Static shape/dtype rules.  The detection lowerings above are static-shape
+# TPU redesigns (padded ground truth, fixed keep_top_k NMS slabs) — so
+# unlike the reference's ragged LoD outputs their shapes ARE statically
+# known, and each op gets an exact rule mirroring its lowering instead of a
+# SHAPE_INFER_ALLOWLIST entry.
 # ---------------------------------------------------------------------------
 from ..analysis.shape_infer import ShapeError, VarInfo, first  # noqa: E402
 from ..core.registry import register_shape_fn  # noqa: E402
@@ -342,3 +343,103 @@ def _iou_similarity_shape(op, ins, attrs):
     n = x.shape[0] if x.shape is not None else -1
     m = y.shape[0] if y.shape is not None else -1
     return {"Out": VarInfo((n, m), x.dtype)}
+
+
+@register_shape_fn("roi_pool")
+def _roi_pool_shape(op, ins, attrs):
+    x, rois = first(ins, "X"), first(ins, "ROIs")
+    if rois.shape is not None and len(rois.shape) == 2 and \
+            rois.shape[-1] >= 0 and rois.shape[-1] != 5:
+        raise ShapeError(
+            f"roi_pool: ROIs must be [R, 5] (batch_idx, x1, y1, x2, y2), "
+            f"got {list(rois.shape)}")
+    r = rois.shape[0] if rois.shape is not None else -1
+    c = x.shape[1] if x.shape is not None and len(x.shape) == 4 else -1
+    shape = (r, c, int(attrs["pooled_height"]), int(attrs["pooled_width"]))
+    return {"Out": VarInfo(shape, x.dtype),
+            "Argmax": VarInfo(shape, "int64")}
+
+
+@register_shape_fn("prior_box")
+def _prior_box_shape(op, ins, attrs):
+    feat = first(ins, "Input")
+    min_sizes = list(attrs["min_sizes"])
+    max_sizes = list(attrs.get("max_sizes", []))
+    ars = list(attrs.get("aspect_ratios", [1.0]))
+    flip = attrs.get("flip", True)
+    # mirror the lowering's box enumeration exactly
+    full_ars = []
+    for ar in ars:
+        full_ars.append(ar)
+        if flip and ar != 1.0:
+            full_ars.append(1.0 / ar)
+    nb = len(min_sizes) * (
+        1 + len(max_sizes) + sum(1 for ar in full_ars if ar != 1.0))
+    fh = feat.shape[2] if feat.shape is not None and \
+        len(feat.shape) == 4 else -1
+    fw = feat.shape[3] if feat.shape is not None and \
+        len(feat.shape) == 4 else -1
+    dt = feat.dtype if feat.dtype is not None else "float32"
+    info = VarInfo((fh, fw, nb, 4), dt)
+    return {"Boxes": info, "Variances": info}
+
+
+@register_shape_fn("box_coder")
+def _box_coder_shape(op, ins, attrs):
+    prior, target = first(ins, "PriorBox"), first(ins, "TargetBox")
+    for name, v in (("PriorBox", prior), ("TargetBox", target)):
+        if v.shape is not None and len(v.shape) >= 1 and \
+                v.shape[-1] >= 0 and v.shape[-1] != 4:
+            raise ShapeError(
+                f"box_coder: {name} must be [*, 4], got {list(v.shape)}")
+    return {"OutputBox": target}
+
+
+@register_shape_fn("ssd_loss")
+def _ssd_loss_shape(op, ins, attrs):
+    conf, loc = first(ins, "Confidence"), first(ins, "Location")
+    if conf.shape is not None and len(conf.shape) != 3:
+        raise ShapeError(
+            f"ssd_loss: Confidence must be [N, P, C], got "
+            f"{list(conf.shape)}")
+    if loc.shape is not None and len(loc.shape) >= 1 and \
+            loc.shape[-1] >= 0 and loc.shape[-1] != 4:
+        raise ShapeError(
+            f"ssd_loss: Location must be [N, P, 4], got "
+            f"{list(loc.shape)}")
+    n = conf.shape[0] if conf.shape is not None else \
+        (loc.shape[0] if loc.shape is not None else -1)
+    dt = loc.dtype if loc.dtype is not None else conf.dtype
+    return {"Loss": VarInfo((n, 1), dt)}
+
+
+@register_shape_fn("multiclass_nms", "detection_output")
+def _detection_output_shape(op, ins, attrs):
+    scores = first(ins, "Scores")
+    if scores.shape is not None and len(scores.shape) != 3:
+        raise ShapeError(
+            f"detection_output: Scores must be [N, num_priors, C], got "
+            f"{list(scores.shape)}")
+    n = scores.shape[0] if scores.shape is not None else -1
+    keep = int(attrs.get("keep_top_k", 16))
+    dt = scores.dtype if scores.dtype is not None else "float32"
+    return {"Out": VarInfo((n, keep, 6), dt)}
+
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rules (analysis.shard_prop): detection heads keep
+# the image/ROI batch sharding; priors replicate (they are per-feature-map
+# constants).
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import (shard_batch_only,  # noqa: E402
+                                   shard_replicated)
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn("prior_box")(shard_replicated("Boxes", "Variances"))
+register_shard_fn("iou_similarity", "box_coder")(shard_replicated(
+    "Out", "OutputBox"))
+register_shard_fn("ssd_loss", "multiclass_nms", "detection_output",
+                  "roi_pool")(shard_batch_only(
+                      "Location", out="Loss",
+                      fallbacks=("Scores", "X"),
+                      also=("Out", "Argmax")))

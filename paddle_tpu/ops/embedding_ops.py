@@ -150,3 +150,30 @@ def _hsigmoid_shape(op, ins, attrs):
         if isinstance(num_classes, int) else -1
     return {"Out": VarInfo((b, 1), x.dtype),
             "PreOut": VarInfo((b, depth), x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rules (analysis.shard_prop).  A vocab-sharded table
+# (Megatron embedding / the reference's SelectedRows-on-pserver analog)
+# lowers to a masked partial gather + all-reduce under GSPMD; the output
+# rides the Ids' batch sharding either way, with the emb dim following the
+# table's column split.
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import (first_in,  # noqa: E402
+                                   shard_batch_only, squeeze_spec_ids)
+from ..core.registry import register_shard_fn  # noqa: E402
+
+
+@register_shard_fn("lookup_table")
+def _lookup_table_shard(op, ins, attrs):
+    w, ids = first_in(ins, "W"), first_in(ins, "Ids")
+    if w.spec is None and ids.spec is None:
+        return {}
+    lead = squeeze_spec_ids(ids)
+    return {"Out": lead + (w.entry(-1),)}
+
+
+register_shard_fn("nce", "hierarchical_sigmoid", "hsigmoid")(
+    shard_batch_only("Input", out="Cost", fallbacks=("X",),
+                     also=("Out", "PreOut", "SampleLogits",
+                           "SampleLabels")))

@@ -142,3 +142,15 @@ def _recurrent_alias(ctx, ins, attrs):
     (recurrent_op.cc:39)."""
     from ..core.registry import get_op_impl
     return get_op_impl("rnn")(ctx, ins, attrs)
+
+
+# ---------------------------------------------------------------------------
+# Sharding propagation (analysis.shard_prop): beam search is decode-time
+# data-dependent machinery — registering the explicit noop states that its
+# outputs are treated replicated (beams are small; sharding them is
+# never the plan), rather than leaving a PT042 blind spot.
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import shard_noop  # noqa: E402
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn("beam_search", "beam_search_decode")(shard_noop())

@@ -24,3 +24,9 @@ from ..analysis.shape_infer import no_outputs  # noqa: E402
 from ..core.registry import register_shape_fn  # noqa: E402
 
 register_shape_fn("save", "load")(no_outputs())
+
+# Sharding propagation: persistence ops are host-side no-ops.
+from ..analysis.shard_prop import shard_noop  # noqa: E402
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn("save", "load")(shard_noop())

@@ -329,3 +329,26 @@ def _lambda_rank_shape(op, ins, attrs):
 @register_shape_fn("cross_entropy_over_beam")
 def _ce_over_beam_shape(op, ins, attrs):
     return {"Out": _rowwise(first(ins, "Scores"))}
+
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rules (analysis.shard_prop): loss heads keep the
+# batch sharding; elementwise losses are shape-preserving.
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import (shard_batch_only,  # noqa: E402
+                                   shard_replicated, shard_same_as)
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn("hinge_loss")(shard_same_as("Logits", out="Loss"))
+register_shard_fn("log_loss")(shard_same_as("Predicted", out="Loss"))
+register_shard_fn("sigmoid_cross_entropy_with_logits", "mse_loss")(
+    shard_same_as("X"))
+register_shard_fn("kldiv_loss")(shard_same_as("X", out="Loss"))
+register_shard_fn("rank_loss")(shard_same_as("Left"))
+register_shard_fn("huber_loss")(shard_same_as("X", also=("Residual",)))
+register_shard_fn("margin_rank_loss")(
+    shard_same_as("X1", also=("Activated",)))
+register_shard_fn("smooth_l1_loss")(shard_batch_only("X"))
+register_shard_fn("squared_l2_distance")(shard_batch_only("X"))
+register_shard_fn("cos_sim")(shard_batch_only("X"))
+register_shard_fn("squared_l2_norm")(shard_replicated("Out"))

@@ -455,3 +455,42 @@ def _fm_shape(op, ins, attrs):
             f"{v.shape[0]}")
     b = x.shape[0] if x.shape is not None else -1
     return {"Out": VarInfo((b, 1), x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rules (analysis.shard_prop).  mul carries the
+# Megatron contract: row dims follow X, col dims follow Y, and a sharded
+# contraction must match on both sides (the row-parallel all-reduce).
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import (merge_specs,  # noqa: E402
+                                   shard_elementwise, shard_matmul,
+                                   shard_mul, shard_reduce,
+                                   shard_replicated, shard_same_as)
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn(
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_pow", "elementwise_max",
+    "elementwise_min", "elementwise_mod", "equal", "not_equal",
+    "less_than", "less_equal", "greater_than", "greater_equal",
+    "logical_and", "logical_or", "logical_xor", "abs_diff",
+    "squared_difference",
+)(shard_elementwise())
+register_shard_fn(
+    "logical_not", "scale", "minus", "clip", "clip_by_norm", "sign",
+    "pow", "increment", "cumsum", "l2_normalize", "norm",
+    "interpolation", "scale_sub_region", "cast",
+)(shard_same_as("X"))
+register_shard_fn("reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+                  "reduce_prod")(shard_reduce())
+register_shard_fn("mul")(shard_mul())
+register_shard_fn("matmul")(shard_matmul())
+register_shard_fn("mean", "isfinite")(shard_replicated("Out"))
+
+
+@register_shard_fn("sum")
+def _sum_shard(op, ins, attrs):
+    spec = None
+    for x in ins.get("X", []):
+        spec = merge_specs(spec, x.spec, "sum operands")
+    return {} if spec is None else {"Out": spec}

@@ -133,3 +133,19 @@ def _precision_recall_shape(op, ins, attrs):
 def _pnpair_shape(op, ins, attrs):
     s = VarInfo((1,), "float32")
     return {"PositivePair": s, "NegativePair": s, "NeutralPair": s}
+
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rules (analysis.shard_prop): metrics reduce to
+# scalars/counters — replicated outputs regardless of input sharding.
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import shard_replicated  # noqa: E402
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn("accuracy")(shard_replicated(
+    "Accuracy", "Correct", "Total"))
+register_shard_fn("auc")(shard_replicated("AUC"))
+register_shard_fn("precision_recall")(shard_replicated(
+    "BatchMetrics", "AccumMetrics", "AccumStatesInfo"))
+register_shard_fn("positive_negative_pair")(shard_replicated(
+    "PositivePair", "NegativePair", "NeutralPair"))

@@ -98,3 +98,21 @@ def _moe_shape(op, ins, attrs):
             f"moe: W1 expert count {w1.shape[0]} != GateW experts "
             f"{gate_w.shape[-1]}")
     return {"Out": x, "AuxLoss": VarInfo((), "float32")}
+
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rule (analysis.shard_prop): the fused MoE op is
+# token-preserving — Out rides X's sharding, the aux loss replicates.
+# (Expert-parallel specs on W1/W2 partition the expert dim; the dispatch
+# all-to-all is GSPMD's to insert and the cost model's to charge.)
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import first_in  # noqa: E402
+from ..core.registry import register_shard_fn  # noqa: E402
+
+
+@register_shard_fn("moe")
+def _moe_shard(op, ins, attrs):
+    x = first_in(ins, "X")
+    if x.spec is None:
+        return {}
+    return {"Out": x.spec, "AuxLoss": ()}

@@ -713,3 +713,76 @@ def _im2sequence_shape(op, ins, attrs):
     t = -1 if oh < 0 or ow < 0 else oh * ow
     d = -1 if c < 0 else c * kh * kw
     return {"Out": x.with_shape((n, t, d))}
+
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rules (analysis.shard_prop): convs follow
+# batch/output-channel sharding, normalizations and pointwise heads are
+# shape-preserving, losses keep the batch dim only.
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import (shard_batch_only,  # noqa: E402
+                                   shard_conv2d, shard_same_as)
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn("conv2d", "depthwise_conv2d")(shard_conv2d())
+register_shard_fn("softmax", "log_softmax", "lrn")(shard_same_as("X"))
+register_shard_fn("dropout")(shard_same_as("X", also=("Mask",)))
+register_shard_fn("cross_entropy")(shard_batch_only("X", out="Y"))
+
+
+@register_shard_fn("pool2d", "pool3d", "max_pool2d_with_index",
+                   "pool2d_with_index")
+def _pool_shard(op, ins, attrs):
+    from ..analysis.shard_prop import ShardConflict, first_in
+    x = first_in(ins, "X")
+    if x.spec is None:
+        return {}
+    if any(x.entry(i) for i in range(2, len(x.spec))):
+        raise ShardConflict(
+            "pooling input spatially sharded: halo exchange required")
+    spec = (x.entry(0), x.entry(1)) + (None,) * (len(x.spec) - 2)
+    res = {"Out": spec}
+    if op.outputs.get("Mask"):
+        res["Mask"] = spec
+    return res
+
+
+@register_shard_fn("batch_norm")
+def _batch_norm_shard(op, ins, attrs):
+    from ..analysis.shard_prop import first_in
+    x = first_in(ins, "X")
+    res = {}
+    if x.spec is not None:
+        res["Y"] = x.spec
+    for out_slot, in_slot in (("MeanOut", "Mean"),
+                              ("VarianceOut", "Variance"),
+                              ("SavedMean", "Mean"),
+                              ("SavedVariance", "Variance")):
+        v = first_in(ins, in_slot)
+        if op.outputs.get(out_slot) and v.spec is not None:
+            res[out_slot] = v.spec
+    return res
+
+
+@register_shard_fn("layer_norm")
+def _layer_norm_shard(op, ins, attrs):
+    from ..analysis.shard_prop import first_in
+    x = first_in(ins, "X")
+    if x.spec is None:
+        return {}
+    begin = attrs.get("begin_norm_axis", 1)
+    res = {"Y": x.spec}
+    if op.outputs.get("Mean"):
+        res["Mean"] = x.spec[:begin]
+    if op.outputs.get("Variance"):
+        res["Variance"] = x.spec[:begin]
+    return res
+
+
+@register_shard_fn("softmax_with_cross_entropy")
+def _softmax_ce_shard(op, ins, attrs):
+    from ..analysis.shard_prop import first_in
+    logits = first_in(ins, "Logits")
+    if logits.spec is None:
+        return {}
+    return {"Softmax": logits.spec, "Loss": (logits.entry(0), None)}

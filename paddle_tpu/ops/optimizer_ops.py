@@ -226,3 +226,37 @@ register_shape_fn("ftrl")(_opt_rule(
     {"ParamOut": "Param", "SquaredAccumOut": "SquaredAccumulator",
      "LinearAccumOut": "LinearAccumulator"}))
 register_shape_fn("proximal_gd")(_opt_rule({"ParamOut": "Param"}))
+
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rules (analysis.shard_prop): every optimizer op
+# keeps its state on the parameter's sharding (the dp-reduced gradient
+# arrives in the param's layout; accumulators ride along), with the
+# Param-vs-Grad merge surfacing layout mismatches as PT041.
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import shard_mirror  # noqa: E402
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn("sgd", "proximal_gd")(shard_mirror(
+    {"ParamOut": "Param"}, check_grad=True))
+register_shard_fn("momentum")(shard_mirror(
+    {"ParamOut": "Param", "VelocityOut": "Velocity"}, check_grad=True))
+register_shard_fn("adam")(shard_mirror(
+    {"ParamOut": "Param", "Moment1Out": "Moment1",
+     "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+     "Beta2PowOut": "Beta2Pow"}, check_grad=True))
+register_shard_fn("adamax")(shard_mirror(
+    {"ParamOut": "Param", "MomentOut": "Moment", "InfNormOut": "InfNorm",
+     "Beta1PowOut": "Beta1Pow"}, check_grad=True))
+register_shard_fn("adagrad", "decayed_adagrad", "proximal_adagrad")(
+    shard_mirror({"ParamOut": "Param", "MomentOut": "Moment"},
+                 check_grad=True))
+register_shard_fn("adadelta")(shard_mirror(
+    {"ParamOut": "Param", "AvgSquaredGradOut": "AvgSquaredGrad",
+     "AvgSquaredUpdateOut": "AvgSquaredUpdate"}, check_grad=True))
+register_shard_fn("rmsprop")(shard_mirror(
+    {"ParamOut": "Param", "MomentOut": "Moment",
+     "MeanSquareOut": "MeanSquare"}, check_grad=True))
+register_shard_fn("ftrl")(shard_mirror(
+    {"ParamOut": "Param", "SquaredAccumOut": "SquaredAccumulator",
+     "LinearAccumOut": "LinearAccumulator"}, check_grad=True))

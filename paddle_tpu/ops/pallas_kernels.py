@@ -524,3 +524,11 @@ def _flash_attention_shape(op, ins, attrs):
                     f"flash_attention: Q {list(q.shape)} vs {name} "
                     f"{list(o.shape)} (rank or head dim mismatch)")
     return {"Out": q}
+
+
+# Sharding propagation: flash_attention is shape-preserving on Q (the
+# kernel runs per-shard under shard_map; batch/head sharding rides along).
+from ..analysis.shard_prop import shard_same_as  # noqa: E402
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn("flash_attention")(shard_same_as("Q"))

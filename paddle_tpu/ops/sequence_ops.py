@@ -795,3 +795,46 @@ def _kmax_seq_score_shape(op, ins, attrs):
     b = x.shape[0] if x.shape is not None else -1
     k = int(attrs.get("beam_size", attrs.get("k", 1)))
     return {"Out": VarInfo((b, k), "int64")}
+
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rules (analysis.shard_prop).  Recurrences keep the
+# batch sharding; the lstm/gru gate dim follows the Weight's column split
+# (the Megatron gate-parallel pattern — the col-split input projection and
+# the recurrent weight shard the same axis).
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import (first_in, merge_entry,  # noqa: E402
+                                   shard_noop, shard_same_as)
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn("sequence_softmax")(shard_same_as("X"))
+register_shard_fn("sequence_reverse")(shard_same_as("X", out="Y"))
+register_shard_fn("sequence_unpad", "sequence_pad")(shard_noop())
+
+
+@register_shard_fn("sequence_pool")
+def _sequence_pool_shard(op, ins, attrs):
+    x = first_in(ins, "X")
+    if x.spec is None:
+        return {}
+    # [B, T, D] -> [B, D]: the time dim drops
+    return {"Out": (x.entry(0),) + tuple(x.spec[2:])}
+
+
+@register_shard_fn("lstm")
+def _lstm_shard(op, ins, attrs):
+    x, w = first_in(ins, "Input"), first_in(ins, "Weight")
+    if x.spec is None and w.spec is None:
+        return {}
+    h_entry = merge_entry(x.entry(2), w.entry(1), "lstm gate dim")
+    info = ((x.entry(0), x.entry(1), h_entry))
+    return {"Hidden": info, "Cell": info}
+
+
+@register_shard_fn("gru")
+def _gru_shard(op, ins, attrs):
+    x, w = first_in(ins, "Input"), first_in(ins, "Weight")
+    if x.spec is None and w.spec is None:
+        return {}
+    h_entry = merge_entry(x.entry(2), w.entry(1), "gru gate dim")
+    return {"Hidden": (x.entry(0), x.entry(1), h_entry)}

@@ -716,3 +716,85 @@ def _unstack_shape(op, ins, attrs):
 @register_shape_fn("is_empty")
 def _is_empty_shape(op, ins, attrs):
     return {"Out": VarInfo((), "bool")}
+
+
+# ---------------------------------------------------------------------------
+# Sharding-propagation rules (analysis.shard_prop).  reshape keeps the
+# batch sharding only when the batch dim survives the reshape; transpose
+# permutes entries; concat/split replicate their concat axis (a sharded
+# concat dim would interleave shards).
+# ---------------------------------------------------------------------------
+from ..analysis.shard_prop import (first_in, merge_entry,  # noqa: E402
+                                   shard_batch_only, shard_noop,
+                                   shard_replicated, shard_same_as)
+from ..core.registry import register_shard_fn  # noqa: E402
+
+register_shard_fn("feed", "fetch", "assign", "fill_zeros_like",
+                  "fill_any_like", "shuffle", "scatter", "reverse",
+                  "lod_reset")(shard_same_as("X"))
+register_shard_fn("fill_constant", "gaussian_random", "uniform_random",
+                  "truncated_gaussian_random", "range", "assign_value",
+                  "shape")(shard_replicated("Out"))
+register_shard_fn("is_empty")(shard_noop())
+
+
+@register_shard_fn("reshape")
+def _reshape_shard(op, ins, attrs):
+    x = first_in(ins, "X")
+    if x.spec is None:
+        return {}
+    new_shape = list(attrs.get("shape", []))
+    if not new_shape:
+        return {}
+    keep_batch = new_shape[0] in (-1, 0) or \
+        (x.shape is not None and new_shape[0] == x.shape[0])
+    return {"Out": ((x.entry(0),) if keep_batch else (None,))
+            + (None,) * (len(new_shape) - 1)}
+
+
+@register_shard_fn("transpose")
+def _transpose_shard(op, ins, attrs):
+    x = first_in(ins, "X")
+    perm = attrs.get("axis")
+    if x.spec is None or perm is None:
+        return {}
+    n = len(x.spec)
+    return {"Out": tuple(x.entry(a % n) for a in perm)}
+
+
+@register_shard_fn("concat")
+def _concat_shard(op, ins, attrs):
+    xs = ins.get("X", [])
+    if not any(x.spec is not None for x in xs):
+        return {}
+    nd = next((x.ndim for x in xs if x.ndim is not None), None)
+    if nd is None:
+        return {}
+    axis = attrs.get("axis", 0) % nd
+    entries = []
+    for i in range(nd):
+        if i == axis:
+            entries.append(None)
+            continue
+        e = None
+        for x in xs:
+            e = merge_entry(e, x.entry(i), f"concat operands dim {i}")
+        entries.append(e)
+    return {"Out": tuple(entries)}
+
+
+@register_shard_fn("squeeze", "unsqueeze", "flatten")
+def _rank_change_shard(op, ins, attrs):
+    # conservatively keep only the batch-dim sharding (dim 0 survives all
+    # three ops' lowerings for the axes>=1 cases the layers emit)
+    x = first_in(ins, "X")
+    if x.spec is None:
+        return {}
+    return {"Out": (x.entry(0),)}
+
+
+# index/selection family: batch dim follows X/Ids, everything else
+# replicates (indices are tiny; gather output layout is data-driven)
+register_shard_fn("gather", "one_hot", "top_k", "argmax", "arg_max",
+                  "argsort", "sampling_id", "max_ids")(
+    shard_batch_only("X", fallbacks=("Ids",), also=("Indices",)))

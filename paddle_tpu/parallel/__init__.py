@@ -12,7 +12,8 @@ XLA collectives over a ``jax.sharding.Mesh``:
   sequence/context parallelism incl. ring attention, pipeline and expert
   scaffolds.
 """
-from .mesh import MeshConfig, get_mesh, make_mesh, mesh_guard
+from .mesh import (MeshConfig, get_mesh, make_mesh, mesh_for_axes,
+                   mesh_guard)
 from .collective import (all_gather, all_reduce, broadcast, psum,
                          reduce_scatter, ppermute, barrier)
 from .data_parallel import DataParallel, shard_batch
@@ -30,7 +31,7 @@ from . import local_sgd
 from .local_sgd import make_local_sgd_step
 
 __all__ = [
-    "MeshConfig", "get_mesh", "make_mesh", "mesh_guard",
+    "MeshConfig", "get_mesh", "make_mesh", "mesh_for_axes", "mesh_guard",
     "all_gather", "all_reduce", "broadcast", "psum", "reduce_scatter",
     "ppermute", "barrier", "DataParallel", "shard_batch",
     "column_parallel_spec", "row_parallel_spec", "shard_params",

@@ -76,6 +76,23 @@ def make_hybrid_mesh(ici_config: MeshConfig, dcn_dp: int = 1,
     return Mesh(dev, axis_names=tuple(names))
 
 
+def mesh_for_axes(axes, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh from an ``{axis: size}`` dict over the first
+    ``prod(sizes)`` local devices, with a readable error when the host
+    has too few — the shared entry for `train(auto_shard="dp=8")` and
+    ``bench.py --mesh``."""
+    axes = {str(k): int(v) for k, v in dict(axes).items()}
+    n = int(np.prod(list(axes.values()))) if axes else 1
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n:
+        raise RuntimeError(
+            f"mesh {axes} needs {n} devices, have {len(devices)} "
+            f"(simulate with XLA_FLAGS=--xla_force_host_platform_"
+            f"device_count={n} on the cpu platform)")
+    return make_mesh(shape=list(axes.values()),
+                     axis_names=list(axes.keys()), devices=devices[:n])
+
+
 def get_mesh() -> Mesh:
     """The ambient mesh (set with mesh_guard), defaulting to a 1-D 'dp' mesh
     over all local devices."""

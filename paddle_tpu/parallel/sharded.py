@@ -43,7 +43,8 @@ class ShardedExecutor(Executor):
     def __init__(self, mesh: Optional[Mesh] = None, batch_axis: str = "dp",
                  feed_specs: Optional[Dict[str, P]] = None,
                  param_specs: Optional[Dict[str, P]] = None,
-                 num_microbatches: Optional[int] = None, **kw):
+                 num_microbatches: Optional[int] = None,
+                 auto_shard: bool = False, **kw):
         super().__init__(**kw)
         self.mesh = mesh or get_mesh()
         self.batch_axis = batch_axis
@@ -52,6 +53,37 @@ class ShardedExecutor(Executor):
         # GPipe microbatch count for pipeline_stage-annotated programs
         # (parallel/pipeline_program.py); default = the 'pp' axis size
         self.num_microbatches = num_microbatches
+        # auto_shard=True: when BOTH spec dicts are omitted, the static
+        # auto-sharding planner (analysis.planner) proposes them from the
+        # first program that carries feeds — the plan is validated against
+        # the PT030/PT031 lints before a single trace happens
+        self.auto_shard = auto_shard
+        self.auto_plan = None
+
+    def _ensure_auto_plan(self, program: Optional[Program]):
+        """Plan once, on the first fed program (the startup program has no
+        feeds and carries no information the planner wants)."""
+        if not self.auto_shard or self.auto_plan is not None:
+            return
+        if program is None:
+            from ..core.program import default_main_program
+            program = default_main_program()
+        if self.param_specs or self.feed_specs:
+            # explicit specs win — auto_shard only fills an omission
+            self.auto_plan = False
+            return
+        if not any(v.is_data for b in program.blocks
+                   for v in b.vars.values()):
+            return
+        from ..analysis import planner
+        mesh_axes = {str(a): int(self.mesh.shape[a])
+                     for a in self.mesh.axis_names}
+        plan = planner.plan(program, mesh_axes,
+                            batch_axis=self.batch_axis)
+        param_specs, feed_specs = plan.as_partition_specs()
+        self.param_specs.update(param_specs)
+        self.feed_specs.update(feed_specs)
+        self.auto_plan = plan
 
     def _validation_context(self):
         # the static verifier's sharding lints (PT030/PT031) check
@@ -103,17 +135,20 @@ class ShardedExecutor(Executor):
     # -- overrides ----------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed=None,
             fetch_list=None, **kw):
+        self._ensure_auto_plan(program)
         with self.mesh:
             return super().run(program, feed=feed, fetch_list=fetch_list,
                                **kw)
 
     def run_steps(self, num_steps, program=None, feed=None, **kw):
+        self._ensure_auto_plan(program)
         with self.mesh:
             return super().run_steps(num_steps, program, feed=feed, **kw)
 
-    def compile(self, *args, **kw):
+    def compile(self, program=None, *args, **kw):
+        self._ensure_auto_plan(program)
         with self.mesh:
-            return super().compile(*args, **kw)
+            return super().compile(program, *args, **kw)
 
     def _fingerprint_extras(self, program: Program):
         """Mesh + sharding-spec fingerprint components: the same program/

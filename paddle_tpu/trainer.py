@@ -75,6 +75,7 @@ class SGD:
               feeding=None, feed_list: Optional[Sequence[Variable]] = None,
               steps_per_dispatch: int = 1, pipeline=False,
               warmup: bool = False, validate: Optional[bool] = None,
+              auto_shard=None,
               checkpoint_dir: Optional[str] = None, resume: bool = False,
               save_every_n_steps: Optional[int] = None, master=None,
               handle_signals: bool = True):
@@ -120,6 +121,17 @@ class SGD:
         applies to this call only — the executor's own setting is
         restored afterwards.
 
+        ``auto_shard`` turns on the static auto-sharding planner
+        (``paddle_tpu.analysis.planner``): when the executor's
+        ``param_specs``/``feed_specs`` are omitted, a plan proposed for
+        its mesh (validated by the PT030/PT031 lints) fills them before
+        the first trace.  ``True`` requires the trainer's executor to
+        already be a ``ShardedExecutor``; a ``{'dp': 8}`` dict or a
+        ``"dp=8,tp=2"`` string additionally builds the mesh over the
+        local devices and swaps the trainer onto a
+        ``ShardedExecutor(auto_shard=True)`` (only before the first
+        ``train()`` call — the swap must precede parameter init).
+
         ``checkpoint_dir`` turns on the fault-tolerant runtime
         (``paddle_tpu.train_state``): every ``save_every_n_steps``
         completed batches a checkpoint of the full scope PLUS the loop's
@@ -157,6 +169,8 @@ class SGD:
                 raise ValueError("train(master=...) snapshots the task "
                                  "queue into checkpoints — pass "
                                  "checkpoint_dir")
+        if auto_shard:
+            self._enable_auto_shard(auto_shard)
         # validate is a PER-CALL override: restore the executor's own
         # setting afterwards so a later train() with the default None
         # defers to the flag again
@@ -383,6 +397,53 @@ class SGD:
         return [t / count for t in totals]
 
     # -- helpers -----------------------------------------------------------
+    def _enable_auto_shard(self, auto_shard):
+        """Resolve the train(auto_shard=) forms onto the executor."""
+        from .parallel.sharded import ShardedExecutor
+
+        if isinstance(self.exe, ShardedExecutor):
+            if auto_shard is not True:
+                # a mesh form alongside an existing ShardedExecutor must
+                # AGREE with its mesh — silently planning for the
+                # executor's mesh while the user asked for another would
+                # misreport what ran
+                if isinstance(auto_shard, str):
+                    from .cli import _parse_mesh
+                    want = _parse_mesh(auto_shard)
+                else:
+                    want = {str(k): int(v)
+                            for k, v in dict(auto_shard).items()}
+                have = {str(a): int(self.exe.mesh.shape[a])
+                        for a in self.exe.mesh.axis_names
+                        if self.exe.mesh.shape[a] > 1}
+                if {k: v for k, v in want.items() if v > 1} != have:
+                    raise ValueError(
+                        f"train(auto_shard={auto_shard!r}) conflicts "
+                        f"with the executor's existing mesh {have} — "
+                        f"pass auto_shard=True to plan for that mesh, "
+                        f"or build the trainer without a ShardedExecutor")
+            self.exe.auto_shard = True
+            return
+        if auto_shard is True:
+            raise ValueError(
+                "train(auto_shard=True) needs a ShardedExecutor (its mesh "
+                "is the planning target); pass a mesh instead — "
+                "auto_shard={'dp': 8} or auto_shard='dp=8,tp=2'")
+        if self._initialized:
+            raise ValueError(
+                "train(auto_shard=<mesh>) must be given on the FIRST "
+                "train() call: parameters were already initialized on the "
+                "unsharded executor")
+        if isinstance(auto_shard, str):
+            from .cli import _parse_mesh
+            axes = _parse_mesh(auto_shard)
+        else:
+            axes = {str(k): int(v) for k, v in dict(auto_shard).items()}
+        from .parallel.mesh import mesh_for_axes
+        self.exe = ShardedExecutor(
+            mesh=mesh_for_axes(axes), batch_axis=next(iter(axes), "dp"),
+            auto_shard=True)
+
     @staticmethod
     def _dispatch_k(opts, steps_per_dispatch):
         """Steps per pipelined dispatch — ONE derivation shared by the

@@ -398,7 +398,13 @@ def test_diagnostic_codes_are_frozen():
     assert set(CODES) == {
         "PT001", "PT002", "PT003", "PT004", "PT005", "PT006", "PT007",
         "PT010", "PT011", "PT012", "PT020", "PT021", "PT022",
-        "PT030", "PT031"}
+        "PT030", "PT031", "PT040", "PT041", "PT042"}
+    from paddle_tpu.analysis.diagnostics import ERROR, WARNING
+    # the PT04x family's severities are part of the frozen contract:
+    # double-booked axes are spec errors, propagation findings advise
+    assert CODES["PT040"][0] == ERROR
+    assert CODES["PT041"][0] == WARNING
+    assert CODES["PT042"][0] == WARNING
 
 
 # ---------------------------------------------------------------------------

@@ -128,7 +128,7 @@ def _registered_names(call_name: str):
 
 
 def test_no_duplicate_register_op_names():
-    for call in ("register_op", "register_shape_fn"):
+    for call in ("register_op", "register_shape_fn", "register_shard_fn"):
         by_name = collections.defaultdict(list)
         for name, rel, lineno in _registered_names(call):
             by_name[name].append(f"{rel}:{lineno}")
@@ -260,3 +260,23 @@ def test_registry_matches_ast_scan():
         f"ops registered at runtime but invisible to the AST lint "
         f"(dynamic name construction defeats the duplicate gate): "
         f"{sorted(missing)}")
+
+
+def test_shard_fn_registry_matches_ast_scan():
+    """Same agreement gate for the sharding-propagation rules: every
+    live register_shard_fn name is a string literal the duplicate lint
+    can see, and every rule targets a registered op (a rule for a
+    nonexistent op would never fire — a silent planner blind spot)."""
+    from paddle_tpu.core.registry import (registered_ops,
+                                          registered_shard_fns)
+
+    ast_names = {n for n, _, _ in _registered_names("register_shard_fn")}
+    live = set(registered_shard_fns())
+    missing = live - ast_names
+    assert not missing, (
+        f"shard fns registered at runtime but invisible to the AST lint: "
+        f"{sorted(missing)}")
+    stale = live - set(registered_ops())
+    assert not stale, (
+        f"shard fns for unregistered ops (dead rules): {sorted(stale)}")
+    assert live, "no shard fns registered — the planner has no rules"
