@@ -16,6 +16,7 @@ on-host server without pipelining would see.
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -26,7 +27,14 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import paddle_tpu as pt  # noqa: E402
-from paddle_tpu import layers, models
+from paddle_tpu import layers, models  # noqa: E402
+
+# artifact-loading/feed-synthesis shared with benchmark/serving.py — the
+# deploy-ABI benchmark and the serving benchmark measure ONE model/
+# manifest path (ISSUE 8 satellite: no drift between the two)
+from benchmark.serving_common import (closed_loop,  # noqa: E402
+                                      feeds_from_manifest, load_artifact,
+                                      percentile, single_example)
 
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "inference_results.json")
@@ -122,7 +130,9 @@ def _time_device_scan(run, feeds, out_count_per_call, est_call_s,
             "device_spread_pct": 100.0 * (max(times) - min(times)) / med}
 
 
-def bench_resnet50(batches=(1, 4, 16, 64, 128), tmpdir="/tmp/pt_infer_resnet"):
+def export_resnet50(tmpdir="/tmp/pt_infer_resnet"):
+    """Export the ResNet-50 inference artifact (shared by the throughput
+    benches below and by ``--server`` mode)."""
     pt.core.reset_default_programs()
     pt.core.reset_global_scope()
     pt.unique_name.reset()
@@ -134,11 +144,15 @@ def bench_resnet50(batches=(1, 4, 16, 64, 128), tmpdir="/tmp/pt_infer_resnet"):
                              [pred])
     pt.core.reset_default_programs()
     pt.core.reset_global_scope()
-    run, _ = pt.load_compiled_model(tmpdir)
+    return tmpdir
+
+
+def bench_resnet50(batches=(1, 4, 16, 64, 128), tmpdir="/tmp/pt_infer_resnet"):
+    run, manifest = load_artifact(export_resnet50(tmpdir))
     rows = {}
     rng = np.random.RandomState(0)
     for b in batches:
-        feeds = {"img": rng.rand(b, 3, 224, 224).astype("float32")}
+        feeds = feeds_from_manifest(manifest, b, rng)
         r = _time_pipelined(run, feeds, out_count_per_call=b)
         r.update(_time_device_scan(run, feeds, out_count_per_call=b,
                                    est_call_s=r["per_call_s"]))
@@ -180,20 +194,99 @@ def bench_seq2seq_decode(batches=(1, 16, 64), tmpdir="/tmp/pt_infer_s2s"):
     return rows
 
 
-def main(which=("resnet50", "seq2seq")):
+def bench_server(tmpdir="/tmp/pt_infer_resnet", duration_s=4.0,
+                 workers=32, max_batch=16, max_wait_ms=5.0,
+                 model_name="resnet50"):
+    """``--server`` mode: drive the SAME exported artifact through the
+    serving runtime (paddle_tpu.serving.Server) instead of raw
+    ``load_compiled_model`` calls — the deploy-ABI benchmark and the
+    serving benchmark share one model/manifest path, and this row is the
+    server-mediated counterpart of the raw per-call rows above (the
+    delta is the batching/admission layer's cost and win)."""
+    from paddle_tpu.serving import Model, Server
+    from paddle_tpu.serving.server import _buckets
+
+    if not os.path.exists(os.path.join(tmpdir, "manifest.json")):
+        if model_name != "resnet50":
+            raise SystemExit(f"--artifact {tmpdir!r}: no manifest.json")
+        export_resnet50(tmpdir)
+    _, manifest = load_artifact(tmpdir)
+    rng = np.random.RandomState(0)
+    example = single_example(manifest, rng)
+
+    # warm EVERY bucket (same fidelity rule as benchmark/serving.py's
+    # _make_server): a mid-window compile would smear seconds of one-off
+    # cost into the p50/p99 this row is compared on
+    srv = Server(max_batch=max_batch, max_wait_ms=max_wait_ms,
+                 deadline_ms=None, queue_capacity=max(256, 4 * workers),
+                 warmup_buckets=_buckets(max_batch))
+    srv.add_model(Model.from_artifact(tmpdir, name=model_name))
+    srv.start()
+    try:
+        lat, loop_row = closed_loop(srv, example, workers=workers,
+                                    duration_s=duration_s)
+        health = srv.health()["models"][model_name]
+    finally:
+        srv.shutdown(drain=True)
+    lat_ms = [v * 1e3 for v in lat]
+    row = {
+        "model": model_name, "artifact": tmpdir,
+        "max_batch": max_batch, "max_wait_ms": max_wait_ms,
+        **loop_row,
+        "latency_ms_p50": round(percentile(lat_ms, 0.50), 2)
+        if lat_ms else None,
+        "latency_ms_p99": round(percentile(lat_ms, 0.99), 2)
+        if lat_ms else None,
+        "batches": health["batches"],
+        "mean_batch": round(health["served"] / health["batches"], 2)
+        if health["batches"] else None,
+    }
+    print(json.dumps({"server": row}), flush=True)
+    return row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="deploy-ABI inference "
+                                 "benchmark (see module docstring)")
+    ap.add_argument("which", nargs="*", default=["resnet50", "seq2seq"],
+                    help="benches to run (resnet50, seq2seq)")
+    ap.add_argument("--server", action="store_true",
+                    help="drive the exported artifact through the "
+                         "serving runtime (paddle_tpu serve engine) "
+                         "instead of raw artifact calls")
+    ap.add_argument("--duration-s", type=float, default=4.0)
+    ap.add_argument("--workers", type=int, default=32)
+    ap.add_argument("--max-batch", type=int, default=16)
+    ap.add_argument("--artifact", default=None,
+                    help="serve this exported dir instead of the "
+                         "resnet50 artifact (--server mode only)")
+    args = ap.parse_args(argv)
+
     import jax
     results = {"device": str(jax.devices()[0])}
-    if os.path.exists(OUT):                 # merge partial runs
-        with open(OUT) as f:
-            results.update(json.load(f))
-    if "resnet50" in which:
-        results["resnet50"] = bench_resnet50()
-    if "seq2seq" in which:
-        results["seq2seq_beam4"] = bench_seq2seq_decode()
+    if os.path.exists(OUT):                 # merge partial runs (keeps
+        with open(OUT) as f:                # the committed rows' device
+            results.update(json.load(f))    # provenance intact)
+    if args.server:
+        kw = {}
+        if args.artifact:
+            kw = {"tmpdir": args.artifact,
+                  "model_name": os.path.basename(
+                      os.path.normpath(args.artifact))}
+        results["server"] = {
+            "device": str(jax.devices()[0]),
+            **bench_server(duration_s=args.duration_s,
+                           workers=args.workers,
+                           max_batch=args.max_batch, **kw)}
+    else:
+        if "resnet50" in args.which:
+            results["resnet50"] = bench_resnet50()
+        if "seq2seq" in args.which:
+            results["seq2seq_beam4"] = bench_seq2seq_decode()
     with open(OUT, "w") as f:
         json.dump(results, f, indent=1)
     print(f"wrote {OUT}")
 
 
 if __name__ == "__main__":
-    main(tuple(sys.argv[1:]) or ("resnet50", "seq2seq"))
+    main()

@@ -1,0 +1,303 @@
+#!/usr/bin/env python
+"""Serving-runtime load benchmark: throughput-vs-latency, shedding vs
+collapse (ISSUE 8 acceptance; ROADMAP item 1's load-generator gate).
+
+Two generators over the in-process :class:`paddle_tpu.serving.Server`
+on an exported MLP artifact (the deploy-ABI path, shared with
+benchmark/inference.py via benchmark/serving_common.py):
+
+* **closed loop** — C worker threads submit back-to-back; measures
+  saturation capacity (req/s) with batching at work.
+* **open loop** — a tick generator offers load at a FIXED rate
+  (fractions/multiples of measured capacity), which is what real traffic
+  does: arrival rate does not slow down because the server is behind.
+  Per-arm rows record offered/admitted/served rates, admitted-request
+  latency p50/p99, shed + deadline-expired counts.
+
+The demonstration row pair (acceptance): at 2x offered overload the
+SHEDDING arm's admitted p99 stays within 2x of the 1x arm's p99 —
+admission control bounds queue wait at queue_capacity/throughput — while
+the CONTROL arm (no shedding, unbounded queue, no deadlines) shows the
+collapse: queue depth grows without bound for the whole run and admitted
+p99 blows up to seconds (every request eventually "succeeds", far past
+any useful deadline).
+
+CPU rows are REAL in-container measurements (this box is ~1 effective
+core — see RESULTS.md round 7 — so absolute capacity is small; the
+CURVES are the result).  TPU rows follow the PR 1 pending-hardware-stub
+convention: run ``python benchmark/serving.py`` on a chip host and
+commit the filled rows.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from benchmark.serving_common import (closed_loop, export_mlp,  # noqa: E402
+                                      load_artifact, percentile,
+                                      single_example)
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "serving_results.json")
+
+
+class _Collector:
+    """Thread-safe terminal-outcome recorder for open-loop arms."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latency_ms = []          # admitted AND served
+        self.errors = {}              # typed error name -> count
+        self.shed_at_admission = 0
+
+    def cb(self, pending):
+        ms = (time.monotonic() - pending.t_admit) * 1e3
+        with self.lock:
+            if pending.error is None:
+                self.latency_ms.append(ms)
+            else:
+                name = type(pending.error).__name__
+                self.errors[name] = self.errors.get(name, 0) + 1
+
+    def note_admission_reject(self, exc):
+        with self.lock:
+            name = type(exc).__name__
+            self.errors[name] = self.errors.get(name, 0) + 1
+            self.shed_at_admission += 1
+
+
+def _make_server(model_dir, *, shed, queue, deadline_ms, max_batch,
+                 max_wait_ms):
+    from paddle_tpu.serving import Model, Server
+    from paddle_tpu.serving.server import _buckets
+    # warm EVERY bucket: the arms measure steady-state queueing, and a
+    # mid-arm compile would smear seconds of one-off cost into the
+    # latency distribution (the runtime itself tags those cold)
+    srv = Server(max_batch=max_batch, max_wait_ms=max_wait_ms,
+                 deadline_ms=deadline_ms, queue_capacity=queue, shed=shed,
+                 warmup_buckets=_buckets(max_batch))
+    srv.add_model(Model.from_artifact(model_dir, name="mlp"))
+    srv.start()
+    return srv
+
+
+def closed_loop_capacity(model_dir, example, *, workers, duration_s,
+                         max_batch, max_wait_ms):
+    """Saturation req/s: C workers, back-to-back sync infers (shared
+    generator: serving_common.closed_loop)."""
+    srv = _make_server(model_dir, shed=True, queue=max(256, 4 * workers),
+                      deadline_ms=None, max_batch=max_batch,
+                      max_wait_ms=max_wait_ms)
+    try:
+        _lat, row = closed_loop(srv, example, workers=workers,
+                                duration_s=duration_s)
+    finally:
+        srv.shutdown(drain=True)
+    return row
+
+
+def open_loop_arm(model_dir, example, *, rate, duration_s, shed, queue,
+                  deadline_ms, max_batch, max_wait_ms, tick_s=0.005,
+                  label="", sample_queue=False):
+    """Offer `rate` req/s for `duration_s`; return the arm's row."""
+    from paddle_tpu import faults
+    srv = _make_server(model_dir, shed=shed, queue=queue,
+                      deadline_ms=deadline_ms, max_batch=max_batch,
+                      max_wait_ms=max_wait_ms)
+    col = _Collector()
+    offered = 0
+    queue_samples = []
+    t0 = time.monotonic()
+    next_sample = t0
+    end = t0 + duration_s
+    while True:
+        now = time.monotonic()
+        if now >= end:
+            break
+        # offer every request whose arrival time has passed (burst ticks:
+        # open-loop arrivals never slow down with the server)
+        due = int((now - t0) * rate) - offered
+        for _ in range(due):
+            offered += 1
+            try:
+                pending = srv.submit(example, deadline_ms=deadline_ms)
+            except (faults.Overloaded, faults.ServerClosed) as e:
+                col.note_admission_reject(e)
+                continue
+            pending.add_done_callback(col.cb)
+        if sample_queue and now >= next_sample:
+            queue_samples.append(
+                (round(now - t0, 2),
+                 srv.health()["models"]["mlp"]["queue_depth"]))
+            next_sample = now + 0.5
+        time.sleep(tick_s)
+    gen_wall = time.monotonic() - t0
+    pending_at_stop = srv.health()["models"]["mlp"]["queue_depth"]
+    if sample_queue:
+        queue_samples.append((round(gen_wall, 2), pending_at_stop))
+    # control arm: do NOT drain the unbounded backlog through the model
+    # (it would take rate/capacity * duration longer); abort it and let
+    # the completed set speak.  Shedding arms drain in bounded time.
+    srv.shutdown(drain=shed, timeout=60)
+    with col.lock:
+        lat = sorted(col.latency_ms)
+        errors = dict(col.errors)
+    served = len(lat)
+    row = {
+        "label": label, "offered_per_s": rate,
+        "duration_s": round(gen_wall, 3), "offered": offered,
+        "served": served,
+        "served_per_s": round(served / gen_wall, 1),
+        "latency_ms_p50": round(percentile(lat, 0.50), 2) if lat else None,
+        "latency_ms_p90": round(percentile(lat, 0.90), 2) if lat else None,
+        "latency_ms_p99": round(percentile(lat, 0.99), 2) if lat else None,
+        "errors": errors,
+        "shed": errors.get("Overloaded", 0),
+        "deadline_expired": errors.get("DeadlineExceeded", 0),
+        "shed_rate": round(errors.get("Overloaded", 0) / offered, 4)
+        if offered else None,
+        "config": {"shed": shed, "queue": queue,
+                   "deadline_ms": deadline_ms, "max_batch": max_batch,
+                   "max_wait_ms": max_wait_ms},
+    }
+    if sample_queue:
+        row["queue_depth_samples"] = queue_samples
+        row["pending_at_stop"] = pending_at_stop
+        row["aborted_at_stop"] = errors.get("ServerClosed", 0)
+    return row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny durations (CI smoke, numbers meaningless)")
+    ap.add_argument("--duration-s", type=float, default=4.0)
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--queue", type=int, default=32,
+                    help="admission queue capacity (the shed arms' "
+                         "latency bound is ~queue/throughput)")
+    ap.add_argument("--deadline-ms", type=float, default=1000.0)
+    ap.add_argument("--workers", type=int, default=64,
+                    help="closed-loop capacity-probe concurrency")
+    ap.add_argument("--out", default=OUT)
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.duration_s = 1.0
+        args.workers = 16
+
+    import jax
+
+    model_dir = export_mlp("/tmp/pt_serving_bench_mlp")
+    _, manifest = load_artifact(model_dir)
+    rng = np.random.RandomState(0)
+    example = single_example(manifest, rng)
+
+    print(json.dumps({"phase": "capacity_probe"}), flush=True)
+    cap = closed_loop_capacity(
+        model_dir, example, workers=args.workers,
+        duration_s=args.duration_s, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms)
+    print(json.dumps({"closed_loop": cap}), flush=True)
+    # The closed loop UNDERESTIMATES capacity (workers wait out their own
+    # round trips, so batches under-fill); saturation throughput under
+    # heavy open-loop overload is the honest "1x" anchor — offered load
+    # factors are relative to what the server can actually serve.
+    sat = open_loop_arm(
+        model_dir, example, rate=max(1.0, cap["req_per_s"] * 4.0),
+        duration_s=args.duration_s, shed=True, queue=args.queue,
+        deadline_ms=args.deadline_ms, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, label="saturation_probe")
+    print(json.dumps({"saturation_probe": sat}), flush=True)
+    capacity = max(cap["req_per_s"], sat["served_per_s"])
+
+    arms = []
+    for factor in (0.25, 0.5, 1.0, 2.0, 4.0):
+        rate = max(1.0, capacity * factor)
+        row = open_loop_arm(
+            model_dir, example, rate=rate, duration_s=args.duration_s,
+            shed=True, queue=args.queue, deadline_ms=args.deadline_ms,
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            label=f"{factor:g}x_shed")
+        row["load_factor"] = factor
+        arms.append(row)
+        print(json.dumps({"open_loop": row}), flush=True)
+
+    # Control anchor: the FASTEST service rate demonstrated anywhere so
+    # far (this ~1-core box's throughput swings 2-3x with neighbors; an
+    # early low probe would leave the "overload" control under-loaded).
+    # If the box speeds up mid-run and the queue still doesn't grow,
+    # escalate the offered multiple until it demonstrably does.
+    anchor = max([capacity] + [a["served_per_s"] for a in arms])
+    control = None
+    for mult in (2.0, 3.0, 4.0):
+        control = open_loop_arm(
+            model_dir, example, rate=max(1.0, anchor * mult),
+            duration_s=args.duration_s, shed=False, queue=None,
+            deadline_ms=None, max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            label=f"{mult:g}x_control_no_shedding", sample_queue=True)
+        control["load_factor"] = mult
+        print(json.dumps({"open_loop": control}), flush=True)
+        if control["pending_at_stop"] >= 4 * args.max_batch:
+            break
+        print(json.dumps({"note": "control arm not overloaded (box sped "
+                          "up mid-run); escalating offered load"}),
+              flush=True)
+
+    p99_1x = next(a["latency_ms_p99"] for a in arms
+                  if a["load_factor"] == 1.0)
+    p99_2x = next(a["latency_ms_p99"] for a in arms
+                  if a["load_factor"] == 2.0)
+    # an arm that served nothing (every request shed/expired on a slow
+    # enough box) reports p99 None — the acceptance fields must degrade
+    # to None/False, not TypeError after every row was measured
+    acceptance = {
+        "p99_1x_ms": p99_1x, "p99_2x_shed_ms": p99_2x,
+        "p99_2x_control_ms": control["latency_ms_p99"],
+        "p99_ratio_2x_over_1x": round(p99_2x / p99_1x, 3)
+        if p99_1x and p99_2x is not None else None,
+        "bounded_under_overload": bool(
+            p99_1x and p99_2x is not None and p99_2x <= 2.0 * p99_1x),
+        "control_collapse_factor": round(
+            control["latency_ms_p99"] / p99_1x, 1)
+        if p99_1x and control["latency_ms_p99"] else None,
+    }
+    print(json.dumps({"acceptance": acceptance}), flush=True)
+
+    results = {
+        "engine": "in-process Server over exported StableHLO artifact "
+                  "(benchmark/serving_common.export_mlp 784-2048x3-10)",
+        "device": str(jax.devices()[0]),
+        "note": "CPU in-container rows; ~1 effective host core "
+                "(RESULTS.md round 7) bounds absolute capacity — the "
+                "shed-vs-control CURVES are the result",
+        "closed_loop": cap,
+        "saturation_probe": sat,
+        "capacity_req_per_s": capacity,
+        "open_loop": arms,
+        "control": control,
+        "acceptance": acceptance,
+        "tpu": {"status": "pending hardware",
+                "note": "re-run python benchmark/serving.py on a chip "
+                        "host and commit the filled rows (PR 1 stub "
+                        "convention)", "rows": []},
+    }
+    if not args.smoke:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,145 @@
+"""Shared deploy-artifact helpers for the serving + inference benchmarks.
+
+One model/manifest path for both: ``benchmark/inference.py`` (deploy-ABI
+throughput, ``--server`` mode) and ``benchmark/serving.py`` (load
+generator) export with :func:`export_mlp` / the inference benches'
+exporters, then load through :func:`load_artifact` and synthesize wire
+feeds with :func:`feeds_from_manifest` — so the two benchmarks can never
+drift onto different artifact conventions.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def export_mlp(dirname: str, in_dim: int = 784, hidden=(2048, 2048, 2048),
+               classes: int = 10, seed: int = 0) -> str:
+    """Export a dense classifier MLP as a symbolic-batch StableHLO
+    artifact (the serving benchmark's standard tenant: heavy enough that
+    CPU capacity is a few hundred req/s, so an open-loop Python load
+    generator can genuinely overload it)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    pt.unique_name.reset()
+    x = layers.data("x", shape=[in_dim], dtype="float32")
+    h = x
+    for width in hidden:
+        h = layers.fc(h, size=width, act="relu")
+    pred = layers.fc(h, size=classes, act="softmax")
+    pt.default_main_program().random_seed = seed
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    pt.export_compiled_model(dirname, {"x": ((-1, in_dim), "float32")},
+                             [pred])
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    return dirname
+
+
+def load_artifact(dirname: str):
+    """(run, manifest) for an exported artifact — the deploy-ABI binding
+    both benchmarks measure through."""
+    import paddle_tpu as pt
+    return pt.load_compiled_model(dirname)
+
+
+def feeds_from_manifest(manifest: dict, batch: int, rng,
+                        int_high: int = 2):
+    """Synthesize a stacked feed dict from an artifact manifest's input
+    specs: floats U(0,1), ints U(0, int_high) — the generic fake-data
+    provider for any exported model."""
+    feeds = {}
+    for name, spec in manifest["inputs"].items():
+        shape = list(spec["shape"])
+        if shape and (shape[0] is None or int(shape[0]) < 0):
+            # symbolic batch: instantiate at the requested size
+            shape = [batch] + [int(d) for d in shape[1:]]
+        else:
+            # fixed-shape input: serve it as exported
+            shape = [int(d) for d in shape]
+        dtype = np.dtype(spec["dtype"])
+        if dtype.kind in "iu":
+            feeds[name] = rng.randint(0, int_high, shape).astype(dtype)
+        else:
+            feeds[name] = rng.rand(*shape).astype(dtype)
+    return feeds
+
+
+def single_example(manifest: dict, rng, int_high: int = 2):
+    """One per-request example (no batch axis) from a manifest.
+
+    Serving submits per-example feeds, so every input must carry a
+    SYMBOLIC leading batch dim — a fixed-shape input has no batch axis
+    to strip, and silently dropping its first real dim would feed the
+    server mis-shaped examples."""
+    for name, spec in manifest["inputs"].items():
+        shape = list(spec["shape"])
+        if not shape or not (shape[0] is None or int(shape[0]) < 0):
+            raise ValueError(
+                f"artifact input {name!r} has fixed shape {shape}; "
+                f"serving needs a symbolic batch dim (export with a "
+                f"-1/None leading dim)")
+    stacked = feeds_from_manifest(manifest, 1, rng, int_high=int_high)
+    return {k: v[0] for k, v in stacked.items()}
+
+
+def closed_loop(srv, example, *, workers: int, duration_s: float,
+                timeout_s: float = 120.0):
+    """Closed-loop load shared by both benchmarks: N worker threads
+    issue back-to-back sync infers against an already-started server
+    for ``duration_s``.  Returns ``(sorted_latencies_s, row)``; worker
+    exceptions are counted (not silently fatal to the thread) and a
+    zero-served run raises loudly instead of yielding a garbage row."""
+    import threading
+    import time
+
+    lat, errors = [], []
+    lock = threading.Lock()
+    stop = time.monotonic() + duration_s
+
+    def worker():
+        while time.monotonic() < stop:
+            t0 = time.monotonic()
+            try:
+                srv.infer(example, deadline_ms=None, timeout=timeout_s)
+            except Exception as e:      # noqa: BLE001 — counted, surfaced
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+            with lock:
+                lat.append(time.monotonic() - t0)
+
+    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    if not lat and errors:
+        raise RuntimeError(
+            f"closed_loop: every worker failed; first error: {errors[0]}")
+    lat.sort()
+    row = {"workers": workers, "duration_s": round(wall, 3),
+           "served": len(lat), "req_per_s": round(len(lat) / wall, 1),
+           "worker_errors": len(errors)}
+    return lat, row
+
+
+def percentile(sorted_vals, q: float):
+    """Shared rank-based percentile over an ASCENDING-sorted list (the
+    one statistic both benchmarks and the serving tests quote — one
+    convention, no drift).  None on empty input."""
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(q * len(sorted_vals)))]

@@ -28,6 +28,12 @@ and the per-device peak-HBM estimate, and ``--out plan.json`` writes a
 plan file that ``check --specs plan.json`` can later re-validate against
 the program — a CI gate needing no Python config import.
 
+``python -m paddle_tpu serve --model dir`` runs the production serving
+runtime (paddle_tpu.serving) over exported StableHLO artifacts: dynamic
+batching with admission control, per-request deadlines, load shedding,
+per-model circuit breaking, and graceful SIGTERM drain — one JSON object
+per line on stdin/stdout (see serving/cli.py for the protocol).
+
 Feeds come from ``--feed-npz`` (named arrays matching the config's data
 layers, with ``name@LEN`` companions for sequences); ``time`` and
 ``checkgrad`` synthesize random feeds from the declared shapes when none
@@ -462,6 +468,11 @@ def main(argv=None):
         return job_plan(argv[1:])
     if argv and argv[0] == "stats":
         return job_stats(argv[1:])
+    if argv and argv[0] == "serve":
+        # lazy: `import paddle_tpu` must never pull the serving package
+        # (zero-cost-when-unused guard, tier-1 enforced)
+        from paddle_tpu.serving.cli import serve_main
+        return serve_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="paddle_tpu",
         description="TrainerMain analog: run a v1 config on the TPU "
@@ -469,9 +480,11 @@ def main(argv=None):
                     "prog.json|__model__|dir` runs the static program "
                     "verifier, `paddle_tpu plan prog.json --mesh dp=8` "
                     "proposes auto-sharding specs with a static cost "
-                    "breakdown, and `paddle_tpu stats run.jsonl` "
-                    "summarizes an observability metrics log (see "
-                    "`paddle_tpu check|plan|stats --help`).")
+                    "breakdown, `paddle_tpu stats run.jsonl` summarizes "
+                    "an observability metrics log, and `paddle_tpu serve "
+                    "--model dir` runs the batching inference server "
+                    "over exported artifacts (see "
+                    "`paddle_tpu check|plan|stats|serve --help`).")
     ap.add_argument("--config", required=True, help="v1 config file")
     ap.add_argument("--job", default="train",
                     choices=["train", "test", "time", "checkgrad"])

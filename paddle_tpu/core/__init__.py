@@ -14,7 +14,7 @@ from . import compile_cache
 from .compile_cache import CompiledProgram, retrace_guard
 from .executor import (
     Executor, Place, CPUPlace, TPUPlace, CUDAPlace,
-    Env, LoweringContext, interpret_ops, run_op, stack_feeds,
+    Env, LoweringContext, interpret_ops, run_op, stack_feeds, pad_batch,
 )
 
 __all__ = [
@@ -27,5 +27,6 @@ __all__ = [
     "Scope", "global_scope", "scope_guard", "reset_global_scope",
     "Executor", "Place", "CPUPlace", "TPUPlace", "CUDAPlace",
     "Env", "LoweringContext", "interpret_ops", "run_op", "stack_feeds",
+    "pad_batch",
     "compile_cache", "CompiledProgram", "retrace_guard",
 ]

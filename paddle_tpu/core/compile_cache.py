@@ -635,6 +635,12 @@ class CompiledProgram:
         self.is_test = is_test
 
     @property
+    def executor(self):
+        """The executor this variant is installed in (the serving runtime
+        dispatches follow-up bucket sizes through it, sharing its cache)."""
+        return self._executor
+
+    @property
     def compile_times(self) -> Dict[str, float]:
         return self._step.times
 

@@ -401,6 +401,40 @@ def stack_feeds(feeds: Sequence[Dict[str, object]]) -> Dict[str, np.ndarray]:
     return {k: np.stack([np.asarray(f[k]) for f in feeds]) for k in keys}
 
 
+def pad_batch(stacked: Dict[str, np.ndarray], to: int) -> Dict[str, np.ndarray]:
+    """Pad every entry of a stacked feed dict (leading batch axis, the
+    :func:`stack_feeds` output form) up to ``to`` rows by repeating the
+    first row.
+
+    The serving batcher uses this to round a coalesced batch up to its
+    bucket size, bounding the number of compiled variants to the bucket
+    list instead of one per observed batch size.  Repeating a REAL row
+    (rather than zero-filling) keeps the pad rows inside the model's
+    input distribution — index inputs stay valid vocab ids and float
+    rows cannot manufacture NaN/Inf paths the live rows never take.
+    Row-wise models (everything servable) make pad rows independent of
+    live rows, which are sliced back out before delivery.
+    """
+    if to < 1:
+        raise ValueError(f"pad_batch: target size must be >= 1, got {to}")
+    out: Dict[str, np.ndarray] = {}
+    for k, v in stacked.items():
+        a = np.asarray(v)
+        if a.ndim < 1:
+            raise ValueError(
+                f"pad_batch: entry {k!r} has no leading batch axis")
+        n = a.shape[0]
+        if n > to:
+            raise ValueError(
+                f"pad_batch: entry {k!r} already has {n} rows > target {to}")
+        if n == to:
+            out[k] = a
+        else:
+            pad = np.broadcast_to(a[:1], (to - n,) + a.shape[1:])
+            out[k] = np.concatenate([a, pad], axis=0)
+    return out
+
+
 def _feed_signature(feed: Dict[str, object]):
     return tuple(sorted(
         (k, tuple(np.shape(v)),

@@ -37,6 +37,7 @@ __all__ = [
     "TransientError", "TransientDispatchError", "InjectedFault",
     "RetriesExhausted", "Preempted", "EXIT_PREEMPTED",
     "classify", "RetryPolicy", "retry_call",
+    "Overloaded", "DeadlineExceeded", "ServerClosed", "ModelUnavailable",
 ]
 
 # Exit status of a training process that was preempted (SIGTERM/SIGINT),
@@ -89,6 +90,42 @@ class Preempted(SystemExit):
         return (f"training preempted at step {self.step}; emergency "
                 f"checkpoint in {self.checkpoint_dir!r} (exit "
                 f"{EXIT_PREEMPTED})")
+
+
+# ---------------------------------------------------------------------------
+# Serving response taxonomy (paddle_tpu.serving).  These live HERE, not in
+# the serving package, so a client can catch every typed rejection without
+# importing the server (the zero-cost-when-unused guard keeps
+# ``import paddle_tpu`` from importing ``paddle_tpu.serving``).
+# ---------------------------------------------------------------------------
+class Overloaded(TransientError):
+    """Admission control rejected the request: the bounded queue was full
+    and load shedding chose it (oldest-deadline-first).  Subclasses
+    :class:`TransientError` — backing off and retrying IS the contract
+    (the server sheds precisely so that retried-later work can succeed
+    with bounded latency instead of the whole queue timing out)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline expired before results could be produced;
+    expired requests are rejected *before* dispatch, never computed.
+    Deliberately NOT transient: re-submitting with the same stale
+    deadline deterministically fails again — the caller must pick a new
+    deadline (or none) to retry."""
+
+
+class ServerClosed(RuntimeError):
+    """The server is draining or stopped: admission is closed.  In-flight
+    admitted requests still complete; new ones belong on another
+    replica."""
+
+
+class ModelUnavailable(RuntimeError):
+    """The per-model circuit breaker is open after repeated fatal
+    dispatch errors: requests to this model fail fast instead of burning
+    queue slots on a poisoned executable.  Deliberately NOT transient —
+    hammering an open breaker defeats its purpose; healthy co-tenant
+    models keep serving."""
 
 
 # ---------------------------------------------------------------------------

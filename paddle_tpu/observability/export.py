@@ -201,6 +201,7 @@ def summarize_log(path: str) -> dict:
     steps: List[dict] = []
     nans: List[dict] = []
     faults: List[dict] = []
+    servings: List[dict] = []
     last_snapshot: Optional[dict] = None
     snapshots = corrupt = total = 0
     t_first = t_last = None
@@ -229,6 +230,8 @@ def summarize_log(path: str) -> dict:
                 nans.append(ev)
             elif kind == "fault":
                 faults.append(ev)
+            elif kind == "serving":
+                servings.append(ev)
 
     summary: dict = {
         "events": total, "corrupt_lines": corrupt,
@@ -299,6 +302,33 @@ def summarize_log(path: str) -> dict:
                           if e.get(k) is not None}
                          for e in faults[:10]],
         }
+    if servings:
+        by_event: Dict[str, int] = {}
+        models = set()
+        batches = [e for e in servings if e.get("event") == "batch"]
+        for e in servings:
+            key = str(e.get("event", "unknown"))
+            by_event[key] = by_event.get(key, 0) + 1
+            if e.get("model"):
+                models.add(str(e["model"]))
+        served = sum(int(e.get("size", 0)) for e in batches)
+        sizes = [int(e.get("size", 0)) for e in batches]
+        dms = sorted(float(e["dispatch_ms"]) for e in batches
+                     if e.get("dispatch_ms") is not None)
+        summary["serving"] = {
+            "events": len(servings), "by_event": by_event,
+            "models": sorted(models),
+            "batches": len(batches), "requests_served": served,
+            "batch_size_mean": round(sum(sizes) / len(sizes), 2)
+            if sizes else None,
+            "dispatch_ms_p50": round(dms[len(dms) // 2], 3)
+            if dms else None,
+            "shed": by_event.get("shed", 0),
+            "deadline_expired": by_event.get("deadline_expired", 0),
+            "breaker_opens": by_event.get("breaker_open", 0),
+            "states": [str(e.get("state")) for e in servings
+                       if e.get("event") == "state"],
+        }
     return summary
 
 
@@ -342,4 +372,18 @@ def render_summary(summary: dict) -> str:
                 f"{k}={e[k]}" for k in ("event", "site", "index", "action",
                                         "step", "attempt", "delay_s",
                                         "error") if k in e))
+    sv = summary.get("serving")
+    if sv:
+        lines.append(
+            f"serving: {sv['requests_served']} request(s) in "
+            f"{sv['batches']} batch(es)"
+            + (f", mean batch {sv['batch_size_mean']}"
+               if sv.get("batch_size_mean") is not None else "")
+            + (f", dispatch p50 {sv['dispatch_ms_p50']} ms"
+               if sv.get("dispatch_ms_p50") is not None else "")
+            + f" [models: {', '.join(sv['models'])}]")
+        lines.append(
+            f"  shed={sv['shed']} deadline_expired={sv['deadline_expired']}"
+            f" breaker_opens={sv['breaker_opens']}"
+            + (f" states={'→'.join(sv['states'])}" if sv["states"] else ""))
     return "\n".join(lines)

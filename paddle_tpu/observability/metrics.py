@@ -91,6 +91,29 @@ METRIC_NAMES = (
      "intact one"),
     ("fault/tasks_returned", "counter",
      "in-flight master tasks handed back before a retry/shutdown"),
+    # serving runtime (paddle_tpu.serving): per-request/per-batch writes
+    # are unconditional — the server IS the instrumented subsystem, and
+    # its metrics are how operators see shedding/deadline behavior; the
+    # zero-overhead-when-off contract covers TRAINING paths, which never
+    # reach these helpers
+    ("serving/requests", "counter",
+     "requests admitted past admission control (a queued request may "
+     "still be shed later under overload)"),
+    ("serving/batches", "counter",
+     "coalesced batches dispatched by the serving runtime"),
+    ("serving/shed", "counter",
+     "requests rejected by load shedding (Overloaded: queue full, "
+     "oldest-deadline-first eviction)"),
+    ("serving/deadline_expired", "counter",
+     "requests whose deadline expired before dispatch (never computed)"),
+    ("serving/breaker_open", "counter",
+     "per-model circuit-breaker open transitions (repeated fatal errors)"),
+    ("serving/queue_depth", "histogram",
+     "admission queue depth sampled as each batch is formed"),
+    ("serving/batch_size", "histogram",
+     "live (unpadded) requests per dispatched serving batch"),
+    ("serving/request_ms", "histogram",
+     "admitted-request latency: admission to completed response"),
 )
 
 _MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
@@ -107,6 +130,9 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
     "executor/stage_put_ms": _MS_BUCKETS,
     "pipeline/queue_depth": _DEPTH_BUCKETS,
     "pipeline/consumer_stall_ms": _MS_BUCKETS,
+    "serving/queue_depth": _DEPTH_BUCKETS,
+    "serving/batch_size": _COUNT_BUCKETS,
+    "serving/request_ms": _MS_BUCKETS,
 }
 _DEFAULT_BUCKETS = _MS_BUCKETS
 

@@ -1,0 +1,38 @@
+"""Production serving runtime: dynamic batching with admission control,
+deadlines, load shedding, circuit breaking, and graceful drain.
+
+The reference framework shipped a dedicated deployment surface
+(``paddle/capi`` — the C inference API) because training machinery is
+the wrong rim for serving; this package is its TPU-native successor,
+built on the substrate the repo already has: AOT ``Executor.compile()``
+/ exported StableHLO artifacts for zero-compile warm start,
+``stack_feeds`` for request coalescing, the observability registry for
+per-request telemetry, and ``faults``/``faultinject`` for the
+degradation paths.
+
+* :class:`~paddle_tpu.serving.model.Model` — one servable tenant
+  (artifact dir, ``CompiledProgram``, or live program).
+* :class:`~paddle_tpu.serving.server.Server` — the multi-tenant server:
+  bounded-queue admission, max-batch/max-wait batching into padded
+  power-of-two buckets, per-request deadlines, oldest-deadline-first
+  load shedding, per-model circuit breaking, warming/ready/draining
+  health states, and graceful drain.
+* ``python -m paddle_tpu serve --model DIR ...`` — the stdio-protocol
+  process form (:mod:`paddle_tpu.serving.cli`): SIGTERM drains and
+  exits 0, composing with ``distributed.supervisor`` for relaunch.
+
+ZERO COST WHEN UNUSED: ``import paddle_tpu`` must never import this
+package (tier-1 pins that, plus byte-identical training-path behavior
+with it loaded).  Typed rejections (``Overloaded``, ``DeadlineExceeded``,
+``ServerClosed``, ``ModelUnavailable``) therefore live in
+:mod:`paddle_tpu.faults`, importable without the server.
+"""
+from ..faults import (DeadlineExceeded, ModelUnavailable, Overloaded,
+                      ServerClosed)
+from .model import Model
+from .server import ModelError, PendingResponse, Server
+
+__all__ = [
+    "Model", "Server", "PendingResponse", "ModelError",
+    "Overloaded", "DeadlineExceeded", "ServerClosed", "ModelUnavailable",
+]

@@ -1,0 +1,172 @@
+"""Servable model handles: one calling convention over every deploy path.
+
+A :class:`Model` is (name, infer fn, input specs): the fn takes ONE
+stacked feed dict ``{name: array[B, ...]}`` and returns the output list
+``[array[B, ...], ...]`` — exactly the row-wise batch contract the
+server's batcher needs to coalesce independent requests.  Three
+constructors cover the substrate the repo already ships:
+
+* :meth:`Model.from_artifact` — an ``export_compiled_model`` directory
+  (serialized StableHLO + manifest, the deploy ABI).  The deserialized
+  ``Exported.call`` is wrapped in ``jax.jit`` so each batch bucket
+  compiles once and then replays — the symbolic-batch artifact serves
+  every bucket from one file.
+* :meth:`Model.from_compiled` — an AOT :class:`CompiledProgram` from
+  ``Executor.compile()``: the pre-compiled variant serves its own batch
+  size with zero compiles; other buckets route through the same
+  executor's content-fingerprinted cache (and its persistent layer, so
+  a warmed cache dir makes every bucket a zero-compile start).
+* :meth:`Model.from_program` — a live (executor, program, fetch_list,
+  scope), for in-process serving and tests.
+
+``example`` (a single-example feed dict, no batch axis) drives server
+warmup; artifact manifests synthesize one automatically from their
+declared input shapes.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Model"]
+
+
+def _example_from_specs(specs: Dict[str, dict]) -> Optional[Dict[str, np.ndarray]]:
+    """Single-example feeds from manifest input specs ({name: {shape,
+    dtype}}); None when any non-batch dim is symbolic/unknown."""
+    out: Dict[str, np.ndarray] = {}
+    for name, spec in specs.items():
+        shape = list(spec["shape"])
+        if shape and shape[0] in (None, -1):
+            shape = shape[1:]
+        if any(d is None or int(d) < 0 for d in shape):
+            return None
+        dtype = np.dtype(spec["dtype"])
+        if dtype.kind in "iu":
+            out[name] = np.zeros(tuple(int(d) for d in shape), dtype)
+        else:
+            out[name] = np.full(tuple(int(d) for d in shape), 0.5, dtype)
+    return out
+
+
+class Model:
+    """One servable tenant: a batched infer fn plus its calling
+    convention.  ``fn({name: [B, ...]}) -> [out[B, ...], ...]`` must be
+    row-wise (row i of every output depends only on row i of the feeds)
+    — that is what makes coalescing and pad-row slicing correct."""
+
+    def __init__(self, name: str, fn: Callable, *,
+                 input_specs: Optional[Dict[str, dict]] = None,
+                 output_names: Optional[Sequence[str]] = None,
+                 example: Optional[Dict[str, np.ndarray]] = None):
+        if not name:
+            raise ValueError("Model: name must be non-empty")
+        self.name = str(name)
+        self._fn = fn
+        self.input_specs = dict(input_specs or {})
+        self.output_names = list(output_names or [])
+        if example is None and self.input_specs:
+            example = _example_from_specs(self.input_specs)
+        self.example = example
+
+    def __call__(self, feeds_stacked: Dict[str, np.ndarray]) -> List:
+        return self._fn(feeds_stacked)
+
+    def coerce_feeds(self, feeds: Dict[str, object]) -> Dict[str, np.ndarray]:
+        """One request's feeds (wire form: nested lists/arrays, no batch
+        axis) -> arrays with declared dtypes.
+
+        When the model carries input specs (artifact manifests do), an
+        unknown, MISSING, or mis-shaped input raises here — at the
+        ADMISSION rim, as a per-request rejection.  Letting it through
+        would surface at dispatch as a fatal batch error and feed the
+        model's circuit breaker: one malformed client could open the
+        breaker and take the tenant down for everyone."""
+        out: Dict[str, np.ndarray] = {}
+        for k, v in feeds.items():
+            spec = self.input_specs.get(k)
+            if self.input_specs and spec is None:
+                raise ValueError(
+                    f"model {self.name!r} has no input {k!r} "
+                    f"(inputs: {sorted(self.input_specs)})")
+            dtype = np.dtype(spec["dtype"]) if spec else None
+            arr = np.asarray(v, dtype=dtype)
+            if spec is not None:
+                shape = list(spec["shape"])
+                if shape and (shape[0] is None or int(shape[0]) < 0):
+                    shape = shape[1:]        # per-example: drop batch dim
+                want = tuple(None if d is None or int(d) < 0 else int(d)
+                             for d in shape)
+                ok = len(arr.shape) == len(want) and all(
+                    w is None or a == w for a, w in zip(arr.shape, want))
+                if not ok:
+                    raise ValueError(
+                        f"model {self.name!r} input {k!r}: example shape "
+                        f"{arr.shape} does not match declared {want}")
+            out[k] = arr
+        if self.input_specs:
+            missing = sorted(set(self.input_specs) - set(out))
+            if missing:
+                raise ValueError(
+                    f"model {self.name!r}: missing inputs {missing}")
+        return out
+
+    def __repr__(self):
+        return (f"Model({self.name!r}, inputs={sorted(self.input_specs)}, "
+                f"outputs={self.output_names})")
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def from_artifact(cls, dirname: str, name: Optional[str] = None):
+        """Load an ``export_compiled_model`` directory (the deploy ABI).
+        The symbolic-batch StableHLO artifact serves every bucket; the
+        ``jax.jit`` wrapper caches one executable per concrete bucket
+        shape."""
+        import jax
+
+        from ..export_model import load_compiled_model
+
+        run, manifest = load_compiled_model(dirname)
+        name = name or os.path.basename(os.path.normpath(dirname))
+        jrun = jax.jit(run)
+
+        def fn(feeds):
+            return list(jrun(feeds))
+
+        return cls(name, fn, input_specs=manifest.get("inputs"),
+                   output_names=manifest.get("outputs"))
+
+    @classmethod
+    def from_compiled(cls, compiled, name: Optional[str] = None,
+                      scope=None,
+                      example: Optional[Dict[str, np.ndarray]] = None):
+        """Wrap an AOT :class:`~paddle_tpu.core.compile_cache.
+        CompiledProgram`: its pre-compiled bucket is free; other buckets
+        go through the owning executor's cache on the same program."""
+        return cls.from_program(
+            compiled.executor, compiled.program, compiled.fetch_names,
+            scope=scope, name=name, is_test=compiled.is_test,
+            example=example)
+
+    @classmethod
+    def from_program(cls, executor, program, fetch_list, scope=None,
+                     name: Optional[str] = None, is_test: bool = True,
+                     example: Optional[Dict[str, np.ndarray]] = None):
+        """Serve a live Program through ``executor.run`` (one compiled
+        variant per bucket, shared content-fingerprinted cache)."""
+        from ..core.program import Variable
+
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_list]
+        name = name or f"program-{id(program):x}"
+
+        def fn(feeds):
+            return executor.run(program, feed=feeds,
+                                fetch_list=fetch_names, scope=scope,
+                                return_numpy=False, is_test=is_test)
+
+        # no input_specs: executor.run already coerces feeds to the
+        # program's declared var dtypes, the same rim every caller gets
+        return cls(name, fn, output_names=fetch_names, example=example)

@@ -44,6 +44,16 @@ Registered sites:
 ``ckpt.write``            per shard file written by ``CheckpointManager``
                           (``truncate`` corrupts the file after its md5 is
                           recorded, simulating a torn write)
+``serving.request``       per request admitted to ``serving.Server.submit``
+                          (hit-count indexed).  ``delay[:ms]`` sleeps
+                          (default 50 ms) before admission — a slow-ingress
+                          simulation; ``drop`` raises ConnectionError at
+                          the admission rim
+``serving.dispatch``      per coalesced batch dispatched by the serving
+                          runtime (inside its retry rim).  ``transient``
+                          retries per the server's policy; ``fatal``
+                          raises :class:`InjectedFault` (classified fatal
+                          — feeds the per-model circuit breaker)
 ========================  ==================================================
 
 Every firing increments the ``fault/injected`` counter and emits a
@@ -64,7 +74,8 @@ __all__ = [
 ]
 
 KNOWN_SITES = ("trainer.step", "reader.item", "executor.dispatch",
-               "master.call", "ckpt.write")
+               "master.call", "ckpt.write", "serving.request",
+               "serving.dispatch")
 
 # THE zero-overhead gate: call sites guard every hook with
 # ``if faultinject.ENABLED:`` — one attribute load when off.

@@ -246,6 +246,74 @@ def test_lint_gate_covers_testing_package():
         "fault/tasks_returned"}
 
 
+def test_serving_package_only_imported_lazily():
+    """Zero-cost-when-unused, statically enforced: no module outside
+    paddle_tpu/serving/ may import the serving package at TOP LEVEL —
+    only inside a function body (lazy, like the CLI's serve branch).
+    This is what guarantees ``import paddle_tpu`` never pulls the
+    server; tests/test_serving_chaos.py proves the same fact at runtime
+    in a fresh interpreter (under -m slow — a full subprocess import
+    costs ~12 s of tier-1 budget)."""
+
+    def _is_serving_import(node):
+        if isinstance(node, ast.Import):
+            return any(a.name.startswith("paddle_tpu.serving")
+                       for a in node.names)
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if (mod.startswith("paddle_tpu.serving")
+                    or mod == "serving" or mod.startswith("serving.")):
+                return True
+            # `from paddle_tpu import serving` / `from . import serving`
+            # / `from .. import serving` — the package arrives as a NAME,
+            # module says nothing about serving
+            if mod in ("paddle_tpu", "") or node.level > 0:
+                return any(a.name == "serving"
+                           or a.name.startswith("serving.")
+                           for a in node.names)
+        return False
+
+    problems = []
+    for rel, tree in _iter_sources():
+        if rel.startswith("paddle_tpu/serving/"):
+            continue
+        # walk with function-nesting context
+        def visit(node, in_func):
+            for child in ast.iter_child_nodes(node):
+                nested = in_func or isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                if _is_serving_import(child) and not in_func:
+                    problems.append(
+                        f"{rel}:{child.lineno}: top-level import of the "
+                        f"serving package — must be lazy (inside a "
+                        f"function) so `import paddle_tpu` stays "
+                        f"serving-free")
+                visit(child, nested)
+        visit(tree, False)
+    assert not problems, "\n".join(problems)
+    # and the one sanctioned lazy site exists (the CLI serve branch)
+    with open(os.path.join(ROOT, "cli.py")) as fh:
+        assert "from paddle_tpu.serving.cli import serve_main" in fh.read()
+
+
+def test_lint_gate_covers_serving_package():
+    """The serving runtime (paddle_tpu/serving/) is inside every lint's
+    scan set — its metric writes and exception handling are held to the
+    same gates — and the serving/* names it writes are frozen in the
+    METRIC_NAMES table."""
+    rels = {rel for rel, _ in _iter_sources()}
+    assert "paddle_tpu/serving/__init__.py" in rels
+    assert "paddle_tpu/serving/server.py" in rels
+    assert "paddle_tpu/serving/model.py" in rels
+    assert "paddle_tpu/serving/cli.py" in rels
+    registered = {n for n, _ in _metric_names_table()}
+    assert {n for n in registered if n.startswith("serving/")} >= {
+        "serving/requests", "serving/batches", "serving/shed",
+        "serving/deadline_expired", "serving/breaker_open",
+        "serving/queue_depth", "serving/batch_size",
+        "serving/request_ms"}
+
+
 def test_registry_matches_ast_scan():
     """The AST scan and the live registry agree — guards against the scan
     silently missing a registration idiom (e.g. names built dynamically)."""

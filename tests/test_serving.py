@@ -1,0 +1,735 @@
+"""Serving runtime: batching correctness + the degradation matrix
+(ISSUE 8): deadline expiry never dispatches, overload sheds oldest
+deadline first, a poisoned model's breaker opens while the healthy
+tenant keeps serving, graceful drain completes every admitted request,
+and the zero-cost-when-unused guard (training paths byte-identical with
+serving loaded).
+
+Deterministic by construction: the degradation tests drive a FAKE model
+(a plain callable) gated on threading.Events, so "the server is busy
+dispatching" and "the queue is full" are facts, not race outcomes.
+Subprocess rounds (SIGTERM drain, supervised relaunch) live in
+tests/test_serving_chaos.py under @pytest.mark.slow.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import faults, layers
+from paddle_tpu.core.executor import pad_batch, stack_feeds
+from paddle_tpu.serving import (DeadlineExceeded, Model, ModelError,
+                                ModelUnavailable, Overloaded, Server,
+                                ServerClosed)
+from paddle_tpu.testing import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clear_injection():
+    yield
+    faultinject.clear()
+
+
+# ---------------------------------------------------------------------------
+# Fakes: deterministic models with per-dispatch gating
+# ---------------------------------------------------------------------------
+class FakeModel:
+    """Row-wise fake tenant: output = feeds['x'] * 2.  ``gate`` (when
+    set) blocks each dispatch until released; ``fail`` is a list of
+    exceptions to raise, one per dispatch, None = succeed."""
+
+    def __init__(self, name="fake", gate=False, fail=None):
+        self.calls = []                  # list of batch sizes dispatched
+        self.rows = []                   # all rows ever computed
+        self.gate = threading.Event() if gate else None
+        self.release_all = False
+        self.fail = list(fail or [])
+        self.model = Model(name, self._fn,
+                           example={"x": np.zeros(2, "float32")})
+
+    def _fn(self, feeds):
+        if self.gate is not None and not self.release_all:
+            if not self.gate.wait(timeout=10):
+                raise RuntimeError("FakeModel gate never released")
+            self.gate.clear()
+        if self.fail:
+            err = self.fail.pop(0)
+            if err is not None:
+                self.calls.append(int(feeds["x"].shape[0]))
+                raise err
+        x = np.asarray(feeds["x"])
+        self.calls.append(int(x.shape[0]))
+        self.rows.extend(x[:, 0].tolist())
+        return [x * 2.0]
+
+    def release(self):
+        self.gate.set()
+
+    def open_gate_forever(self):
+        self.release_all = True
+        self.gate.set()
+
+
+def _mk_server(fake, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 5.0)
+    kw.setdefault("deadline_ms", 5000.0)
+    kw.setdefault("queue_capacity", 16)
+    kw.setdefault("warmup", False)
+    srv = Server(**kw)
+    models = fake if isinstance(fake, (list, tuple)) else [fake]
+    for m in models:
+        srv.add_model(m.model if isinstance(m, FakeModel) else m)
+    srv.start()
+    return srv
+
+
+def _req(i, dim=2):
+    return {"x": np.full(dim, float(i), "float32")}
+
+
+# ---------------------------------------------------------------------------
+# pad_batch / bucketing
+# ---------------------------------------------------------------------------
+def test_pad_batch_repeats_first_row():
+    stacked = stack_feeds([{"x": np.array([1.0, 2.0])},
+                           {"x": np.array([3.0, 4.0])}])
+    padded = pad_batch(stacked, 4)
+    assert padded["x"].shape == (4, 2)
+    np.testing.assert_array_equal(padded["x"][2], padded["x"][0])
+    np.testing.assert_array_equal(padded["x"][3], padded["x"][0])
+    # no-op at target, rejects shrink
+    assert pad_batch(stacked, 2)["x"].shape == (2, 2)
+    with pytest.raises(ValueError, match="rows"):
+        pad_batch(stacked, 1)
+
+
+def test_buckets_are_powers_of_two_up_to_max():
+    from paddle_tpu.serving.server import _bucket_for, _buckets
+    assert _buckets(8) == [1, 2, 4, 8]
+    assert _buckets(12) == [1, 2, 4, 8, 12]
+    assert _bucket_for(3, [1, 2, 4, 8]) == 4
+    assert _bucket_for(9, [1, 2, 4, 8]) == 8
+
+
+# ---------------------------------------------------------------------------
+# Batching correctness on a REAL program-backed model
+# ---------------------------------------------------------------------------
+def test_batched_responses_match_direct_execution():
+    x = layers.data("x", shape=[8], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(h, size=4, act="softmax")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    m = Model.from_program(exe, pt.default_main_program(), [pred],
+                           name="mlp",
+                           example={"x": np.zeros(8, "float32")})
+    srv = Server(max_batch=4, max_wait_ms=20.0, deadline_ms=None,
+                 queue_capacity=64)
+    srv.add_model(m)
+    srv.start()
+    assert srv.state == "ready" and srv.ready()
+    try:
+        rng = np.random.RandomState(0)
+        feeds = [{"x": rng.rand(8).astype("float32")} for _ in range(6)]
+        pendings = [srv.submit(f) for f in feeds]
+        outs = np.stack([p.result(timeout=30)[0] for p in pendings])
+        ref = exe.run(pt.default_main_program(),
+                      feed={"x": np.stack([f["x"] for f in feeds])},
+                      fetch_list=[pred], is_test=True)
+        # coalesced + padded batching must not change the math
+        np.testing.assert_allclose(outs, ref[0], rtol=0, atol=0)
+        h = srv.health()
+        assert h["models"]["mlp"]["served"] == 6
+        assert h["models"]["mlp"]["batches"] >= 2   # 6 reqs, max_batch 4
+    finally:
+        srv.shutdown(drain=True)
+    assert srv.state == "stopped"
+
+
+def test_padded_rows_are_sliced_out():
+    fake = FakeModel()
+    srv = _mk_server(fake, max_batch=4, max_wait_ms=50.0)
+    try:
+        ps = [srv.submit(_req(i)) for i in range(3)]   # 3 -> bucket 4
+        outs = [p.result(timeout=10) for p in ps]
+        for i, o in enumerate(outs):
+            np.testing.assert_array_equal(o[0], np.full(2, 2.0 * i))
+        assert fake.calls == [4]                       # padded dispatch
+    finally:
+        srv.shutdown()
+
+
+def test_mixed_signatures_never_stack():
+    fake = FakeModel()
+    srv = _mk_server(fake, max_batch=8, max_wait_ms=100.0)
+    try:
+        a = srv.submit({"x": np.zeros(2, "float32")})
+        b = srv.submit({"x": np.zeros(3, "float32")})   # different shape
+        a.result(timeout=10)
+        b.result(timeout=10)
+        assert sorted(fake.calls) == [1, 1]             # two dispatches
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Deadlines
+# ---------------------------------------------------------------------------
+def test_expired_request_never_dispatches():
+    fake = FakeModel(gate=True)
+    srv = _mk_server(fake, max_batch=1)
+    try:
+        r1 = srv.submit(_req(1))                  # occupies the dispatcher
+        time.sleep(0.02)                          # r1 reaches the gate
+        r2 = srv.submit(_req(2), deadline_ms=1.0)
+        time.sleep(0.05)                          # r2's deadline passes
+        fake.open_gate_forever()
+        r1.result(timeout=10)
+        with pytest.raises(DeadlineExceeded):
+            r2.result(timeout=10)
+        # THE contract: the expired request's row was never computed
+        assert 2.0 not in fake.rows
+    finally:
+        srv.shutdown()
+
+
+def test_deadline_none_disables_expiry():
+    fake = FakeModel()
+    srv = _mk_server(fake, deadline_ms=None)
+    try:
+        out = srv.infer(_req(7), timeout=10)
+        np.testing.assert_array_equal(out[0], np.full(2, 14.0))
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Admission control + shedding
+# ---------------------------------------------------------------------------
+def _soak_pipeline(srv, n=3, deadline_ms=60000.0):
+    """Fill the dispatcher (gated model), the staging queue and the
+    batcher's hands, so subsequent submits ACCUMULATE in the admission
+    queue — makes queue-full a deterministic fact, not a race.  With
+    max_batch=1 and staging_depth=1 that is 3 requests: one dispatching,
+    one staged, one held by the blocked batcher."""
+    held = []
+    for i in range(n):
+        held.append(srv.submit(_req(1000 + i), deadline_ms=deadline_ms))
+        time.sleep(0.05)
+    return held
+
+
+def test_overload_sheds_oldest_deadline_first():
+    fake = FakeModel(gate=True)
+    srv = _mk_server(fake, max_batch=1, queue_capacity=2,
+                     deadline_ms=None, staging_depth=1)
+    try:
+        held = _soak_pipeline(srv)
+        r2 = srv.submit(_req(2), deadline_ms=1000.0)  # queued, soonest
+        r3 = srv.submit(_req(3), deadline_ms=5000.0)  # queued -> full
+        r4 = srv.submit(_req(4), deadline_ms=9000.0)  # -> shed r2
+        with pytest.raises(Overloaded):
+            r2.result(timeout=10)
+        fake.open_gate_forever()
+        for r in held + [r3, r4]:
+            assert r.result(timeout=10) is not None
+        assert 2.0 not in fake.rows                   # shed = never computed
+        snap = pt.observability.registry().snapshot()
+        assert snap["serving/shed"]["value"] >= 1
+    finally:
+        fake.open_gate_forever()
+        srv.shutdown()
+
+
+def test_incoming_with_soonest_deadline_is_rejected():
+    fake = FakeModel(gate=True)
+    srv = _mk_server(fake, max_batch=1, queue_capacity=1,
+                     deadline_ms=None, staging_depth=1)
+    try:
+        held = _soak_pipeline(srv)
+        rq = srv.submit(_req(2), deadline_ms=9000.0)  # fills the queue
+        with pytest.raises(Overloaded):
+            srv.submit(_req(3), deadline_ms=10.0)     # soonest -> rejected
+        fake.open_gate_forever()
+        for r in held + [rq]:
+            assert r.result(timeout=10) is not None
+    finally:
+        fake.open_gate_forever()
+        srv.shutdown()
+
+
+def test_backpressure_without_shedding_rejects_newcomer():
+    fake = FakeModel(gate=True)
+    srv = _mk_server(fake, max_batch=1, queue_capacity=1, shed=False,
+                     deadline_ms=None, staging_depth=1)
+    try:
+        held = _soak_pipeline(srv, deadline_ms=10000.0)
+        rq = srv.submit(_req(2), deadline_ms=10000.0)
+        with pytest.raises(Overloaded):
+            srv.submit(_req(3), deadline_ms=90000.0)  # latest, still shed
+        fake.open_gate_forever()
+        for r in held + [rq]:
+            assert r.result(timeout=10) is not None
+    finally:
+        fake.open_gate_forever()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker: poisoned tenant vs healthy tenant
+# ---------------------------------------------------------------------------
+def test_breaker_opens_on_poisoned_model_healthy_tenant_serves():
+    poisoned = FakeModel(name="bad",
+                         fail=[ValueError("shape mismatch (poisoned)"),
+                               ValueError("shape mismatch (poisoned)")])
+    healthy = FakeModel(name="good")
+    srv = _mk_server([poisoned, healthy], max_batch=1,
+                     breaker_threshold=2, breaker_cooldown_s=3600.0)
+    try:
+        for _ in range(2):
+            with pytest.raises(ModelError, match="poisoned"):
+                srv.infer(_req(1), model="bad", timeout=10)
+        # breaker is now open: fail fast at admission, no dispatch
+        with pytest.raises(ModelUnavailable):
+            srv.submit(_req(2), model="bad")
+        assert srv.health()["models"]["bad"]["breaker"] == "open"
+        # the healthy co-tenant is untouched
+        out = srv.infer(_req(5), model="good", timeout=10)
+        np.testing.assert_array_equal(out[0], np.full(2, 10.0))
+        assert srv.health()["models"]["good"]["breaker"] == "closed"
+        snap = pt.observability.registry().snapshot()
+        assert snap["serving/breaker_open"]["value"] >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_breaker_half_open_probe_recovers():
+    flaky = FakeModel(name="flaky", fail=[ValueError("boom"),
+                                          ValueError("boom")])
+    srv = _mk_server(flaky, max_batch=1, breaker_threshold=2,
+                     breaker_cooldown_s=0.05)
+    try:
+        for _ in range(2):
+            with pytest.raises(ModelError):
+                srv.infer(_req(1), timeout=10)
+        assert srv.health()["models"]["flaky"]["breaker"] == "open"
+        time.sleep(0.08)                       # cooldown -> half_open
+        assert srv.health()["models"]["flaky"]["breaker"] == "half_open"
+        out = srv.infer(_req(3), timeout=10)   # probe succeeds
+        np.testing.assert_array_equal(out[0], np.full(2, 6.0))
+        assert srv.health()["models"]["flaky"]["breaker"] == "closed"
+    finally:
+        srv.shutdown()
+
+
+def test_non_row_wise_model_fails_typed_without_killing_dispatcher():
+    """A model whose outputs cannot be row-sliced (scalar fetch) is a
+    MODEL failure: its requests complete with ModelError, the breaker
+    counts it, and the dispatcher thread survives to serve the next
+    batch (a dead dispatcher would wedge staging and hang drain)."""
+    class ScalarModel(FakeModel):
+        def _fn(self, feeds):
+            self.calls.append(int(np.asarray(feeds["x"]).shape[0]))
+            if len(self.calls) == 1:
+                return [np.float32(1.0)]       # not [B, ...]-indexable
+            return [np.asarray(feeds["x"]) * 2.0]
+
+    m = ScalarModel(name="scalar")
+    srv = _mk_server(m, max_batch=1, breaker_threshold=10)
+    try:
+        with pytest.raises(ModelError):
+            srv.infer(_req(1), timeout=10)
+        # dispatcher alive: the next (well-formed) dispatch serves
+        out = srv.infer(_req(3), timeout=10)
+        np.testing.assert_array_equal(out[0], np.full(2, 6.0))
+        srv.shutdown(drain=True, timeout=30)   # and drain does not hang
+        assert srv.state == "stopped"
+    finally:
+        if srv.state != "stopped":
+            srv.shutdown(drain=False)
+
+
+def test_malformed_feeds_rejected_at_admission_not_breaker():
+    """Missing/mis-shaped inputs on a spec-carrying model reject at
+    submit (per-request), never reach dispatch, never feed the shared
+    circuit breaker — one bad client cannot open the tenant's breaker."""
+    specs = {"x": {"shape": [None, 2], "dtype": "float32"},
+             "y": {"shape": [None, 3], "dtype": "float32"}}
+    m = Model("specced", lambda feeds: [np.asarray(feeds["x"]) * 2.0],
+              input_specs=specs)
+    srv = _mk_server(m, max_batch=1, breaker_threshold=1)
+    try:
+        with pytest.raises(ValueError, match="missing inputs"):
+            srv.submit({"x": np.zeros(2, "float32")})     # no 'y'
+        with pytest.raises(ValueError, match="does not match declared"):
+            srv.submit({"x": np.zeros(5, "float32"),      # wrong shape
+                        "y": np.zeros(3, "float32")})
+        with pytest.raises(ValueError, match="has no input"):
+            srv.submit({"x": np.zeros(2, "float32"),
+                        "y": np.zeros(3, "float32"),
+                        "typo": np.zeros(1)})
+        # breaker (threshold 1!) untouched: nothing reached dispatch
+        assert srv.health()["models"]["specced"]["breaker"] == "closed"
+        out = srv.infer({"x": np.full(2, 3.0, "float32"),
+                         "y": np.zeros(3, "float32")}, timeout=10)
+        np.testing.assert_array_equal(out[0], np.full(2, 6.0))
+    finally:
+        srv.shutdown()
+
+
+def test_transient_dispatch_error_retries_once():
+    flaky = FakeModel(name="flaky",
+                      fail=[faults.TransientDispatchError("hiccup"), None])
+    srv = _mk_server(flaky, max_batch=1)
+    try:
+        out = srv.infer(_req(3), timeout=10)
+        np.testing.assert_array_equal(out[0], np.full(2, 6.0))
+        assert len(flaky.calls) == 2           # failed + retried
+        assert srv.health()["models"]["flaky"]["breaker"] == "closed"
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection sites
+# ---------------------------------------------------------------------------
+def test_injected_dispatch_transient_is_retried():
+    fake = FakeModel()
+    srv = _mk_server(fake, max_batch=1)
+    try:
+        faultinject.configure("serving.dispatch@1=transient")
+        out = srv.infer(_req(2), timeout=10)
+        np.testing.assert_array_equal(out[0], np.full(2, 4.0))
+        assert faultinject.fired("serving.dispatch") == 1
+    finally:
+        srv.shutdown()
+
+
+def test_injected_dispatch_fatal_feeds_the_breaker():
+    fake = FakeModel()
+    srv = _mk_server(fake, max_batch=1, breaker_threshold=1)
+    try:
+        faultinject.configure("serving.dispatch@*=fatal")
+        with pytest.raises(ModelError):
+            srv.infer(_req(1), timeout=10)
+        assert srv.health()["models"]["fake"]["breaker"] == "open"
+        assert fake.calls == []                # never reached the model
+    finally:
+        srv.shutdown()
+
+
+def test_injected_request_drop_and_delay():
+    fake = FakeModel()
+    srv = _mk_server(fake)
+    try:
+        faultinject.configure("serving.request@1=drop")
+        with pytest.raises(ConnectionError):
+            srv.submit(_req(1))
+        faultinject.configure("serving.request@1=delay:30")
+        t0 = time.monotonic()
+        out = srv.infer(_req(2), timeout=10)
+        assert time.monotonic() - t0 >= 0.03
+        np.testing.assert_array_equal(out[0], np.full(2, 4.0))
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Drain / lifecycle
+# ---------------------------------------------------------------------------
+def test_graceful_drain_completes_every_admitted_request():
+    fake = FakeModel()
+    srv = _mk_server(fake, max_batch=4, max_wait_ms=2.0,
+                     queue_capacity=None, deadline_ms=None)
+    admitted, stop = [], threading.Event()
+
+    def pump():
+        i = 0
+        while not stop.is_set():
+            try:
+                admitted.append(srv.submit(_req(i)))
+            except ServerClosed:
+                break
+            i += 1
+            time.sleep(0.001)
+
+    t = threading.Thread(target=pump)
+    t.start()
+    try:
+        time.sleep(0.08)                       # requests in flight
+        srv.begin_drain()
+        assert srv.state == "draining"
+        with pytest.raises(ServerClosed):
+            srv.submit(_req(99999))
+        srv.shutdown(drain=True, timeout=30)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert srv.state == "stopped"
+    assert len(admitted) > 0
+    # ZERO dropped admitted requests: every one reached a terminal result
+    for p in admitted:
+        out = p.result(timeout=0.5)            # must already be done
+        assert out is not None
+
+
+def test_shutdown_without_drain_aborts_queued_typed():
+    fake = FakeModel(gate=True)
+    srv = _mk_server(fake, max_batch=1, queue_capacity=16,
+                     deadline_ms=None)
+    r1 = srv.submit(_req(1))
+    time.sleep(0.02)
+    queued = [srv.submit(_req(i)) for i in range(2, 6)]
+    fake.open_gate_forever()
+    srv.shutdown(drain=False, timeout=30)
+    assert srv.state == "stopped"
+    r1.result(timeout=5)                       # in-flight one completed
+    aborted = 0
+    for p in queued:
+        assert p.done()
+        try:
+            p.result(timeout=0)
+        except ServerClosed:
+            aborted += 1
+    assert aborted >= 1                        # tail was aborted, typed
+
+
+def test_submit_validation_errors():
+    fake = FakeModel()
+    srv = _mk_server(fake)
+    try:
+        with pytest.raises(ValueError, match="unknown model"):
+            srv.submit(_req(0), model="nope")
+        with pytest.raises(RuntimeError, match="already started"):
+            srv.add_model(FakeModel(name="late").model)
+    finally:
+        srv.shutdown()
+    with pytest.raises(ServerClosed):
+        srv.submit(_req(1))                  # stopped: admission closed
+    srv2 = Server(warmup=False)
+    with pytest.raises(ValueError, match="no models"):
+        srv2.start()
+    srv3 = Server(warmup=False)
+    srv3.add_model(FakeModel(name="dup").model)
+    with pytest.raises(ValueError, match="duplicate"):
+        srv3.add_model(FakeModel(name="dup").model)
+    with pytest.raises(ValueError):
+        Server(max_batch=0)
+    with pytest.raises(ValueError):
+        Server(queue_capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# Overload p99 bound (the in-process shedding acceptance)
+# ---------------------------------------------------------------------------
+def _overload_arm(*, shed, queue, duration_s, service_s=0.004,
+                  max_batch=4, factor=2.0):
+    """Offer ``factor``x a fixed-service-time fake's capacity; return
+    (sorted served latencies s, rejected/errored count, offered)."""
+    class SlowModel(FakeModel):
+        def _fn(self, feeds):
+            time.sleep(service_s)              # fixed batch service time
+            x = np.asarray(feeds["x"])
+            self.calls.append(int(x.shape[0]))
+            return [x * 2.0]
+
+    slow = SlowModel(name="slow")
+    srv = _mk_server(slow, max_batch=max_batch, max_wait_ms=1.0,
+                     queue_capacity=queue, shed=shed, deadline_ms=None)
+    lat, errs = [], []
+    lock = threading.Lock()
+
+    def cb(p):
+        with lock:
+            (errs if p.error is not None else lat).append(
+                (time.monotonic() - p.t_admit))
+
+    rate = factor * max_batch / service_s
+    t0 = time.monotonic()
+    offered = 0
+    try:
+        while time.monotonic() - t0 < duration_s:
+            due = int((time.monotonic() - t0) * rate) - offered
+            for _ in range(due):
+                offered += 1
+                try:
+                    srv.submit(_req(offered)).add_done_callback(cb)
+                except Overloaded:
+                    with lock:
+                        errs.append(None)
+            time.sleep(0.002)
+        # control arm: don't serve the unbounded backlog out, abort it
+        srv.shutdown(drain=shed, timeout=30)
+    finally:
+        if srv.state != "stopped":
+            srv.shutdown(drain=False)
+    with lock:
+        return sorted(lat), len(errs), offered
+
+
+def test_shedding_bounds_admitted_p99_under_2x_overload():
+    """2x offered overload on a fixed-service-time fake: with shedding,
+    admitted-request p99 stays bounded (~queue/throughput); the no-shed
+    unbounded-queue control arm under the SAME load degrades with queue
+    depth — its p99 must be decisively worse."""
+    from benchmark.serving_common import percentile
+    shed_lat, shed_errs, shed_offered = _overload_arm(
+        shed=True, queue=8, duration_s=1.0)
+    ctrl_lat, _, _ = _overload_arm(
+        shed=False, queue=None, duration_s=1.5)
+    assert len(shed_lat) >= 20                 # actually served plenty
+    assert shed_errs >= 10                     # and actually overloaded
+    assert len(ctrl_lat) >= 20
+    shed_p99 = percentile(shed_lat, 0.99)
+    ctrl_p99 = percentile(ctrl_lat, 0.99)
+    # absolute SANITY bound only: the shed arm's queue holds ~2 batches,
+    # so a p99 on the order of the whole 1 s run means the bound did
+    # nothing; the tight claim is the relative one below (a wall-clock
+    # threshold tuned to this ~1-core box would flake on slower CI)
+    assert shed_p99 <= 1.0, (
+        f"admitted p99 {shed_p99 * 1e3:.1f} ms with shedding is not "
+        f"bounded")
+    # ... and the comparative claim: without shedding the same overload
+    # collapses (latency grows with the unbounded queue for the whole
+    # run)
+    assert ctrl_p99 >= 1.5 * shed_p99, (
+        f"control p99 {ctrl_p99 * 1e3:.1f} ms vs shed p99 "
+        f"{shed_p99 * 1e3:.1f} ms — control arm did not degrade")
+
+
+# ---------------------------------------------------------------------------
+# Zero cost when unused
+# ---------------------------------------------------------------------------
+def test_training_paths_byte_identical_with_serving_loaded():
+    """Counter-delta + retrace + bit-identity guard: loading and using
+    the serving package must not perturb Executor.run/run_steps."""
+    from paddle_tpu.core.compile_cache import retrace_guard
+
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    h = layers.fc(x, size=8, act="relu")
+    pred = layers.fc(h, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    opt = pt.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(4, 4).astype("float32"),
+            "y": rng.randint(0, 3, (4, 1))}
+
+    def run_block(e):
+        outs = []
+        outs.append(e.run(pt.default_main_program(), feed=feed,
+                          fetch_list=[loss])[0])
+        outs.append(e.run_steps(2, pt.default_main_program(), feed=feed,
+                                fetch_list=[loss])[0])
+        return outs
+
+    # arm A: plain training run (serving package IS imported by this
+    # test module — the guard is that using it changes nothing)
+    state0 = {k: np.array(pt.global_scope().get(k))
+              for k in pt.global_scope().keys()}
+    a = run_block(exe)
+
+    # restore state, spin up AND use a serving server, run again
+    for k, v in state0.items():
+        pt.global_scope().set(k, v)
+    fake = FakeModel()
+    srv = _mk_server(fake)
+    srv.infer(_req(1), timeout=10)
+    srv.shutdown()
+
+    exe2 = pt.Executor()
+    before = pt.observability.registry().snapshot()
+    with retrace_guard():
+        b = run_block(exe2)
+    after = pt.observability.registry().snapshot()
+    for av, bv in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(av), np.asarray(bv))
+    # the TRAINING dispatches wrote no executor metrics (observe off)
+    for name in ("executor/steps", "executor/dispatches"):
+        assert after[name]["value"] == before[name]["value"]
+
+
+# ---------------------------------------------------------------------------
+# Artifact round trip + stats CLI section
+# ---------------------------------------------------------------------------
+def test_artifact_model_serves_and_matches_direct_call(tmp_path):
+    x = layers.data("x", shape=[6], dtype="float32")
+    pred = layers.fc(x, size=3, act="softmax")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    d = str(tmp_path / "m")
+    pt.export_compiled_model(d, {"x": ((-1, 6), "float32")}, [pred])
+    run, _ = pt.load_compiled_model(d)
+
+    m = Model.from_artifact(d)
+    assert m.name == "m" and m.example is not None
+    srv = Server(max_batch=2, max_wait_ms=5.0, deadline_ms=None,
+                 queue_capacity=8)
+    srv.add_model(m)
+    srv.start()
+    try:
+        xs = np.random.RandomState(0).rand(6).astype("float32")
+        out = srv.infer({"x": xs}, timeout=60)
+        ref = run({"x": xs[None]})
+        np.testing.assert_allclose(out[0], np.asarray(ref[0])[0],
+                                   rtol=0, atol=0)
+    finally:
+        srv.shutdown()
+
+
+def test_from_compiled_serves_through_the_aot_variant():
+    x = layers.data("x", shape=[5], dtype="float32")
+    pred = layers.fc(x, size=2)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    cp = exe.compile(pt.default_main_program(),
+                     feed={"x": ((1, 5), "float32")}, fetch_list=[pred],
+                     is_test=True)
+    assert cp.executor is exe
+    m = Model.from_compiled(cp, name="aot",
+                            example={"x": np.zeros(5, "float32")})
+    srv = Server(max_batch=1, max_wait_ms=1.0, deadline_ms=None,
+                 queue_capacity=4)
+    srv.add_model(m)
+    srv.start()
+    try:
+        xs = np.random.RandomState(1).rand(5).astype("float32")
+        out = srv.infer({"x": xs}, timeout=30)
+        ref = exe.run(pt.default_main_program(), feed={"x": xs[None]},
+                      fetch_list=[pred], is_test=True)
+        np.testing.assert_allclose(out[0], ref[0][0], rtol=0, atol=0)
+    finally:
+        srv.shutdown()
+
+
+def test_stats_cli_serving_section(tmp_path, capsys):
+    from paddle_tpu.observability.export import (render_summary,
+                                                 summarize_log)
+    log = tmp_path / "serve.jsonl"
+    pt.flags.set_flag("metrics_log", str(log))
+    try:
+        fake = FakeModel()
+        srv = _mk_server(fake, max_batch=2, queue_capacity=2,
+                         deadline_ms=None)
+        ps = [srv.submit(_req(i)) for i in range(2)]
+        for p in ps:
+            p.result(timeout=10)
+        srv.shutdown(drain=True)
+    finally:
+        pt.flags.set_flag("metrics_log", "")
+        from paddle_tpu.observability.export import _reset_writer
+        _reset_writer()
+    summary = summarize_log(str(log))
+    sv = summary["serving"]
+    assert sv["requests_served"] == 2
+    assert sv["batches"] >= 1
+    assert sv["states"][-1] == "stopped"
+    assert "ready" in sv["states"] and "draining" in sv["states"]
+    text = render_summary(summary)
+    assert "serving:" in text and "shed=0" in text

@@ -1,0 +1,285 @@
+"""Serving chaos suite: REAL server processes killed under load.
+
+Subprocess rounds (fresh jax import apiece, ~15 s on this CPU container)
+run under ``@pytest.mark.slow`` like the training chaos suite; the fast
+deterministic degradation matrix lives in tests/test_serving.py.
+
+The acceptance round (ISSUE 8): SIGTERM under live load → admission
+stops (late requests get typed ``ServerClosed`` rejections), in-flight
+batches complete, readiness flips to ``draining``, the process exits 0
+with ZERO admitted requests dropped — and a supervised relaunch of the
+identical command returns to ``ready`` and serves again.
+
+Every subprocess call carries a hard ``timeout=``.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def artifact_dir(tmp_path_factory):
+    """One tiny exported MLP artifact shared by every round."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    pt.unique_name.reset()
+    x = layers.data("x", shape=[8], dtype="float32")
+    h = layers.fc(x, size=32, act="relu")
+    pred = layers.fc(h, size=4, act="softmax")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    d = str(tmp_path_factory.mktemp("serve_artifact") / "mlp")
+    pt.export_compiled_model(d, {"x": ((-1, 8), "float32")}, [pred])
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    return d
+
+
+def _spawn_server(artifact_dir, *extra):
+    cmd = [sys.executable, "-m", "paddle_tpu", "serve",
+           "--model", f"m={artifact_dir}",
+           "--max-batch", "4", "--max-wait-ms", "5",
+           "--deadline-ms", "2000", "--queue", "64", *extra]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    return subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                            stdout=subprocess.PIPE, text=True, env=env,
+                            cwd=REPO)
+
+
+def _wait_ready(proc, timeout_s=180):
+    """Read events until the ready state line; returns all seen events."""
+    deadline = time.monotonic() + timeout_s
+    events = []
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError(
+                f"server exited before ready (rc={proc.poll()})")
+        ev = json.loads(line)
+        events.append(ev)
+        if ev.get("event") == "state" and ev.get("state") == "ready":
+            return events
+    raise AssertionError("server never became ready")
+
+
+def _request_line(i, rng):
+    return json.dumps({"id": i,
+                       "feeds": {"x": rng.rand(8).tolist()}}) + "\n"
+
+
+def test_import_paddle_tpu_does_not_import_serving():
+    """Runtime half of the zero-cost guard (the static half is the
+    repo-lint lazy-import gate, tier-1): a fresh ``import paddle_tpu``
+    pulls nothing from paddle_tpu.serving."""
+    code = (
+        "import sys\n"
+        "import paddle_tpu\n"
+        "bad = [m for m in sys.modules if m.startswith("
+        "'paddle_tpu.serving')]\n"
+        "assert not bad, f'import paddle_tpu pulled {bad}'\n"
+        "print('SERVING-NOT-IMPORTED')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "SERVING-NOT-IMPORTED" in r.stdout
+
+
+def test_sigterm_under_load_drains_admitted_requests(artifact_dir):
+    """THE kill-under-load acceptance round."""
+    proc = _spawn_server(artifact_dir)
+    try:
+        _wait_ready(proc)
+        rng = np.random.RandomState(0)
+        # stream requests; SIGTERM strikes mid-stream
+        total, kill_after = 60, 25
+        for i in range(kill_after):
+            proc.stdin.write(_request_line(i, rng))
+            if i % 5 == 4:
+                proc.stdin.flush()
+                time.sleep(0.005)
+        proc.stdin.flush()
+        proc.send_signal(signal.SIGTERM)
+        # keep writing AFTER the kill: these must get typed rejections
+        # (or responses, if they raced admission-close), never silence
+        late_ids = []
+        try:
+            for i in range(kill_after, total):
+                proc.stdin.write(_request_line(i, rng))
+                late_ids.append(i)
+            proc.stdin.flush()
+            proc.stdin.close()
+        except (BrokenPipeError, OSError, ValueError):
+            late_ids = late_ids[:0]     # pipe already torn down: fine
+        out = proc.stdout.read()        # until EOF at process exit
+        proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    assert proc.returncode == 0, f"drain must exit 0, got {proc.returncode}"
+    responses, states = {}, []
+    stopped_summary = None
+    for line in out.splitlines():
+        ev = json.loads(line)
+        if ev.get("event") == "state":
+            states.append(ev["state"])
+        elif ev.get("event") == "stopped":
+            stopped_summary = ev
+        elif "id" in ev and ev.get("id") is not None:
+            assert ev["id"] not in responses, f"duplicate response {ev}"
+            responses[ev["id"]] = ev
+    # readiness flipped: draining seen, then stopped, in order
+    assert "draining" in states and "stopped" in states
+    assert states.index("draining") < states.index("stopped")
+    # ZERO silent drops: every pre-kill request has exactly one terminal
+    # response, and every admitted one has OUTPUTS (drained, not aborted)
+    for i in range(kill_after):
+        assert i in responses, f"request {i} got no response (dropped)"
+        ev = responses[i]
+        assert "outputs" in ev or ev.get("error") in (
+            "ServerClosed", "Overloaded", "DeadlineExceeded"), ev
+    admitted_served = sum(1 for i in range(kill_after)
+                          if "outputs" in responses[i])
+    assert admitted_served > 0
+    # post-SIGTERM writes that the server read got TYPED rejections
+    for i in late_ids:
+        if i in responses:
+            assert responses[i].get("error") == "ServerClosed" \
+                or "outputs" in responses[i], responses[i]
+    assert stopped_summary is not None
+    assert stopped_summary["models"]["m"]["queue_depth"] == 0
+
+
+def test_supervised_relaunch_returns_to_ready_and_serves(artifact_dir):
+    """Round 2 of the acceptance: after a drain, relaunching the SAME
+    command (the Supervisor.run_command contract — exit 0 is 'done', so
+    the relaunch is the supervisor restarting the serving job, exactly
+    what a k8s-style controller does) returns to ready and serves."""
+    rng = np.random.RandomState(7)
+    # leg 1: serve one request, SIGTERM, clean exit
+    proc = _spawn_server(artifact_dir)
+    try:
+        _wait_ready(proc)
+        proc.stdin.write(_request_line(0, rng))
+        proc.stdin.flush()
+        while True:
+            ev = json.loads(proc.stdout.readline())
+            if ev.get("id") == 0:
+                assert "outputs" in ev
+                break
+        proc.send_signal(signal.SIGTERM)
+        proc.stdin.close()
+        out = proc.stdout.read()
+        proc.wait(timeout=120)
+        assert proc.returncode == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # leg 2: identical command relaunched -> ready again, serves again
+    proc = _spawn_server(artifact_dir)
+    try:
+        events = _wait_ready(proc)
+        assert any(ev.get("state") == "ready" for ev in events)
+        proc.stdin.write(_request_line(1, rng))
+        proc.stdin.flush()
+        while True:
+            ev = json.loads(proc.stdout.readline())
+            if ev.get("id") == 1:
+                assert "outputs" in ev and len(ev["outputs"][0]) == 4
+                break
+        proc.stdin.close()
+        out = proc.stdout.read()
+        proc.wait(timeout=120)
+        assert proc.returncode == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+def test_sigterm_during_startup_still_drains_to_exit_0(artifact_dir):
+    """Handlers are installed before model load/warmup: a supervisor's
+    SIGTERM that lands in the startup window must still end in the
+    drain path and exit 0, not a default-disposition kill (143)."""
+    proc = _spawn_server(artifact_dir)
+    try:
+        # first line = the 'loading' event: handlers are already in
+        # place by then; strike during load/warmup
+        ev = json.loads(proc.stdout.readline())
+        assert ev.get("event") == "loading"
+        proc.send_signal(signal.SIGTERM)
+        proc.stdin.close()
+        out = proc.stdout.read()
+        proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert proc.returncode == 0, proc.returncode
+    states = [json.loads(line)["state"] for line in out.splitlines()
+              if json.loads(line).get("event") == "state"]
+    assert states[-2:] == ["draining", "stopped"] or \
+        states[-1] == "stopped", states
+
+
+def test_injected_dispatch_fault_opens_breaker_in_subprocess(artifact_dir):
+    """PADDLE_TPU_FAULT_SPEC drives the serving.dispatch site end to end
+    in the process form: every dispatch fails fatally, the breaker opens
+    after the threshold, late requests get fast ModelUnavailable."""
+    cmd = [sys.executable, "-m", "paddle_tpu", "serve",
+           "--model", f"m={artifact_dir}",
+           "--max-batch", "1", "--max-wait-ms", "1",
+           "--deadline-ms", "0", "--queue", "16",
+           "--breaker-threshold", "2", "--breaker-cooldown-s", "3600"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_FAULT_SPEC="serving.dispatch@*=fatal",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                            stdout=subprocess.PIPE, text=True, env=env,
+                            cwd=REPO)
+    try:
+        _wait_ready(proc)
+        rng = np.random.RandomState(0)
+        errors = {}
+        for i in range(6):
+            proc.stdin.write(_request_line(i, rng))
+            proc.stdin.flush()
+            while True:
+                ev = json.loads(proc.stdout.readline())
+                if ev.get("id") == i:
+                    errors[i] = ev.get("error")
+                    break
+        proc.stdin.close()
+        out = proc.stdout.read()
+        proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert proc.returncode == 0
+    # first failures are ModelError (dispatched, injected-fatal); once
+    # the breaker opens the rest fail fast at admission
+    assert errors[0] == "ModelError"
+    assert "ModelUnavailable" in errors.values(), errors
