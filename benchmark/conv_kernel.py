@@ -30,7 +30,6 @@ import functools
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -43,6 +42,10 @@ from jax import lax                          # noqa: E402
 
 from paddle_tpu.ops.pallas_conv import (  # noqa: E402
     _from_pixel_major, _to_pixel_major, pallas_matmul)
+# the shared measurement harness (paddle_tpu.tuning.search): warmup
+# discard, median of windows, spread — this benchmark is a thin driver
+# over it since the autotuner PR
+from paddle_tpu.tuning.search import time_windows  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "conv_kernel_results.json")
@@ -170,18 +173,15 @@ def run_row(name, N, C, H, W, M, stride, steps, reps, dtype, interpret):
                 _, ss = lax.scan(body, (x, w, g), None, length=n)
                 return ss[-1]
 
-            float(window(x, w, g, steps))          # compile + warm
-            ts = []
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                float(window(x, w, g, steps))      # barrier
-                ts.append(time.perf_counter() - t0)
-            med = float(np.median(ts)) / steps
+            # engine harness: warmup window pays the compile, timed
+            # windows materialize the scalar (the completion barrier)
+            tw = time_windows(lambda: float(window(x, w, g, steps)),
+                              reps=reps, warmup=1, unit=steps)
+            med = tw["seconds"]
             times[impl] = {
                 "ms": round(med * 1e3, 3),
                 "tflops": round(flops / med / 1e12, 1),
-                "spread_pct": round(100 * (max(ts) - min(ts))
-                                    / np.median(ts), 2)}
+                "spread_pct": tw["spread_pct"]}
         times["pallas_speedup"] = round(
             times["xla"]["ms"] / times["pallas"]["ms"], 3)
         row["passes"][pas] = times

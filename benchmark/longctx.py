@@ -35,7 +35,6 @@ import functools
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -46,6 +45,10 @@ import jax.numpy as jnp                      # noqa: E402
 from jax import lax                          # noqa: E402
 
 from paddle_tpu.ops.pallas_kernels import flash_attention  # noqa: E402
+# the shared measurement harness (paddle_tpu.tuning.search): warmup
+# discard, median of windows, per-config fault containment — this
+# benchmark is a thin driver over it since the autotuner PR
+from paddle_tpu.tuning.search import run_trial, time_windows  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "longctx_results.json")
@@ -96,18 +99,12 @@ def _steps_for(T):
     return int(np.clip(steps, 2, 30))
 
 
-def _time_windows(call, steps, reps=3):
-    losses = call()
-    float(losses[-1])                # compile + warm
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        losses = call()
-        float(losses[-1])            # completion barrier
-        times.append(time.perf_counter() - t0)
-    med = float(np.median(times)) / steps
-    spread = round(100 * (max(times) - min(times)) / np.median(times), 2)
-    return med, spread
+def _timed(call, steps, reps=3):
+    """Median s/step + spread via the engine harness; ``call`` returns
+    the loss stack, materialized here as the completion barrier."""
+    tw = time_windows(lambda: float(call()[-1]), reps=reps, warmup=1,
+                      unit=steps)
+    return tw["seconds"], tw["spread_pct"]
 
 
 def _attn_flops(T, dim=DIM):
@@ -121,7 +118,7 @@ def default_table(results):
         qkv = _qkv(T)
         run = make_step(T)
         steps = _steps_for(T)
-        med, spread = _time_windows(lambda: run(qkv, steps), steps)
+        med, spread = _timed(lambda: run(qkv, steps), steps)
         row = {"tokens": T, "ms_per_step": round(med * 1e3, 2),
                "tokens_per_sec": round(T / med),
                "attn_tflops": round(_attn_flops(T) / med / 1e12, 1),
@@ -130,31 +127,59 @@ def default_table(results):
         print(json.dumps(row), flush=True)
 
 
+def _sweep_measure(T, bq, bk, kib, qkv, steps, d=DIM):
+    """One-window measure closure for the search engine: compile lazily
+    on the first (warmup-discarded) window, exactly where the bespoke
+    loop compiled; a VMEM rejection therefore surfaces as the trial's
+    recorded failure — which IS the sweep result for that config."""
+    state = {}
+
+    def measure(_cfg):
+        if "comp" not in state:
+            run = make_step(T, bq, bk)
+            opts = ({"xla_tpu_scoped_vmem_limit_kib": str(kib)}
+                    if kib else None)
+            state["comp"] = jax.jit(run, static_argnames=("steps",)) \
+                .lower(qkv, steps).compile(compiler_options=opts)
+        float(state["comp"](qkv)[-1])        # completion barrier
+    return measure
+
+
+def _trial_row(trial, T, steps, base_row, d=DIM):
+    """Map an engine Trial onto the committed sweep row format."""
+    row = dict(base_row)
+    if trial.status == "ok":
+        med = trial.seconds / steps
+        row.update(ms_per_step=round(med * 1e3, 2),
+                   attn_tflops=round(_attn_flops(T, d) / med / 1e12, 1),
+                   spread_pct=trial.spread_pct)
+    else:
+        row["error"] = (trial.error or trial.status)[:160]
+    return row
+
+
 def sweep(results):
-    """32k/64k block sweep across scoped-VMEM limits.  Configs whose
-    kernel VMEM footprint exceeds the limit record the compile error
-    instead of a time (that IS the sweep result for them)."""
+    """32k/64k block sweep across scoped-VMEM limits — a thin driver over
+    the autotuner search engine (`tuning.search.run_trial` provides the
+    warmup-discard/median-of-windows harness AND the per-config fault
+    containment: a config whose kernel VMEM footprint exceeds the limit
+    records its compile error as the row, never kills the sweep)."""
     rows = []
     for T in (32768, 65536):
         steps = _steps_for(T)
         qkv = _qkv(T)            # one host-RNG + device_put per T, not per row
         for kib in SWEEP_VMEM_KIB:
-            opts = ({"xla_tpu_scoped_vmem_limit_kib": str(kib)}
-                    if kib else None)
             for bq, bk in SWEEP_BLOCKS:
-                row = {"tokens": T, "block_q": bq, "block_k": bk,
-                       "scoped_vmem_mb": (kib or 16 * 1024) // 1024}
-                try:
-                    run = make_step(T, bq, bk)
-                    comp = jax.jit(run, static_argnames=("steps",)) \
-                        .lower(qkv, steps).compile(compiler_options=opts)
-                    med, spread = _time_windows(lambda: comp(qkv), steps)
-                    row.update(ms_per_step=round(med * 1e3, 2),
-                               attn_tflops=round(
-                                   _attn_flops(T) / med / 1e12, 1),
-                               spread_pct=spread)
-                except Exception as e:
-                    row["error"] = f"{type(e).__name__}: {e}"[:160]
+                trial = run_trial(
+                    _sweep_measure(T, bq, bk, kib, qkv, steps),
+                    {"block_q": bq, "block_k": bk,
+                     "scoped_vmem_kib": kib or 16 * 1024},
+                    reps=3, warmup=1, trial_timeout_s=600.0)
+                row = _trial_row(trial, T, steps,
+                                 {"tokens": T, "block_q": bq,
+                                  "block_k": bk,
+                                  "scoped_vmem_mb":
+                                      (kib or 16 * 1024) // 1024})
                 rows.append(row)
                 print(json.dumps(row), flush=True)
     # head-dim control: the same kernel at d=128 (2x the MXU lane fill of
@@ -162,16 +187,15 @@ def sweep(results):
     # any VMEM/block effect
     T, d = 32768, 128
     qkv = _qkv(T, d)                     # head dim comes from the arrays
-    run = make_step(T, 1024, 1024)
     steps = _steps_for(T)
-    comp = jax.jit(run, static_argnames=("steps",)).lower(qkv, steps) \
-        .compile(compiler_options={"xla_tpu_scoped_vmem_limit_kib":
-                                   str(32 * 1024)})
-    med, spread = _time_windows(lambda: comp(qkv), steps)
-    ctrl = {"tokens": T, "head_dim": d, "block_q": 1024, "block_k": 1024,
-            "scoped_vmem_mb": 32, "ms_per_step": round(med * 1e3, 2),
-            "attn_tflops": round(_attn_flops(T, d) / med / 1e12, 1),
-            "spread_pct": spread}
+    trial = run_trial(
+        _sweep_measure(T, 1024, 1024, 32 * 1024, qkv, steps, d=d),
+        {"block_q": 1024, "block_k": 1024,
+         "scoped_vmem_kib": 32 * 1024},
+        reps=3, warmup=1, trial_timeout_s=600.0)
+    ctrl = _trial_row(trial, T, steps,
+                      {"tokens": T, "head_dim": d, "block_q": 1024,
+                       "block_k": 1024, "scoped_vmem_mb": 32}, d=d)
     print(json.dumps(ctrl), flush=True)
     results["sweep"] = {"rows": rows, "head_dim_control": ctrl}
 
@@ -203,21 +227,18 @@ def framework_path(results, T=65536, interpret=False):
     exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
     prog = pt.default_main_program()
     steps = _steps_for(T)
-    (lv,) = exe.run_steps(steps, prog, feed={}, fetch_list=[loss],
-                          return_numpy=False)       # compile + warm
-    assert np.isfinite(np.asarray(lv)[-1])
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
+
+    def call():
         (lv,) = exe.run_steps(steps, prog, feed={}, fetch_list=[loss],
                               return_numpy=False)
-        assert np.isfinite(np.asarray(lv)[-1])
-        times.append(time.perf_counter() - t0)
-    med = float(np.median(times)) / steps
+        # unconditional materialization = the completion barrier
+        if not np.isfinite(np.asarray(lv)[-1]):
+            raise FloatingPointError("non-finite loss in timed window")
+
+    tw = time_windows(call, reps=3, warmup=1, unit=steps)
     row = {"tokens": T, "path": "framework(Executor.run_steps)",
-           "ms_per_step": round(med * 1e3, 2),
-           "spread_pct": round(100 * (max(times) - min(times))
-                               / np.median(times), 2)}
+           "ms_per_step": round(tw["seconds"] * 1e3, 2),
+           "spread_pct": tw["spread_pct"]}
     print(json.dumps(row), flush=True)
     results["framework_path"] = row
 

@@ -195,15 +195,28 @@ def run_compile_cache(smoke=False):
     return [run_model(m) for m in MODELS]
 
 
+def run_autotune(smoke=False):
+    """Delegate to benchmark/autotune.py (tuned-vs-default A/B per
+    host-side tunable through the real search path); one JSON summary
+    line per tunable, same shape as the committed rows."""
+    import tempfile
+
+    from benchmark.autotune import HOST_TUNABLES, run_one
+    with tempfile.TemporaryDirectory(prefix="pt-autotune-") as store:
+        return [run_one(n, store, smoke=smoke)
+                for n in sorted(HOST_TUNABLES)]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None,
                     help="model config, 'input_pipeline' for the "
-                         "naive-vs-pipelined input A/B, or 'compile_cache' "
-                         "for the cold-vs-warm startup A/B")
+                         "naive-vs-pipelined input A/B, 'compile_cache' "
+                         "for the cold-vs-warm startup A/B, or 'autotune' "
+                         "for the tuned-vs-default autotuner A/B")
     ap.add_argument("--smoke", action="store_true",
-                    help="input_pipeline/compile_cache only: seconds-fast "
-                         "path check")
+                    help="input_pipeline/compile_cache/autotune only: "
+                         "seconds-fast path check")
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--iters", type=int, default=None,
                     help="steps per timed window (default: 60 for the "
@@ -224,6 +237,9 @@ def main():
         return
     if args.model == "compile_cache":
         run_compile_cache(smoke=args.smoke)
+        return
+    if args.model == "autotune":
+        run_autotune(smoke=args.smoke)
         return
     if args.all:
         for name, batch in HEADLINE:

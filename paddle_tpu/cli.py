@@ -435,6 +435,139 @@ def job_plan(argv):
     return 0
 
 
+def job_tune(argv):
+    """Persistent-autotuner CLI: search one tunable's declared space and
+    commit the winner for trace-time replay."""
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu tune",
+        description="persistent autotuner (paddle_tpu.tuning): search a "
+                    "registered tunable's declared space on its built-in "
+                    "measurement target (grid or successive halving, "
+                    "paired-A/B noise gate), and persist the winner under "
+                    "<cache_dir>/tuning/ for trace-time replay via the "
+                    "autotune opt-ins (Executor(autotune=True), "
+                    "Trainer.train(autotune=True), PADDLE_TPU_AUTOTUNE=1)."
+                    "  Device-side targets on a host without the "
+                    "accelerator report their pending-hardware stub and "
+                    "pre-registered decision rule instead of searching.")
+    ap.add_argument("target", nargs="?", default=None,
+                    help="tunable name (e.g. executor/run_pipelined); "
+                         "omit with --list to enumerate")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered tunables (spaces, defaults, "
+                         "decision rules) and exit")
+    ap.add_argument("--algo", default="grid", choices=["grid", "halving"],
+                    help="search algorithm (default grid; halving for "
+                         "large spaces under a tight budget)")
+    ap.add_argument("--budget", type=int, default=None,
+                    help="max configs evaluated (default: the full grid; "
+                         "the shipped default config is always included)")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed windows per trial (median scores; "
+                         "default 3)")
+    ap.add_argument("--pairs", type=int, default=5,
+                    help="alternating default/candidate pairs in the "
+                         "final A/B (median of per-pair ratios; default 5)")
+    ap.add_argument("--min-speedup", type=float, default=1.10,
+                    help="noise-gate threshold on the median pair ratio "
+                         "(default 1.10)")
+    ap.add_argument("--trial-timeout-s", type=float, default=120.0,
+                    help="soft per-trial budget; overruns record "
+                         "'timeout' and the search continues (default "
+                         "120)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-fast target sizes (path check; winners "
+                         "from smoke runs are still persisted — use "
+                         "--no-save)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="winner store root (default: the cache_dir flag "
+                         "/ PADDLE_TPU_CACHE_DIR; records land under "
+                         "<dir>/tuning/)")
+    ap.add_argument("--no-save", action="store_true",
+                    help="search and report only; do not persist a "
+                         "winner")
+    ap.add_argument("--out", default=None,
+                    help="also write the full result document (trial "
+                         "table, A/B windows, verdict) to this JSON file")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.core.registry import get_tunable, registered_tunables
+    from paddle_tpu.tuning import search, targets, tunables
+
+    if args.list or args.target is None:
+        if not args.list and args.target is None:
+            ap.error("give a tunable name, or --list")
+        # surface lazily-imported subsystems' declarations too
+        for t in targets.target_names():
+            targets.ensure_registered(t)
+        for n in registered_tunables():
+            has_target = n in targets.TARGETS
+            print(tunables.describe(n)
+                  + ("" if has_target else "\n  (no built-in target — "
+                     "library use via paddle_tpu.tuning.tune)"),
+                  flush=True)
+            print(flush=True)
+        return 0
+
+    name = args.target
+    targets.ensure_registered(name)
+    try:
+        entry = get_tunable(name)
+    except KeyError as e:
+        raise SystemExit(f"tune: {e}")
+    import jax
+    if entry["side"] == "device" and jax.default_backend() == "cpu":
+        doc = search.pending_stub(name)
+    else:
+        if not args.no_save:
+            # fail BEFORE the multi-minute search, not after: an
+            # accepted winner with nowhere to persist would silently
+            # make the documented search-then-replay workflow a no-op
+            from paddle_tpu.tuning import store as _store
+            if not _store.store_dir(args.cache_dir):
+                raise SystemExit(
+                    "tune: no winner store configured — set "
+                    "PADDLE_TPU_CACHE_DIR (or the cache_dir flag), pass "
+                    "--cache-dir DIR, or run with --no-save to search "
+                    "without persisting")
+        try:
+            measure = targets.build_target(name, smoke=args.smoke)
+        except KeyError as e:
+            raise SystemExit(f"tune: {e}")
+
+        def on_trial(t):
+            print(json.dumps({"trial": t.config, "status": t.status,
+                              "seconds": t.seconds,
+                              "spread_pct": t.spread_pct,
+                              "error": t.error}), flush=True)
+
+        doc = search.tune(
+            name, measure, algo=args.algo, budget=args.budget,
+            reps=args.reps, pairs=args.pairs,
+            min_speedup=args.min_speedup,
+            trial_timeout_s=args.trial_timeout_s,
+            save=not args.no_save, base=args.cache_dir,
+            on_trial=on_trial)
+    if args.out:
+        try:
+            with open(args.out, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+        except OSError as e:
+            raise SystemExit(f"tune: cannot write {args.out!r}: {e}")
+    # one summary object on the last line (the trial table is in --out)
+    summary = {k: doc.get(k) for k in
+               ("tunable", "status", "winner", "record_path",
+                "decision_rule")
+               if doc.get(k) is not None}
+    if "ab" in doc:
+        summary["speedup"] = doc["ab"]["speedup"]
+        summary["pair_ratios"] = doc["ab"]["pair_ratios"]
+        if doc["ab"]["refusal_reason"]:
+            summary["refusal_reason"] = doc["ab"]["refusal_reason"]
+    print(json.dumps(summary, sort_keys=True), flush=True)
+    return 0
+
+
 def job_stats(argv):
     """Summarize a JSONL observability log (PADDLE_TPU_METRICS_LOG)."""
     ap = argparse.ArgumentParser(
@@ -468,6 +601,10 @@ def main(argv=None):
         return job_plan(argv[1:])
     if argv and argv[0] == "stats":
         return job_stats(argv[1:])
+    if argv and argv[0] == "tune":
+        # lazy: `import paddle_tpu` must never pull the tuning package
+        # (zero-cost-when-unused guard, tier-1 enforced)
+        return job_tune(argv[1:])
     if argv and argv[0] == "serve":
         # lazy: `import paddle_tpu` must never pull the serving package
         # (zero-cost-when-unused guard, tier-1 enforced)
@@ -481,10 +618,12 @@ def main(argv=None):
                     "verifier, `paddle_tpu plan prog.json --mesh dp=8` "
                     "proposes auto-sharding specs with a static cost "
                     "breakdown, `paddle_tpu stats run.jsonl` summarizes "
-                    "an observability metrics log, and `paddle_tpu serve "
-                    "--model dir` runs the batching inference server "
-                    "over exported artifacts (see "
-                    "`paddle_tpu check|plan|stats|serve --help`).")
+                    "an observability metrics log, `paddle_tpu tune "
+                    "<target>` searches and persists autotuner winners, "
+                    "and `paddle_tpu serve --model dir` runs the "
+                    "batching inference server over exported artifacts "
+                    "(see `paddle_tpu check|plan|stats|tune|serve "
+                    "--help`).")
     ap.add_argument("--config", required=True, help="v1 config file")
     ap.add_argument("--job", default="train",
                     choices=["train", "test", "time", "checkgrad"])

@@ -39,10 +39,47 @@ from .. import faults as _faults
 from .. import observability as obs
 from ..testing import faultinject as _fi
 from .program import Block, Operator, Program, Variable, grad_var_name
-from .registry import get_op_impl
+from .registry import get_op_impl, register_tunable
 from .scope import Scope, global_scope
 
 logger = logging.getLogger("paddle_tpu")
+
+# ---------------------------------------------------------------------------
+# Autotuner knob declarations (paddle_tpu.tuning) — declared HERE, next to
+# the implementations they control; nothing imports the tuning package
+# until an autotune opt-in actually replays a winner.
+# ---------------------------------------------------------------------------
+register_tunable(
+    "executor/run_pipelined", side="host",
+    space={"steps_per_dispatch": (1, 2, 4, 8, 16),
+           "prefetch_depth": (1, 2, 4)},
+    default={"steps_per_dispatch": 4, "prefetch_depth": 2},
+    description="run_pipelined dispatch chunking: steps stacked per "
+                "compiled K-step scan, and staged dispatches in flight. "
+                "Larger K amortizes host dispatch overhead; deeper "
+                "prefetch hides staging — both trade memory and tail "
+                "latency, and the right point is workload- and "
+                "host-dependent.")
+
+# XLA's scoped-VMEM budget for Pallas kernels (the knob the PR 1 flash-
+# attention sweep hand-threaded); applied through compiler_options, so a
+# replayed winner is part of the compile-cache fingerprint by
+# construction.  16 MiB is XLA's own default: replay only injects the
+# option when a persisted winner DIFFERS from it.
+_SCOPED_VMEM_DEFAULT_KIB = 16 * 1024
+register_tunable(
+    "xla/scoped_vmem_limit_kib", side="device",
+    space={"scoped_vmem_limit_kib": (16 * 1024, 32 * 1024, 64 * 1024,
+                                     128 * 1024)},
+    default={"scoped_vmem_limit_kib": _SCOPED_VMEM_DEFAULT_KIB},
+    description="xla_tpu_scoped_vmem_limit_kib compiler option: the "
+                "VMEM budget large Pallas blocks (flash-attention 2048-"
+                "row tiles) need beyond the 16 MiB default.",
+    pending_hardware=True,
+    decision_rule="enable a non-default limit only when the on-chip "
+                  "longctx block sweep shows >= 1.10x median step time "
+                  "over the 16 MiB default at the target (tokens, "
+                  "blocks) point, paired-window discipline")
 
 
 # ---------------------------------------------------------------------------
@@ -490,7 +527,8 @@ class Executor:
                  conv1x1_pallas: Optional[bool] = None,
                  validate: Optional[bool] = None,
                  observe: Optional[bool] = None,
-                 retry_policy=None):
+                 retry_policy=None,
+                 autotune: Optional[bool] = None):
         self.place = place or TPUPlace()
         self.use_jit = use_jit
         self.check_nan_inf = check_nan_inf
@@ -540,6 +578,16 @@ class Executor:
         # is byte-for-byte the old direct call — no new per-step work
         # (tier-1 counter-delta assertion).
         self.retry_policy = retry_policy
+        # persisted-autotuner replay (paddle_tpu.tuning): tuned call
+        # sites (run_pipelined chunking here; scoped-VMEM compiler
+        # option at compile time) consult the winner store.  None defers
+        # to the `autotune` flag (PADDLE_TPU_AUTOTUNE=1).  Replay NEVER
+        # searches, and with no persisted record every site resolves to
+        # its hand-picked default — byte-identical to autotune off
+        # (tier-1 pins both).  Device-side winners reach the compile
+        # through _effective_compiler_options, so they are part of the
+        # cache fingerprint by construction.
+        self.autotune = autotune
         # compiled step variants keyed by CONTENT fingerprint (survives
         # process restarts via the persistent layer; content-identical
         # programs share an entry), LRU-bounded with dead-program sweeping
@@ -602,6 +650,49 @@ class Executor:
             seen.difference_update(
                 [k for k in seen if k[0] != program.version])
         seen.add(key)
+
+    # -- autotuner replay ----------------------------------------------------
+    def _autotuning(self) -> bool:
+        """Resolved autotune switch: per-executor override, else flag."""
+        if self.autotune is not None:
+            return bool(self.autotune)
+        try:
+            from .. import flags
+            return bool(flags.get_flag("autotune"))
+        except KeyError:
+            return False
+
+    def _tuned(self, name: str, default: Dict[str, object]):
+        """Tunable config for a call site: the persisted winner under the
+        autotune opt-in, else ``default`` UNCHANGED (the same object).
+        The tuning package loads lazily and only on the opted-in path."""
+        if not self._autotuning():
+            return default
+        from ..tuning.store import tuned
+        return tuned(name, default)
+
+    def _effective_compiler_options(self) -> Dict[str, object]:
+        """compiler_options with device-side tuned winners folded in.
+
+        Feeds BOTH the compile-cache fingerprint (_config_sig) and the
+        actual compile (CachedStep/_AutoLayoutStep), so a replayed XLA
+        flag can never produce a fingerprint/executable mismatch.  An
+        explicit user-set option always wins; with autotune off, or no
+        record, or a record equal to XLA's own default, this returns
+        ``self.compiler_options`` untouched."""
+        opts = self.compiler_options
+        if not self._autotuning():
+            return opts
+        key = "xla_tpu_scoped_vmem_limit_kib"
+        if key in opts:
+            return opts
+        dflt = {"scoped_vmem_limit_kib": _SCOPED_VMEM_DEFAULT_KIB}
+        cfg = self._tuned("xla/scoped_vmem_limit_kib", dflt)
+        if cfg == dflt:
+            return opts
+        out = dict(opts)
+        out[key] = str(cfg["scoped_vmem_limit_kib"])
+        return out
 
     # -- observability -------------------------------------------------------
     def _observing(self) -> bool:
@@ -939,8 +1030,8 @@ class Executor:
                       program: Optional[Program] = None,
                       fetch_list: Optional[Sequence] = None,
                       scope: Optional[Scope] = None,
-                      steps_per_dispatch: int = 4,
-                      prefetch_depth: int = 2,
+                      steps_per_dispatch: Optional[int] = None,
+                      prefetch_depth: Optional[int] = None,
                       return_numpy: bool = True,
                       is_test: bool = False):
         """Pipelined driver: generator over per-step fetch lists for a
@@ -948,7 +1039,12 @@ class Executor:
         ``jax.device_put`` staging overlapped with device compute.
 
         ``feed_iter`` yields host feed dicts (e.g. ``DataFeeder.feed``
-        output).  A staging worker thread groups consecutive
+        output).  ``steps_per_dispatch``/``prefetch_depth`` default to
+        the hand-picked (4, 2) — or, under the autotune opt-in
+        (``Executor(autotune=...)`` / the ``autotune`` flag), to the
+        persisted ``executor/run_pipelined`` winner for this host +
+        topology; an explicit argument always wins.  A staging worker
+        thread groups consecutive
         same-signature feeds into runs of ``steps_per_dispatch``, stacks
         each run along a new leading axis (:func:`stack_feeds`) and ships
         it to the device; up to ``prefetch_depth`` staged dispatches wait
@@ -978,6 +1074,14 @@ class Executor:
                 "inspection; use run() for NaN hunts")
         from .program import default_main_program
         program = program or default_main_program()
+        if steps_per_dispatch is None or prefetch_depth is None:
+            cfg = self._tuned("executor/run_pipelined",
+                              {"steps_per_dispatch": 4,
+                               "prefetch_depth": 2})
+            if steps_per_dispatch is None:
+                steps_per_dispatch = cfg["steps_per_dispatch"]
+            if prefetch_depth is None:
+                prefetch_depth = cfg["prefetch_depth"]
         K = int(steps_per_dispatch)
         if K < 1:
             raise ValueError(
@@ -1037,6 +1141,13 @@ class Executor:
                 for i in range(n):
                     yield [o[i] if o is not None else None for o in outs]
             else:
+                # per-step fallback: stream tail, or a partially-filled
+                # stack flushed by a padding-bucket signature change —
+                # visible in telemetry so a bucketing mistake that
+                # degrades every dispatch to singles is diagnosable
+                # (K=1 dispatches singles by design: not a fallback)
+                if obs_on and K > 1:
+                    obs.inc_counter("pipeline/fallback_steps")
                 yield self.run(program, feed=dev, fetch_list=fetch_list,
                                scope=scope, return_numpy=return_numpy,
                                is_test=is_test)
@@ -1078,10 +1189,11 @@ class Executor:
             return multi
         if self.auto_layout:
             return _AutoLayoutStep(multi, self._fmt_registry,
-                                   self.compiler_options,
+                                   self._effective_compiler_options(),
                                    donate=not self.check_nan_inf)
         return compile_cache.CachedStep(
-            multi, fingerprint, compiler_options=self.compiler_options,
+            multi, fingerprint,
+            compiler_options=self._effective_compiler_options(),
             label="run_steps")
 
     # -- fingerprinting ------------------------------------------------------
@@ -1090,7 +1202,7 @@ class Executor:
         everything on `self` that changes the traced computation."""
         return (self.use_jit, self.amp, self.auto_layout,
                 str(self.compute_dtype), self.conv1x1_pallas,
-                _specs_sig(self.compiler_options))
+                _specs_sig(self._effective_compiler_options()))
 
     def _fingerprint_extras(self, program: Program):
         """Subclass hook: extra fingerprint components (ShardedExecutor
@@ -1275,10 +1387,11 @@ class Executor:
             return fn
         if self.auto_layout:
             return _AutoLayoutStep(fn, self._fmt_registry,
-                                   self.compiler_options,
+                                   self._effective_compiler_options(),
                                    donate=not self.check_nan_inf)
         return compile_cache.CachedStep(
-            fn, fingerprint, compiler_options=self.compiler_options,
+            fn, fingerprint,
+            compiler_options=self._effective_compiler_options(),
             label="run", donate=not self.check_nan_inf)
 
     def _make_fn(self, program: Program, fetch_names: List[str],

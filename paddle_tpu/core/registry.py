@@ -30,6 +30,7 @@ from typing import Callable, Dict, Optional
 _OP_IMPLS: Dict[str, Callable] = {}
 _SHAPE_FNS: Dict[str, Callable] = {}
 _SHARD_FNS: Dict[str, Callable] = {}
+_TUNABLES: Dict[str, dict] = {}
 
 
 def register_op(*names: str):
@@ -135,6 +136,96 @@ def register_shard_fn(*names: str):
         return fn
 
     return deco
+
+
+def register_tunable(name: str, *, side: str, space: Dict[str, tuple],
+                     default: Dict[str, object], description: str = "",
+                     pending_hardware: bool = False,
+                     decision_rule: str = "") -> dict:
+    """Declare a named performance knob with a typed search space — the
+    autotuner companion of :func:`register_shape_fn`/:func:`register_shard_fn`,
+    declared NEXT TO the implementation whose behavior the knob controls
+    and consumed by ``paddle_tpu.tuning`` (registry browse, search-space
+    enumeration, persisted-winner validation).
+
+    * ``name`` — namespaced ``<subsystem>/<knob>`` id (the persistence key
+      component and the ``tuned(name, default)`` lookup key).  Must be a
+      string LITERAL at the call site: tests/test_repo_lint.py runs the
+      same duplicate-name AST scan + live-registry agreement gate as the
+      op/shape/shard registries.
+    * ``side`` — ``"host"`` (searchable in any container: dispatch
+      chunking, reader workers, serving batcher) or ``"device"``
+      (needs the real accelerator: Pallas block configs, XLA flags).
+    * ``space`` — ``{param: (candidate, ...)}`` finite typed axes; the
+      grid / successive-halving searches enumerate their product.
+    * ``default`` — the config shipped today, one value per axis, each a
+      member of its axis.  ``tuned(name, default)`` returns exactly this
+      object when no persisted winner exists — the byte-identical-when-
+      untuned contract pinned by tier-1.
+    * ``pending_hardware`` — device-side entries whose search has not run
+      on a real chip yet; MUST carry a pre-registered ``decision_rule``
+      (the PR 1 convention: the enable threshold is written down before
+      the measurement exists).
+
+    Registering is declaration only: nothing here imports the tuning
+    package, so training paths that never opt in never load it
+    (lazy-import lint, tests/test_repo_lint.py).
+    """
+    if name in _TUNABLES:
+        raise ValueError(f"tunable {name!r} registered twice")
+    if "/" not in name:
+        raise ValueError(f"tunable {name!r} is not namespaced (sub/name)")
+    if side not in ("host", "device"):
+        raise ValueError(f"tunable {name!r}: side must be 'host' or "
+                         f"'device', got {side!r}")
+    if not space:
+        raise ValueError(f"tunable {name!r}: empty search space")
+    if set(default) != set(space):
+        raise ValueError(
+            f"tunable {name!r}: default keys {sorted(default)} != space "
+            f"axes {sorted(space)}")
+    norm = {}
+    for param, values in space.items():
+        values = tuple(values)
+        if not values:
+            raise ValueError(f"tunable {name!r}: axis {param!r} is empty")
+        if len(set(values)) != len(values):
+            raise ValueError(
+                f"tunable {name!r}: axis {param!r} has duplicate values")
+        if default[param] not in values:
+            raise ValueError(
+                f"tunable {name!r}: default {param}={default[param]!r} is "
+                f"not in its axis {values} — the search must be able to "
+                f"re-select the shipped config")
+        norm[param] = values
+    if pending_hardware and not decision_rule:
+        raise ValueError(
+            f"tunable {name!r}: pending_hardware entries must pre-register "
+            f"a decision_rule (the PR 1 convention: write the enable "
+            f"threshold down before the measurement exists)")
+    entry = {"name": name, "side": side, "space": norm,
+             "default": dict(default), "description": description,
+             "pending_hardware": bool(pending_hardware),
+             "decision_rule": decision_rule}
+    _TUNABLES[name] = entry
+    return entry
+
+
+def get_tunable(name: str) -> dict:
+    try:
+        return _TUNABLES[name]
+    except KeyError:
+        raise KeyError(
+            f"no tunable registered under {name!r}; registered: "
+            f"{sorted(_TUNABLES)}") from None
+
+
+def has_tunable(name: str) -> bool:
+    return name in _TUNABLES
+
+
+def registered_tunables():
+    return sorted(_TUNABLES)
 
 
 def get_shard_fn(name: str) -> Optional[Callable]:

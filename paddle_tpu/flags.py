@@ -103,6 +103,16 @@ define_flag("metrics_log", "",
             "JSONL structured metrics/event log path "
             "(PADDLE_TPU_METRICS_LOG); empty = off.  Summarize with "
             "`python -m paddle_tpu stats <log.jsonl>`")
+define_flag("autotune", False,
+            "replay persisted autotuner winners (paddle_tpu.tuning) at the "
+            "tuned call sites: run_pipelined dispatch chunking, reader "
+            "prefetch workers/buffers, serving batcher, Pallas/XLA device "
+            "knobs.  Off (default): every call site uses its hand-picked "
+            "default, byte-identical to an autotune-free build (tier-1 "
+            "enforced).  On with no persisted record: defaults again — "
+            "replay never searches.  Per-executor override: "
+            "Executor(autotune=...); search via `python -m paddle_tpu "
+            "tune <target>`.  (PADDLE_TPU_AUTOTUNE=1)")
 define_flag("conv1x1_pallas", False,
             "route eligible 1x1 conv2d ops (groups=1, pad 0, dil 1, "
             "128-divisible dims) to the hand-written Pallas dot kernels "

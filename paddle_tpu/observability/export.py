@@ -202,6 +202,7 @@ def summarize_log(path: str) -> dict:
     nans: List[dict] = []
     faults: List[dict] = []
     servings: List[dict] = []
+    tunings: List[dict] = []
     last_snapshot: Optional[dict] = None
     snapshots = corrupt = total = 0
     t_first = t_last = None
@@ -232,6 +233,8 @@ def summarize_log(path: str) -> dict:
                 faults.append(ev)
             elif kind == "serving":
                 servings.append(ev)
+            elif kind == "tuning":
+                tunings.append(ev)
 
     summary: dict = {
         "events": total, "corrupt_lines": corrupt,
@@ -329,6 +332,26 @@ def summarize_log(path: str) -> dict:
             "states": [str(e.get("state")) for e in servings
                        if e.get("event") == "state"],
         }
+    if tunings:
+        by_event: Dict[str, int] = {}
+        for e in tunings:
+            key = str(e.get("event", "unknown"))
+            by_event[key] = by_event.get(key, 0) + 1
+        summary["tuning"] = {
+            "events": len(tunings), "by_event": by_event,
+            "trials": by_event.get("trial", 0),
+            "winners": [{"tunable": e.get("tunable"),
+                         "config": e.get("config"),
+                         "speedup": e.get("speedup")}
+                        for e in tunings if e.get("event") == "winner"],
+            "refusals": [{"tunable": e.get("tunable"),
+                          "reason": e.get("reason"),
+                          "speedup": e.get("speedup")}
+                         for e in tunings if e.get("event") == "refusal"],
+            "replays": [{"tunable": e.get("tunable"),
+                         "config": e.get("config")}
+                        for e in tunings if e.get("event") == "replay"],
+        }
     return summary
 
 
@@ -386,4 +409,16 @@ def render_summary(summary: dict) -> str:
             f"  shed={sv['shed']} deadline_expired={sv['deadline_expired']}"
             f" breaker_opens={sv['breaker_opens']}"
             + (f" states={'→'.join(sv['states'])}" if sv["states"] else ""))
+    tu = summary.get("tuning")
+    if tu:
+        kinds = " ".join(f"{k}={v}" for k, v in sorted(
+            tu["by_event"].items()))
+        lines.append(f"tuning: {tu['events']} event(s): {kinds}")
+        for w in tu["winners"]:
+            lines.append(f"  winner: {w['tunable']} -> {w['config']} "
+                         f"({w['speedup']}x)")
+        for r in tu["refusals"]:
+            lines.append(f"  refusal: {r['tunable']} — {r['reason']}")
+        for r in tu["replays"]:
+            lines.append(f"  replay: {r['tunable']} -> {r['config']}")
     return "\n".join(lines)

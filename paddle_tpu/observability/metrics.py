@@ -114,6 +114,30 @@ METRIC_NAMES = (
      "live (unpadded) requests per dispatched serving batch"),
     ("serving/request_ms", "histogram",
      "admitted-request latency: admission to completed response"),
+    ("pipeline/fallback_steps", "counter",
+     "run_pipelined steps dispatched through the per-step fallback "
+     "(stream tail or padding-bucket signature change) instead of a "
+     "K-step scan"),
+    # autotuner (paddle_tpu.tuning): search-time writes are cold paths
+    # (a search IS the workload) and replay writes fire once per
+    # (call site, process) — the zero-overhead-when-off contract covers
+    # untuned training paths, which never reach these helpers
+    ("tuning/trials", "counter",
+     "autotuner trials executed (ok + failed + timeout)"),
+    ("tuning/trial_ms", "histogram",
+     "wall time per autotuner trial (all windows incl. warmup)"),
+    ("tuning/failures", "counter",
+     "autotuner trials recorded failed or timeout (contained, never "
+     "crash the search)"),
+    ("tuning/winners", "counter",
+     "tunables whose candidate cleared the paired-A/B noise gate and "
+     "was persisted"),
+    ("tuning/refusals", "counter",
+     "searches ending in an explicit refusal (noise gate, or no viable "
+     "config)"),
+    ("tuning/replays", "counter",
+     "persisted winners replayed into call sites by tuned() (first "
+     "lookup per site per process)"),
 )
 
 _MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
@@ -133,6 +157,7 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
     "serving/queue_depth": _DEPTH_BUCKETS,
     "serving/batch_size": _COUNT_BUCKETS,
     "serving/request_ms": _MS_BUCKETS,
+    "tuning/trial_ms": _MS_BUCKETS,
 }
 _DEFAULT_BUCKETS = _MS_BUCKETS
 

@@ -439,7 +439,28 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
 # ---------------------------------------------------------------------------
 # op registration (layer: layers.flash_attention)
 # ---------------------------------------------------------------------------
-from ..core.registry import register_op  # noqa: E402
+from ..core.registry import register_op, register_tunable  # noqa: E402
+
+# Autotuner knob declaration (paddle_tpu.tuning), next to the kernel it
+# tunes.  Replay is fingerprint-coherent by construction: the winning
+# blocks land in the flash_attention OP ATTRS (layers.flash_attention
+# resolves omitted block_q/block_k through tuned() under the autotune
+# flag), so they are part of the Program content digest every compile-
+# cache key hashes.  Search needs the chip: benchmark/longctx.py --sweep
+# is the measurement driver.
+register_tunable(
+    "pallas/flash_attention", side="device",
+    space={"block_q": (512, 1024, 2048), "block_k": (1024, 2048, 4096)},
+    default={"block_q": 1024, "block_k": 1024},
+    description="flash-attention Pallas tile shape: rows of Q per grid "
+                "step and the K-stream slab; 2048-row tiles additionally "
+                "need the scoped-VMEM limit raised "
+                "(xla/scoped_vmem_limit_kib).",
+    pending_hardware=True,
+    decision_rule="flip the default only when the on-chip longctx sweep "
+                  "shows >= 1.10x median ms/step over 1024x1024 at BOTH "
+                  "32k and 64k tokens (paired-window discipline, "
+                  "spread < gain)")
 
 
 _mesh_detect_warned = False

@@ -38,8 +38,21 @@ import time as _time
 from typing import Callable, Optional, Sequence
 
 from .. import observability as _obs
+from ..core.registry import register_tunable
 
 __all__ = ["prefetch", "interleave", "THREAD_NAME_PREFIX"]
+
+# Autotuner knob declaration (paddle_tpu.tuning), next to the engine it
+# controls.  num_workers trades decode parallelism against GIL/core
+# contention (this container delivers ~1 effective core — PR 2's probe —
+# so the winner is host-dependent by nature); buffer_size bounds decoded
+# samples in flight (backpressure vs burst absorption).
+register_tunable(
+    "reader/prefetch", side="host",
+    space={"num_workers": (1, 2, 4), "buffer_size": (2, 4, 8, 16)},
+    default={"num_workers": 1, "buffer_size": 8},
+    description="prefetch engine defaults: decode worker threads and the "
+                "bounded decoded-sample queue.")
 
 # Every worker thread the engine spawns carries this name prefix so test
 # harnesses (tests/conftest.py) can detect leaked pipeline workers.
@@ -156,7 +169,28 @@ def _run(sources: Sequence[Callable], buffer_size: int,
             t.join(timeout=5.0)
 
 
-def prefetch(reader: Callable, buffer_size: int = 8, num_workers: int = 1,
+def _tuned_defaults(buffer_size: Optional[int], num_workers: Optional[int]):
+    """Resolve omitted prefetch knobs: the hand-picked (8, 1) — or, when
+    the ``autotune`` flag is on, the persisted ``reader/prefetch`` winner
+    (lazy import; the untuned path never loads the tuning package).  An
+    explicit argument always wins."""
+    if buffer_size is not None and num_workers is not None:
+        return buffer_size, num_workers
+    cfg = {"buffer_size": 8, "num_workers": 1}
+    try:
+        from .. import flags as _flags
+        autotune = bool(_flags.get_flag("autotune"))
+    except KeyError:
+        autotune = False
+    if autotune:
+        from ..tuning.store import tuned
+        cfg = tuned("reader/prefetch", cfg)
+    return (cfg["buffer_size"] if buffer_size is None else buffer_size,
+            cfg["num_workers"] if num_workers is None else num_workers)
+
+
+def prefetch(reader: Callable, buffer_size: Optional[int] = None,
+             num_workers: Optional[int] = None,
              mapper: Optional[Callable] = None,
              instrument: Optional[bool] = None) -> Callable:
     """Decode-ahead through ``num_workers`` threads and a bounded queue.
@@ -169,8 +203,11 @@ def prefetch(reader: Callable, buffer_size: int = 8, num_workers: int = 1,
     (drop-in for the old ``buffered``); with more workers, relative order
     across workers is not guaranteed.  ``instrument``: queue-depth/stall/
     busy metrics into the observability registry (None = follow the
-    global ``observe`` flag).
+    global ``observe`` flag).  ``buffer_size``/``num_workers`` default to
+    (8, 1) — or the persisted ``reader/prefetch`` autotuner winner when
+    the ``autotune`` flag is on.
     """
+    buffer_size, num_workers = _tuned_defaults(buffer_size, num_workers)
     if num_workers < 1:
         raise ValueError(f"prefetch: num_workers must be >= 1, "
                          f"got {num_workers}")

@@ -97,11 +97,19 @@ def serve_main(argv=None) -> int:
                     help="export_compiled_model directory to serve "
                          "(repeat for multiple tenants; NAME defaults to "
                          "the directory basename)")
-    ap.add_argument("--max-batch", type=int, default=32,
-                    help="max requests coalesced per dispatch (default 32)")
-    ap.add_argument("--max-wait-ms", type=float, default=5.0,
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="max requests coalesced per dispatch (default "
+                         "32, or the persisted serving/batcher winner "
+                         "under --autotune)")
+    ap.add_argument("--max-wait-ms", type=float, default=None,
                     help="max batching wait after the first request "
-                         "(default 5)")
+                         "(default 5, or the persisted winner under "
+                         "--autotune)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="resolve omitted batcher knobs from the "
+                         "persisted autotuner winners (paddle_tpu.tuning; "
+                         "search with `python -m paddle_tpu tune "
+                         "serving/batcher`)")
     ap.add_argument("--deadline-ms", type=float, default=100.0,
                     help="default per-request deadline; <= 0 disables "
                          "(default 100)")
@@ -131,7 +139,8 @@ def serve_main(argv=None) -> int:
         queue_capacity=(None if args.queue == 0 else args.queue),
         shed=not args.no_shed, breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown_s,
-        warmup=not args.no_warmup)
+        warmup=not args.no_warmup,
+        autotune=True if args.autotune else None)
 
     emitter = _Emitter(sys.stdout)
 

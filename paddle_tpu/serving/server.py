@@ -64,10 +64,23 @@ import numpy as np
 from .. import faults as _faults
 from .. import observability as obs
 from ..core.executor import pad_batch, stack_feeds
+from ..core.registry import register_tunable
 from ..testing import faultinject as _fi
 from .model import Model
 
 logger = logging.getLogger("paddle_tpu")
+
+# Autotuner knob declaration (paddle_tpu.tuning), next to the batcher it
+# controls: max_batch bounds the coalescing window (and the compiled
+# bucket list), max_wait_ms trades first-request latency against batch
+# fill — the right point depends on model cost per row and offered load.
+register_tunable(
+    "serving/batcher", side="host",
+    space={"max_batch": (8, 16, 32, 64), "max_wait_ms": (1.0, 2.0, 5.0,
+                                                         10.0)},
+    default={"max_batch": 32, "max_wait_ms": 5.0},
+    description="serving batcher coalescing policy: maximum batch size "
+                "and the wait after the first queued request.")
 
 __all__ = ["Server", "PendingResponse", "ModelError"]
 
@@ -246,7 +259,8 @@ class Server:
     no-robustness control arm the serving benchmark measures against).
     """
 
-    def __init__(self, max_batch: int = 32, max_wait_ms: float = 5.0,
+    def __init__(self, max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
                  deadline_ms: Optional[float] = 100.0,
                  queue_capacity: Optional[int] = 256,
                  shed: bool = True,
@@ -255,7 +269,27 @@ class Server:
                  staging_depth: int = 2,
                  retry_policy: Optional[_faults.RetryPolicy] = None,
                  warmup: bool = True,
-                 warmup_buckets: Optional[Sequence[int]] = None):
+                 warmup_buckets: Optional[Sequence[int]] = None,
+                 autotune: Optional[bool] = None):
+        # max_batch/max_wait_ms default to the hand-picked (32, 5.0) —
+        # or, under the autotune opt-in (``autotune=True``, else the
+        # `autotune` flag), the persisted serving/batcher winner for
+        # this host.  Explicit arguments always win.
+        if max_batch is None or max_wait_ms is None:
+            cfg = {"max_batch": 32, "max_wait_ms": 5.0}
+            if autotune is None:
+                try:
+                    from .. import flags as _flags
+                    autotune = bool(_flags.get_flag("autotune"))
+                except KeyError:
+                    autotune = False
+            if autotune:
+                from ..tuning.store import tuned
+                cfg = tuned("serving/batcher", cfg)
+            if max_batch is None:
+                max_batch = cfg["max_batch"]
+            if max_wait_ms is None:
+                max_wait_ms = cfg["max_wait_ms"]
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if queue_capacity is not None and queue_capacity < 1:

@@ -54,6 +54,12 @@ Registered sites:
                           retries per the server's policy; ``fatal``
                           raises :class:`InjectedFault` (classified fatal
                           — feeds the per-model circuit breaker)
+``tuning.trial``          per autotuner trial (``tuning.search.run_trial``;
+                          hit-count indexed).  ``fail`` makes the trial's
+                          measurement raise (recorded ``failed``);
+                          ``timeout`` makes it overrun its budget
+                          (recorded ``timeout``) — both INSIDE the
+                          containment rim, so the search must survive
 ========================  ==================================================
 
 Every firing increments the ``fault/injected`` counter and emits a
@@ -75,7 +81,7 @@ __all__ = [
 
 KNOWN_SITES = ("trainer.step", "reader.item", "executor.dispatch",
                "master.call", "ckpt.write", "serving.request",
-               "serving.dispatch")
+               "serving.dispatch", "tuning.trial")
 
 # THE zero-overhead gate: call sites guard every hook with
 # ``if faultinject.ENABLED:`` — one attribute load when off.

@@ -75,6 +75,7 @@ class SGD:
               feeding=None, feed_list: Optional[Sequence[Variable]] = None,
               steps_per_dispatch: int = 1, pipeline=False,
               warmup: bool = False, validate: Optional[bool] = None,
+              autotune: Optional[bool] = None,
               auto_shard=None,
               checkpoint_dir: Optional[str] = None, resume: bool = False,
               save_every_n_steps: Optional[int] = None, master=None,
@@ -120,6 +121,19 @@ class SGD:
         ``validate`` flag (``PADDLE_TPU_VALIDATE=1``).  The override
         applies to this call only — the executor's own setting is
         restored afterwards.
+
+        ``autotune=True`` replays persisted autotuner winners
+        (``paddle_tpu.tuning``) into this loop's omitted knobs: the
+        pipelined path's ``steps_per_dispatch``/``prefetch_depth`` and
+        reader ``num_workers``/``buffer_size`` (any knob given
+        explicitly — argument or pipeline dict — always wins), plus the
+        executor's device-side tuned compiler options.  ``False`` forces
+        it off; ``None`` (default) defers to the executor /
+        ``autotune`` flag (``PADDLE_TPU_AUTOTUNE=1``).  Replay never
+        searches — with no persisted record every knob keeps its
+        hand-picked default.  Search with ``python -m paddle_tpu tune
+        <target>``.  Like ``validate``, the override applies to this
+        call only.
 
         ``auto_shard`` turns on the static auto-sharding planner
         (``paddle_tpu.analysis.planner``): when the executor's
@@ -177,6 +191,11 @@ class SGD:
         prev_validate = self.exe.validate
         if validate is not None:
             self.exe.validate = validate
+        # autotune is the same kind of per-call override (the executor's
+        # own dispatch paths consult _autotuning() for their tuned knobs)
+        prev_autotune = self.exe.autotune
+        if autotune is not None:
+            self.exe.autotune = autotune
         ckpt = None
         try:
             if not self._initialized:
@@ -215,9 +234,22 @@ class SGD:
                            self.main_program.random_seed, opt_fp)
 
             fetch = [self.cost] + self.extra
+            # resolve the pipelined-loop knobs ONCE — including the
+            # autotuned fills — so warmup AOT-compiles the exact scan
+            # variant the loop will dispatch (_dispatch_k's contract;
+            # resolving inside the loop body would let warmup compile
+            # the untuned K and the first real dispatch pay the stall)
+            pipe_opts = None
+            if pipeline:
+                pipe_opts = dict(pipeline) if isinstance(pipeline, dict) \
+                    else {}
+                if self.exe._autotuning():
+                    self._fill_tuned_pipeline_opts(pipe_opts,
+                                                   steps_per_dispatch)
             if warmup:
                 self._warmup(reader, feeding, feed_list, fetch,
-                             steps_per_dispatch, pipeline)
+                             steps_per_dispatch,
+                             pipe_opts if pipe_opts is not None else False)
 
             # periodic observability reports every `log_period` iterations
             # (the v1 Stat::printAllStatus cadence, Flags.cpp:62), counted
@@ -289,7 +321,7 @@ class SGD:
                 return _r, skip
 
             if pipeline:
-                opts = dict(pipeline) if isinstance(pipeline, dict) else {}
+                opts = pipe_opts
                 K = self._dispatch_k(opts, steps_per_dispatch)
                 workers = int(opts.get("num_workers", 1))
                 buf = int(opts.get("buffer_size", 4))
@@ -375,6 +407,7 @@ class SGD:
                 ckpt.final_save(num_passes)
         finally:
             self.exe.validate = prev_validate
+            self.exe.autotune = prev_autotune
             if ckpt is not None:
                 ckpt.close()
 
@@ -444,6 +477,27 @@ class SGD:
             mesh=mesh_for_axes(axes), batch_axis=next(iter(axes), "dp"),
             auto_shard=True)
 
+    def _fill_tuned_pipeline_opts(self, opts, steps_per_dispatch):
+        """Fill OMITTED pipeline knobs from persisted autotuner winners
+        (autotune opt-in resolved by the caller).  Explicit knobs — in
+        the pipeline dict, or steps_per_dispatch > 1 as the documented
+        K override — always win; with no persisted record every knob
+        resolves to its existing hand-picked default, so this is
+        behavior-neutral until a `tune` run has committed a winner."""
+        pipe = self.exe._tuned(
+            "executor/run_pipelined",
+            {"steps_per_dispatch": 8, "prefetch_depth": 2})
+        if "steps_per_dispatch" not in opts and steps_per_dispatch <= 1:
+            opts["steps_per_dispatch"] = pipe["steps_per_dispatch"]
+        if "prefetch_depth" not in opts:
+            opts["prefetch_depth"] = pipe["prefetch_depth"]
+        rd = self.exe._tuned("reader/prefetch",
+                             {"num_workers": 1, "buffer_size": 4})
+        if "num_workers" not in opts:
+            opts["num_workers"] = rd["num_workers"]
+        if "buffer_size" not in opts:
+            opts["buffer_size"] = rd["buffer_size"]
+
     @staticmethod
     def _dispatch_k(opts, steps_per_dispatch):
         """Steps per pipelined dispatch — ONE derivation shared by the
@@ -462,7 +516,9 @@ class SGD:
         if probe is None:
             return
         feed0 = self._feeder(feeding, feed_list).feed(probe)
-        if pipeline:
+        # train() passes the RESOLVED opts dict (autotuned fills applied)
+        # when pipelining — an empty dict still means "pipelined"
+        if pipeline is not False and pipeline is not None:
             opts = dict(pipeline) if isinstance(pipeline, dict) else {}
             K = self._dispatch_k(opts, steps_per_dispatch)
         else:

@@ -556,7 +556,14 @@ def test_trainer_validate_kwarg(rng):
 # ---------------------------------------------------------------------------
 # CLI: python -m paddle_tpu check
 # ---------------------------------------------------------------------------
+@pytest.mark.slow
 def test_cli_check(tmp_path):
+    # @slow: two `python -m paddle_tpu check` subprocesses (~8 s of jax
+    # import on this container) against a tier-1 budget that is ~98%
+    # full; the check pipeline itself (validate_program, report
+    # rendering, PT0xx codes) stays tier-1-covered in-process throughout
+    # this file, and cli.job_check's argument handling by the in-process
+    # CLI tests.
     main, _, loss = _build_clean()
     ok = tmp_path / "prog_ok.json"
     ok.write_text(main.to_json())

@@ -205,6 +205,45 @@ def test_run_pipelined_handles_signature_changes():
         np.testing.assert_allclose(o[0], f["x"].mean(), rtol=1e-6)
 
 
+def test_run_pipelined_flushes_partial_stack_on_signature_change():
+    """A signature change mid-K must flush the partially-filled stack
+    through the per-step path — every feed trains, in order, with
+    fetches BIT-IDENTICAL to the sequential loop (training state + the
+    step-keyed RNG cross the flush boundary intact) — and the flushed
+    steps are counted in pipeline/fallback_steps so a bucketing mistake
+    that degrades every dispatch to singles is visible in telemetry."""
+    rng = np.random.RandomState(11)
+    # K=4: one full scan of A, a 2-deep partial stack of A flushed by the
+    # B signature change, then a 3-step B tail — 5 fallback steps total
+    batches = _batches(rng, 6, batch=16) + _batches(rng, 3, batch=10)
+
+    _fresh()
+    loss = _build_cls_net()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    seq = [exe.run(pt.default_main_program(), feed=f, fetch_list=[loss])[0]
+           for f in batches]
+
+    _fresh()
+    loss2 = _build_cls_net()
+    exe2 = pt.Executor(observe=True)
+    exe2.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    from paddle_tpu.observability import registry
+    before = registry().snapshot()["pipeline/fallback_steps"]["value"]
+    pip = [o[0] for o in exe2.run_pipelined(
+        iter(batches), pt.default_main_program(), fetch_list=[loss2],
+        steps_per_dispatch=4)]
+    after = registry().snapshot()["pipeline/fallback_steps"]["value"]
+
+    assert len(pip) == len(seq) == 9
+    for i, (a, b) in enumerate(zip(seq, pip)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            f"step {i}: sequential {a} != pipelined {b}"
+    assert after - before == 5, \
+        f"expected 5 per-step fallback dispatches (2 flushed + 3 tail), " \
+        f"metric counted {after - before}"
+
+
 def test_run_pipelined_propagates_feed_iter_exception():
     _fresh()
     x = layers.data("x", shape=[4], dtype="float32")

@@ -128,7 +128,8 @@ def _registered_names(call_name: str):
 
 
 def test_no_duplicate_register_op_names():
-    for call in ("register_op", "register_shape_fn", "register_shard_fn"):
+    for call in ("register_op", "register_shape_fn", "register_shard_fn",
+                 "register_tunable"):
         by_name = collections.defaultdict(list)
         for name, rel, lineno in _registered_names(call):
             by_name[name].append(f"{rel}:{lineno}")
@@ -246,6 +247,44 @@ def test_lint_gate_covers_testing_package():
         "fault/tasks_returned"}
 
 
+def _top_level_package_imports(pkg: str):
+    """(rel, lineno) of every TOP-LEVEL import of ``pkg`` from outside
+    its own directory — the static half of a package's zero-cost-when-
+    unused contract (lazy imports inside function bodies are fine)."""
+
+    def _is_pkg_import(node):
+        if isinstance(node, ast.Import):
+            return any(a.name.startswith(f"paddle_tpu.{pkg}")
+                       for a in node.names)
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if (mod.startswith(f"paddle_tpu.{pkg}")
+                    or mod == pkg or mod.startswith(f"{pkg}.")):
+                return True
+            # `from paddle_tpu import <pkg>` / `from . import <pkg>`
+            # / `from .. import <pkg>` — the package arrives as a NAME,
+            # module says nothing about it
+            if mod in ("paddle_tpu", "") or node.level > 0:
+                return any(a.name == pkg or a.name.startswith(f"{pkg}.")
+                           for a in node.names)
+        return False
+
+    found = []
+    for rel, tree in _iter_sources():
+        if rel.startswith(f"paddle_tpu/{pkg}/"):
+            continue
+        # walk with function-nesting context
+        def visit(node, in_func):
+            for child in ast.iter_child_nodes(node):
+                nested = in_func or isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                if _is_pkg_import(child) and not in_func:
+                    found.append((rel, child.lineno))
+                visit(child, nested)
+        visit(tree, False)
+    return found
+
+
 def test_serving_package_only_imported_lazily():
     """Zero-cost-when-unused, statically enforced: no module outside
     paddle_tpu/serving/ may import the serving package at TOP LEVEL —
@@ -254,46 +293,34 @@ def test_serving_package_only_imported_lazily():
     server; tests/test_serving_chaos.py proves the same fact at runtime
     in a fresh interpreter (under -m slow — a full subprocess import
     costs ~12 s of tier-1 budget)."""
-
-    def _is_serving_import(node):
-        if isinstance(node, ast.Import):
-            return any(a.name.startswith("paddle_tpu.serving")
-                       for a in node.names)
-        if isinstance(node, ast.ImportFrom):
-            mod = node.module or ""
-            if (mod.startswith("paddle_tpu.serving")
-                    or mod == "serving" or mod.startswith("serving.")):
-                return True
-            # `from paddle_tpu import serving` / `from . import serving`
-            # / `from .. import serving` — the package arrives as a NAME,
-            # module says nothing about serving
-            if mod in ("paddle_tpu", "") or node.level > 0:
-                return any(a.name == "serving"
-                           or a.name.startswith("serving.")
-                           for a in node.names)
-        return False
-
-    problems = []
-    for rel, tree in _iter_sources():
-        if rel.startswith("paddle_tpu/serving/"):
-            continue
-        # walk with function-nesting context
-        def visit(node, in_func):
-            for child in ast.iter_child_nodes(node):
-                nested = in_func or isinstance(
-                    child, (ast.FunctionDef, ast.AsyncFunctionDef))
-                if _is_serving_import(child) and not in_func:
-                    problems.append(
-                        f"{rel}:{child.lineno}: top-level import of the "
-                        f"serving package — must be lazy (inside a "
-                        f"function) so `import paddle_tpu` stays "
-                        f"serving-free")
-                visit(child, nested)
-        visit(tree, False)
+    problems = [
+        f"{rel}:{lineno}: top-level import of the serving package — "
+        f"must be lazy (inside a function) so `import paddle_tpu` "
+        f"stays serving-free"
+        for rel, lineno in _top_level_package_imports("serving")]
     assert not problems, "\n".join(problems)
     # and the one sanctioned lazy site exists (the CLI serve branch)
     with open(os.path.join(ROOT, "cli.py")) as fh:
         assert "from paddle_tpu.serving.cli import serve_main" in fh.read()
+
+
+def test_tuning_package_only_imported_lazily():
+    """Same contract for the autotuner: declaring a tunable
+    (core.registry.register_tunable) costs nothing, and only an explicit
+    autotune opt-in may load paddle_tpu/tuning/ — every call site
+    (executor dispatch chunking, reader prefetch defaults, serving
+    batcher, flash-attention layer blocks, the CLI tune branch) imports
+    it inside a function body.  `import paddle_tpu` stays tuning-free
+    (tests/test_tuning.py proves the runtime half)."""
+    problems = [
+        f"{rel}:{lineno}: top-level import of the tuning package — "
+        f"must be lazy (inside a function) so training paths that "
+        f"never opt in never load the autotuner"
+        for rel, lineno in _top_level_package_imports("tuning")]
+    assert not problems, "\n".join(problems)
+    # and the sanctioned lazy replay site exists (executor._tuned)
+    with open(os.path.join(ROOT, "core", "executor.py")) as fh:
+        assert "from ..tuning.store import tuned" in fh.read()
 
 
 def test_lint_gate_covers_serving_package():
@@ -328,6 +355,58 @@ def test_registry_matches_ast_scan():
         f"ops registered at runtime but invisible to the AST lint "
         f"(dynamic name construction defeats the duplicate gate): "
         f"{sorted(missing)}")
+
+
+def test_lint_gate_covers_tuning_package():
+    """The autotuner (paddle_tpu/tuning/) is inside every lint's scan
+    set — its metric writes and exception handling are held to the same
+    gates — and the tuning/* names it writes are frozen in the
+    METRIC_NAMES table."""
+    rels = {rel for rel, _ in _iter_sources()}
+    assert "paddle_tpu/tuning/__init__.py" in rels
+    assert "paddle_tpu/tuning/tunables.py" in rels
+    assert "paddle_tpu/tuning/search.py" in rels
+    assert "paddle_tpu/tuning/store.py" in rels
+    assert "paddle_tpu/tuning/targets.py" in rels
+    registered = {n for n, _ in _metric_names_table()}
+    assert {n for n in registered if n.startswith("tuning/")} >= {
+        "tuning/trials", "tuning/trial_ms", "tuning/failures",
+        "tuning/winners", "tuning/refusals", "tuning/replays"}
+
+
+def test_tunable_registry_matches_ast_scan():
+    """Agreement gate for the autotuner knob declarations: every live
+    register_tunable name is a string literal the duplicate lint can
+    see.  (ast - live is legitimate: serving and the flag-gated Pallas
+    conv module register lazily.)  Every declared entry must also pass
+    the registry's own validation — importing the declaring modules here
+    IS that check, since register_tunable validates at call time."""
+    import importlib
+
+    from paddle_tpu.core.registry import registered_tunables
+
+    # surface the lazily-imported declarations so live is maximal
+    importlib.import_module("paddle_tpu.serving.server")
+    importlib.import_module("paddle_tpu.ops.pallas_conv")
+
+    ast_names = {n for n, _, _ in _registered_names("register_tunable")}
+    live = set(registered_tunables())
+    missing = live - ast_names
+    assert not missing, (
+        f"tunables registered at runtime but invisible to the AST lint "
+        f"(dynamic name construction defeats the duplicate gate): "
+        f"{sorted(missing)}")
+    assert live >= {"executor/run_pipelined", "reader/prefetch",
+                    "serving/batcher", "pallas/flash_attention",
+                    "pallas/conv1x1_blocks", "xla/scoped_vmem_limit_kib"}, \
+        f"expected initial tunable coverage missing: {sorted(live)}"
+    # device-side entries must carry their pre-registered decision rule
+    from paddle_tpu.core.registry import get_tunable
+    for n in live:
+        e = get_tunable(n)
+        if e["pending_hardware"]:
+            assert e["decision_rule"], \
+                f"pending-hardware tunable {n!r} without a decision rule"
 
 
 def test_shard_fn_registry_matches_ast_scan():
