@@ -295,9 +295,50 @@ def run_workload(workload, smoke=False, quiet=False):
         "speedup_pairs": [round(r, 3) for r in ratios],
         "smoke": smoke,
     }
+    try:
+        row.update(_doctor_pass(workload, one_pass, pipe_cfg, cfg))
+    except Exception as e:   # the A/B rows must survive a doctor failure
+        row["doctor"] = {"error": f"{type(e).__name__}: {e}"}
     if not quiet:
         print(json.dumps(row), flush=True)
     return row
+
+
+def _doctor_pass(workload, one_pass, pipe_cfg, cfg):
+    """One EXTRA pipelined pass with observe + a fresh JSONL log, AFTER
+    the timed windows (instrumentation cost never touches the A/B):
+    the measured step-time budget and the static-cost-model calibration
+    row ride the committed result row (`python -m paddle_tpu doctor`
+    is the CLI form of the same attribution).  The log path is unique
+    per workload — the JSONL writer only reopens on a path change."""
+    import tempfile
+
+    import paddle_tpu as pt
+    from paddle_tpu import flags
+    from paddle_tpu.observability import attribution
+
+    log = os.path.join(tempfile.gettempdir(),
+                       f"pt_doctor_pipe_{workload}_{os.getpid()}.jsonl")
+    try:
+        os.remove(log)
+    except OSError:
+        pass
+    prev_obs = flags.get_flag("observe")
+    prev_log = flags.get_flag("metrics_log")
+    flags.set_flag("observe", True)
+    flags.set_flag("metrics_log", log)
+    try:
+        one_pass(pipe_cfg)
+    finally:
+        flags.set_flag("observe", prev_obs)
+        flags.set_flag("metrics_log", prev_log or "")
+    report = attribution.doctor_report([log],
+                                       program=pt.default_main_program(),
+                                       assume_batch=cfg["batch"])
+    out = {"doctor": report.get("training")}
+    if "calibration" in report:
+        out["calibration"] = report["calibration"]
+    return out
 
 
 def main():

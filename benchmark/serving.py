@@ -108,46 +108,73 @@ def closed_loop_capacity(model_dir, example, *, workers, duration_s,
 def open_loop_arm(model_dir, example, *, rate, duration_s, shed, queue,
                   deadline_ms, max_batch, max_wait_ms, tick_s=0.005,
                   label="", sample_queue=False):
-    """Offer `rate` req/s for `duration_s`; return the arm's row."""
-    from paddle_tpu import faults
-    srv = _make_server(model_dir, shed=shed, queue=queue,
-                      deadline_ms=deadline_ms, max_batch=max_batch,
-                      max_wait_ms=max_wait_ms)
-    col = _Collector()
-    offered = 0
-    queue_samples = []
-    t0 = time.monotonic()
-    next_sample = t0
-    end = t0 + duration_s
-    while True:
-        now = time.monotonic()
-        if now >= end:
-            break
-        # offer every request whose arrival time has passed (burst ticks:
-        # open-loop arrivals never slow down with the server)
-        due = int((now - t0) * rate) - offered
-        for _ in range(due):
-            offered += 1
-            try:
-                pending = srv.submit(example, deadline_ms=deadline_ms)
-            except (faults.Overloaded, faults.ServerClosed) as e:
-                col.note_admission_reject(e)
-                continue
-            pending.add_done_callback(col.cb)
-        if sample_queue and now >= next_sample:
-            queue_samples.append(
-                (round(now - t0, 2),
-                 srv.health()["models"]["mlp"]["queue_depth"]))
-            next_sample = now + 0.5
-        time.sleep(tick_s)
-    gen_wall = time.monotonic() - t0
-    pending_at_stop = srv.health()["models"]["mlp"]["queue_depth"]
-    if sample_queue:
-        queue_samples.append((round(gen_wall, 2), pending_at_stop))
-    # control arm: do NOT drain the unbounded backlog through the model
-    # (it would take rate/capacity * duration longer); abort it and let
-    # the completed set speak.  Shedding arms drain in bounded time.
-    srv.shutdown(drain=shed, timeout=60)
+    """Offer `rate` req/s for `duration_s`; return the arm's row.
+
+    Each arm writes its own JSONL span log, and the committed row
+    carries the per-request budget (queue+batch wait vs model dispatch)
+    the doctor derives from it — `python -m paddle_tpu doctor` over the
+    same log reproduces the breakdown."""
+    import re
+    import tempfile
+
+    from paddle_tpu import faults, flags
+    # one log PER ARM (unique path: the JSONL writer only reopens on a
+    # path CHANGE, so reusing one name across arms would keep writing
+    # into the first arm's unlinked inode)
+    slug = re.sub(r"[^A-Za-z0-9_.-]", "_", label or f"rate{rate:g}")
+    log = os.path.join(tempfile.gettempdir(),
+                       f"pt_serving_arm_{os.getpid()}_{slug}.jsonl")
+    try:
+        os.remove(log)
+    except OSError:
+        pass
+    prev_log = flags.get_flag("metrics_log")
+    flags.set_flag("metrics_log", log)
+    try:
+        srv = _make_server(model_dir, shed=shed, queue=queue,
+                           deadline_ms=deadline_ms, max_batch=max_batch,
+                           max_wait_ms=max_wait_ms)
+        col = _Collector()
+        offered = 0
+        queue_samples = []
+        t0 = time.monotonic()
+        next_sample = t0
+        end = t0 + duration_s
+        while True:
+            now = time.monotonic()
+            if now >= end:
+                break
+            # offer every request whose arrival time has passed (burst
+            # ticks: open-loop arrivals never slow down with the server)
+            due = int((now - t0) * rate) - offered
+            for _ in range(due):
+                offered += 1
+                try:
+                    pending = srv.submit(example, deadline_ms=deadline_ms)
+                except (faults.Overloaded, faults.ServerClosed,
+                        faults.ModelUnavailable) as e:
+                    col.note_admission_reject(e)
+                    continue
+                pending.add_done_callback(col.cb)
+            if sample_queue and now >= next_sample:
+                queue_samples.append(
+                    (round(now - t0, 2),
+                     srv.health()["models"]["mlp"]["queue_depth"]))
+                next_sample = now + 0.5
+            time.sleep(tick_s)
+        gen_wall = time.monotonic() - t0
+        pending_at_stop = srv.health()["models"]["mlp"]["queue_depth"]
+        if sample_queue:
+            queue_samples.append((round(gen_wall, 2), pending_at_stop))
+        # control arm: do NOT drain the unbounded backlog through the
+        # model (it would take rate/capacity * duration longer); abort it
+        # and let the completed set speak.  Shedding arms drain in
+        # bounded time.
+        srv.shutdown(drain=shed, timeout=60)
+    finally:
+        # restore even when the arm dies mid-flight — leaking the arm's
+        # temp path would permanently clobber a user-set metrics log
+        flags.set_flag("metrics_log", prev_log or "")
     with col.lock:
         lat = sorted(col.latency_ms)
         errors = dict(col.errors)
@@ -173,6 +200,11 @@ def open_loop_arm(model_dir, example, *, rate, duration_s, shed, queue,
         row["queue_depth_samples"] = queue_samples
         row["pending_at_stop"] = pending_at_stop
         row["aborted_at_stop"] = errors.get("ServerClosed", 0)
+    try:
+        from paddle_tpu.observability import attribution
+        row["doctor"] = attribution.doctor_report([log]).get("serving")
+    except OSError:
+        row["doctor"] = None       # log unreadable: the arm row stands
     return row
 
 

@@ -569,26 +569,161 @@ def job_tune(argv):
 
 
 def job_stats(argv):
-    """Summarize a JSONL observability log (PADDLE_TPU_METRICS_LOG)."""
+    """Summarize JSONL observability logs (PADDLE_TPU_METRICS_LOG)."""
     ap = argparse.ArgumentParser(
         prog="paddle_tpu stats",
-        description="summarize a structured observability log "
-                    "(paddle_tpu.observability, flag metrics_log / env "
-                    "PADDLE_TPU_METRICS_LOG): step-time statistics, "
+        description="summarize one or more structured observability "
+                    "logs (paddle_tpu.observability, flag metrics_log / "
+                    "env PADDLE_TPU_METRICS_LOG): step-time statistics, "
                     "pipeline stall/busy numbers, last metrics snapshot, "
-                    "NaN events")
-    ap.add_argument("log", help="JSONL metrics log file")
+                    "NaN events.  Multiple files (a supervised run's "
+                    "per-relaunch logs) merge in time order with restart "
+                    "boundaries marked.")
+    ap.add_argument("log", nargs="+", help="JSONL metrics log file(s)")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as ONE JSON object only")
+    ap.add_argument("--prom", action="store_true",
+                    help="print the logs' LAST metrics snapshot in "
+                         "Prometheus text exposition format (scrape a "
+                         "serving deployment without a new dependency) "
+                         "and exit")
     args = ap.parse_args(argv)
     from paddle_tpu.observability import export
+    if args.prom:
+        try:
+            events, _files = export.iter_log_events(args.log)
+        except OSError as e:
+            raise SystemExit(f"stats: cannot read log: {e}")
+        snap = next((e for e in reversed(events)
+                     if e.get("kind") == "snapshot"), None)
+        if snap is None:
+            raise SystemExit(
+                "stats --prom: no snapshot events in the log — run with "
+                "observe on and periodic reports (log_period), or call "
+                "observability.periodic_report()")
+        print(export.to_prometheus(snap), end="", flush=True)
+        return 0
     try:
-        summary = export.summarize_log(args.log)
+        summary = export.summarize_logs(args.log)
     except OSError as e:
-        raise SystemExit(f"stats: cannot read {args.log!r}: {e}")
+        raise SystemExit(f"stats: cannot read log: {e}")
     if not args.json:
         print(export.render_summary(summary), flush=True)
     print(json.dumps(summary, default=repr), flush=True)
+    return 0
+
+
+def job_trace(argv):
+    """Reconstruct per-trace timelines from a span-carrying JSONL log."""
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu trace",
+        description="replay the tracing spans of one or more "
+                    "observability logs (paddle_tpu.observability."
+                    "tracing): per-trace timelines, the critical path of "
+                    "the longest trace, and p50/p99 latency by span "
+                    "name.  Multiple files merge in time order (a "
+                    "resumed job's logs read as one).")
+    ap.add_argument("log", nargs="+", help="JSONL metrics log file(s)")
+    ap.add_argument("--json", action="store_true",
+                    help="print ONE JSON object only")
+    ap.add_argument("--limit", type=int, default=5,
+                    help="timelines rendered (largest traces first; "
+                         "default 5)")
+    args = ap.parse_args(argv)
+    from paddle_tpu.observability import export, tracing
+    try:
+        events, files = export.iter_log_events(args.log)
+    except OSError as e:
+        raise SystemExit(f"trace: cannot read log: {e}")
+    traces = tracing.build_traces(events)
+    stats = tracing.span_stats(events)
+    if args.json:
+        print(json.dumps({
+            "files": files, "traces": len(traces), "span_stats": stats,
+            "critical_path": [
+                {"name": s["name"], "dur_ms": s.get("dur_ms")}
+                for s in tracing.critical_path(
+                    max(traces, key=lambda t: t["dur_ms"]))]
+            if traces else [],
+        }, default=repr), flush=True)
+        return 0
+    if not traces:
+        print("no spans in this log — run with observe on and a "
+              "metrics_log set", flush=True)
+        return 0
+    print(f"{len(traces)} trace(s), {sum(len(t['spans']) for t in traces)}"
+          f" span(s)", flush=True)
+    if len(files) > 1:
+        for f in files:
+            print(f"  restart boundary: {f['file']} ({f['events']} "
+                  f"event(s), from ts={f['t_first']})", flush=True)
+    print("\nby span name:", flush=True)
+    for name, s in stats.items():
+        print(f"  {name}: count={s['count']} p50={s['p50_ms']}ms "
+              f"p99={s['p99_ms']}ms max={s['max_ms']}ms "
+              f"total={s['total_ms']}ms", flush=True)
+    big = sorted(traces, key=lambda t: -t["dur_ms"])[:args.limit]
+    for t in big:
+        print("\n" + tracing.render_trace(t), flush=True)
+    longest = max(traces, key=lambda t: t["dur_ms"])
+    cp = tracing.critical_path(longest)
+    print("\ncritical path of the longest trace "
+          f"({longest['trace']}):", flush=True)
+    for s in cp:
+        print(f"  {s['name']} ({s.get('dur_ms', 0.0)} ms)", flush=True)
+    return 0
+
+
+def job_doctor(argv):
+    """Measured-vs-modeled step/request budget: where did the time go."""
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu doctor",
+        description="explain where the step (or request) time went: a "
+                    "budget decomposing the measured wall into compute / "
+                    "fetch / compile / staging / host-stall from the "
+                    "log's step events and spans, the top bottleneck "
+                    "with actionable hints, and — with --program — a "
+                    "cost-model calibration row (predicted vs measured, "
+                    "stored ratio for the planner; ROADMAP item 2).  "
+                    "Budget components reconcile with the measured wall "
+                    "within the pinned tolerance or the report says so.")
+    ap.add_argument("log", nargs="+", help="JSONL metrics log file(s)")
+    ap.add_argument("--program", default=None,
+                    help="Program.to_json file / __model__ meta / dir: "
+                         "confront the static cost model with this run")
+    ap.add_argument("--batch", type=int, default=64,
+                    help="batch assumed for symbolic -1 dims in the "
+                         "static model (default 64)")
+    ap.add_argument("--mesh", default=None,
+                    help="axis=size,... the measured run was sharded "
+                         "over (folds into the prediction)")
+    ap.add_argument("--calibration-out", default=None,
+                    help="merge the calibration row into this JSON "
+                         "table (keyed by program digest; the planner-"
+                         "consumable store)")
+    ap.add_argument("--json", action="store_true",
+                    help="print ONE JSON object only")
+    args = ap.parse_args(argv)
+    from paddle_tpu.observability import attribution
+    program = None
+    if args.program is not None:
+        program, _fetch = _load_check_target(args.program)
+    try:
+        report = attribution.doctor_report(
+            args.log, program=program, assume_batch=args.batch,
+            mesh_axes=_parse_mesh(args.mesh))
+    except OSError as e:
+        raise SystemExit(f"doctor: cannot read log: {e}")
+    if args.calibration_out and report.get("calibration"):
+        try:
+            attribution.save_calibration([report["calibration"]],
+                                         args.calibration_out)
+        except OSError as e:
+            raise SystemExit(
+                f"doctor: cannot write {args.calibration_out!r}: {e}")
+    if not args.json:
+        print(attribution.render_doctor(report), flush=True)
+    print(json.dumps(report, default=repr), flush=True)
     return 0
 
 
@@ -601,6 +736,12 @@ def main(argv=None):
         return job_plan(argv[1:])
     if argv and argv[0] == "stats":
         return job_stats(argv[1:])
+    if argv and argv[0] == "trace":
+        return job_trace(argv[1:])
+    if argv and argv[0] == "doctor":
+        # lazy: the attribution engine pulls analysis.cost_model — only
+        # the doctor pays for it
+        return job_doctor(argv[1:])
     if argv and argv[0] == "tune":
         # lazy: `import paddle_tpu` must never pull the tuning package
         # (zero-cost-when-unused guard, tier-1 enforced)
@@ -617,13 +758,18 @@ def main(argv=None):
                     "prog.json|__model__|dir` runs the static program "
                     "verifier, `paddle_tpu plan prog.json --mesh dp=8` "
                     "proposes auto-sharding specs with a static cost "
-                    "breakdown, `paddle_tpu stats run.jsonl` summarizes "
-                    "an observability metrics log, `paddle_tpu tune "
-                    "<target>` searches and persists autotuner winners, "
-                    "and `paddle_tpu serve --model dir` runs the "
-                    "batching inference server over exported artifacts "
-                    "(see `paddle_tpu check|plan|stats|tune|serve "
-                    "--help`).")
+                    "breakdown, `paddle_tpu stats run.jsonl...` "
+                    "summarizes observability metrics logs (--prom for "
+                    "Prometheus exposition), `paddle_tpu trace "
+                    "run.jsonl...` renders span timelines and critical "
+                    "paths, `paddle_tpu doctor run.jsonl... [--program "
+                    "prog.json]` explains where the step/request time "
+                    "went and calibrates the cost model, `paddle_tpu "
+                    "tune <target>` searches and persists autotuner "
+                    "winners, and `paddle_tpu serve --model dir` runs "
+                    "the batching inference server over exported "
+                    "artifacts (see `paddle_tpu "
+                    "check|plan|stats|trace|doctor|tune|serve --help`).")
     ap.add_argument("--config", required=True, help="v1 config file")
     ap.add_argument("--job", default="train",
                     choices=["train", "test", "time", "checkgrad"])

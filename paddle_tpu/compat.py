@@ -25,7 +25,8 @@ from typing import Optional
 import jax
 from jax import lax
 
-__all__ = ["shard_map", "axis_size", "pvary", "manual_axes"]
+__all__ = ["shard_map", "axis_size", "pvary", "manual_axes",
+           "executable_cost_analysis", "executable_memory_analysis"]
 
 
 def shard_map(f, mesh, in_specs, out_specs, axis_names=None, check_vma=None):
@@ -102,3 +103,56 @@ def manual_axes() -> Optional[frozenset]:
     except (ImportError, AttributeError):
         pass
     return None
+
+
+def executable_cost_analysis(compiled) -> Optional[dict]:
+    """XLA cost analysis of a compiled executable, normalized to one flat
+    ``{"flops": ..., "bytes_accessed": ..., ...}`` dict.
+
+    The surface drifted across jax releases: ``Compiled.cost_analysis()``
+    returns a list with one dict per partition on the 0.4.x line and a
+    bare dict on newer jax; some backends (and serialized-executable
+    reloads) raise or return nothing.  ``None`` means "unavailable" —
+    callers fall back to the static cost model, never crash.
+    """
+    fn = getattr(compiled, "cost_analysis", None)
+    if fn is None:
+        return None
+    try:
+        ca = fn()
+    except Exception:   # backend without the analysis API
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict) or not ca:
+        return None
+    out = {}
+    for k in ("flops", "transcendentals", "bytes accessed",
+              "bytes_accessed", "optimal_seconds"):
+        v = ca.get(k)
+        if isinstance(v, (int, float)):
+            out[k.replace(" ", "_")] = float(v)
+    return out or None
+
+
+def executable_memory_analysis(compiled) -> Optional[dict]:
+    """``Compiled.memory_analysis()`` normalized to plain ints (the
+    return type is an opaque ``CompiledMemoryStats`` on this jax line, a
+    dict-like on others).  ``None`` when unavailable."""
+    fn = getattr(compiled, "memory_analysis", None)
+    if fn is None:
+        return None
+    try:
+        ma = fn()
+    except Exception:   # backend without the analysis API
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for k in ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "generated_code_size_in_bytes",
+              "alias_size_in_bytes"):
+        v = getattr(ma, k, None) if not isinstance(ma, dict) else ma.get(k)
+        if isinstance(v, (int, float)):
+            out[k] = int(v)
+    return out or None

@@ -28,6 +28,7 @@ import logging
 import time
 import warnings
 import weakref
+from contextlib import nullcontext
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -716,7 +717,8 @@ class Executor:
     def _record_dispatch(self, path: str, fp: Optional[str], steps: int,
                          wall_s: float, fetch_block_s: float,
                          feed_arrays: Dict[str, object], stacked: bool,
-                         compile_before: Optional[Dict[str, int]] = None):
+                         compile_before: Optional[Dict[str, int]] = None,
+                         span=None):
         """Registry writes + JSONL step event for one compiled dispatch.
         Only reached when _observing() — the off path never touches the
         registry (counter-delta tier-1 assertion).
@@ -764,19 +766,25 @@ class Executor:
             fetch_block_ms=round(fetch_block_s * 1e3, 3),
             examples_per_sec=round(examples_per_s, 2)
             if examples_per_s else None,
-            label=self._observe_label() or None)
+            label=self._observe_label() or None,
+            # join key into the span tree: the step event IS the
+            # executor/step span's quantitative payload
+            trace=span.trace_id if span is not None else None,
+            span=span.span_id if span is not None else None)
 
-    def _dispatch(self, fn, feed_arrays, state, step, path: str):
+    def _dispatch(self, fn, feed_arrays, state, step, path: str,
+                  trace_span=None):
         """One compiled-step dispatch through the fault-tolerance rim.
 
         With no retry policy and fault injection off this is a direct
         call (the zero-overhead off path).  Otherwise: the
         ``executor.dispatch`` injection site fires inside the retried
         region, retryable failures back off per the policy (counting
-        ``fault/retries`` + emitting JSONL fault events), and retrying is
-        refused once any state buffer has been donated away by a failed
-        attempt — re-running on deleted buffers would turn a transient
-        hiccup into undefined behavior.
+        ``fault/retries`` + emitting JSONL fault events, and attaching a
+        ``retry`` event to the dispatch span when tracing), and retrying
+        is refused once any state buffer has been donated away by a
+        failed attempt — re-running on deleted buffers would turn a
+        transient hiccup into undefined behavior.
         """
         policy = self.retry_policy
         if policy is None and not _fi.ENABLED:
@@ -808,6 +816,10 @@ class Executor:
                            site="executor.dispatch", step=int(step),
                            attempt=i + 1, delay_s=round(d, 4),
                            error=f"{type(e).__name__}: {e}")
+            if trace_span is not None:
+                trace_span.event("retry", attempt=i + 1,
+                                 delay_s=round(d, 4),
+                                 error=f"{type(e).__name__}: {e}")
 
         return _faults.retry_call(attempt, policy,
                                   what=f"dispatch {path}",
@@ -883,47 +895,65 @@ class Executor:
         obs_on = self._observing()
         t_start = time.perf_counter() if obs_on else 0.0
         c0 = compile_cache.stats().snapshot() if obs_on else None
+        sp = obs.tracing.start_span(
+            "executor/step", path="run", steps=1,
+            fingerprint=(fp or "")[:12]) if obs_on else None
         step = self._step
         self._step += 1
-        if obs_on:
-            with jax.profiler.StepTraceAnnotation("paddle_tpu/step",
-                                                  step_num=step), \
-                    jax.profiler.TraceAnnotation(
-                        self._trace_name("run", fp)):
-                fetches, new_state = self._dispatch(fn, feed_arrays, state,
-                                                    step, "run")
-        else:
-            fetches, new_state = self._dispatch(fn, feed_arrays, state,
-                                                step, "run")
+        try:
+            if obs_on:
+                with jax.profiler.StepTraceAnnotation("paddle_tpu/step",
+                                                      step_num=step), \
+                        jax.profiler.TraceAnnotation(
+                            self._trace_name("run", fp)), \
+                        obs.tracing.span("executor/dispatch",
+                                         parent=sp) as dsp:
+                    fetches, new_state = self._dispatch(
+                        fn, feed_arrays, state, step, "run",
+                        trace_span=dsp)
+            else:
+                fetches, new_state = self._dispatch(fn, feed_arrays,
+                                                    state, step, "run")
 
-        finite_map = None
-        if self.check_nan_inf and fetches and isinstance(fetches[-1], dict):
-            finite_map = fetches[-1]
-            fetches = fetches[:-1]
+            finite_map = None
+            if self.check_nan_inf and fetches \
+                    and isinstance(fetches[-1], dict):
+                finite_map = fetches[-1]
+                fetches = fetches[:-1]
 
-        for k, v in new_state.items():
-            scope.set(k, v)
+            for k, v in new_state.items():
+                scope.set(k, v)
 
-        if self.check_nan_inf:
-            try:
-                if finite_map is not None:
-                    self._nan_localize(program, finite_map)
-                self._nan_check(fetch_names, fetches)
-            except FloatingPointError as e:
-                raise self._nan_diagnose(program, feed_arrays, state,
-                                         step, is_test, e) from e
+            if self.check_nan_inf:
+                try:
+                    if finite_map is not None:
+                        self._nan_localize(program, finite_map)
+                    self._nan_check(fetch_names, fetches)
+                except FloatingPointError as e:
+                    raise self._nan_diagnose(program, feed_arrays, state,
+                                             step, is_test, e) from e
 
-        t_fetch = time.perf_counter() if obs_on else 0.0
-        if return_numpy:
-            fetches = [np.asarray(f) if f is not None else None
-                       for f in fetches]
+            t_fetch = time.perf_counter() if obs_on else 0.0
+            if return_numpy:
+                with (obs.tracing.span("executor/fetch_block", parent=sp)
+                      if sp is not None else nullcontext()):
+                    fetches = [np.asarray(f) if f is not None else None
+                               for f in fetches]
+        except BaseException as e:
+            # a FAILED step is exactly what a trace must explain: end
+            # the root with the typed status so its dispatch child (and
+            # any retry events) are not an orphaned fragment
+            if sp is not None:
+                sp.end(status=type(e).__name__)
+            raise
         if obs_on:
             now = time.perf_counter()
+            sp.end()
             self._record_dispatch("run", fp, steps=1,
                                   wall_s=now - t_start,
                                   fetch_block_s=now - t_fetch,
                                   feed_arrays=feed_arrays, stacked=False,
-                                  compile_before=c0)
+                                  compile_before=c0, span=sp)
         return fetches
 
     def run_steps(self, num_steps: int,
@@ -997,33 +1027,49 @@ class Executor:
         obs_on = self._observing()
         t_start = time.perf_counter() if obs_on else 0.0
         c0 = compile_cache.stats().snapshot() if obs_on else None
+        sp = obs.tracing.start_span(
+            "executor/step", path="run_steps", steps=num_steps,
+            fingerprint=(fp or "")[:12]) if obs_on else None
         step0 = self._step
         self._step += num_steps
-        if obs_on:
-            with jax.profiler.StepTraceAnnotation("paddle_tpu/dispatch",
-                                                  step_num=step0), \
-                    jax.profiler.TraceAnnotation(
-                        self._trace_name("run_steps", fp)):
-                fetches, new_state = self._dispatch(jfn, feed_arrays, state,
-                                                    step0, "run_steps")
-        else:
-            fetches, new_state = self._dispatch(jfn, feed_arrays, state,
-                                                step0, "run_steps")
-        fetches = list(fetches)
-        for k, v in new_state.items():
-            scope.set(k, v)
-        t_fetch = time.perf_counter() if obs_on else 0.0
-        if return_numpy:
-            fetches = [np.asarray(f) if f is not None else None
-                       for f in fetches]
+        try:
+            if obs_on:
+                with jax.profiler.StepTraceAnnotation(
+                        "paddle_tpu/dispatch", step_num=step0), \
+                        jax.profiler.TraceAnnotation(
+                            self._trace_name("run_steps", fp)), \
+                        obs.tracing.span("executor/dispatch",
+                                         parent=sp) as dsp:
+                    fetches, new_state = self._dispatch(
+                        jfn, feed_arrays, state, step0, "run_steps",
+                        trace_span=dsp)
+            else:
+                fetches, new_state = self._dispatch(
+                    jfn, feed_arrays, state, step0, "run_steps")
+            fetches = list(fetches)
+            for k, v in new_state.items():
+                scope.set(k, v)
+            t_fetch = time.perf_counter() if obs_on else 0.0
+            if return_numpy:
+                with (obs.tracing.span("executor/fetch_block", parent=sp)
+                      if sp is not None else nullcontext()):
+                    fetches = [np.asarray(f) if f is not None else None
+                               for f in fetches]
+        except BaseException as e:
+            # see run(): a failed dispatch must not leave an orphaned
+            # dispatch child — the root span ends with the typed status
+            if sp is not None:
+                sp.end(status=type(e).__name__)
+            raise
         if obs_on:
             now = time.perf_counter()
+            sp.end()
             self._record_dispatch("run_steps", fp, steps=num_steps,
                                   wall_s=now - t_start,
                                   fetch_block_s=now - t_fetch,
                                   feed_arrays=feed_arrays,
                                   stacked=feeds_stacked,
-                                  compile_before=c0)
+                                  compile_before=c0, span=sp)
         return fetches
 
     def run_pipelined(self, feed_iter,
@@ -1088,29 +1134,43 @@ class Executor:
                 f"run_pipelined: steps_per_dispatch must be >= 1, got {K}")
 
         # resolved once: the staging worker and the queue instrumentation
-        # below run for this generator's whole lifetime
+        # below run for this generator's whole lifetime.  The root span
+        # ties the whole causal chain into ONE trace: staging-worker
+        # spans parent to it explicitly (cross-thread), and each
+        # consuming run/run_steps call attaches it so the executor/step
+        # spans nest under it.
         obs_on = self._observing()
+        root = obs.tracing.start_span(
+            "executor/run_pipelined", steps_per_dispatch=K,
+            prefetch_depth=int(prefetch_depth)) if obs_on else None
 
         def staged():
             """Chunks of the feed stream, already device-resident."""
             def ship_scan(pend):
-                t0 = time.perf_counter() if obs_on else 0.0
-                dev = {k: jax.device_put(v)
-                       for k, v in stack_feeds(pend).items()}
-                if obs_on:
-                    obs.observe_hist("executor/stage_put_ms",
-                                     (time.perf_counter() - t0) * 1e3)
+                with (obs.tracing.span("pipeline/stage", kind="scan",
+                                       steps=len(pend))
+                      if obs_on else nullcontext()):
+                    t0 = time.perf_counter() if obs_on else 0.0
+                    dev = {k: jax.device_put(v)
+                           for k, v in stack_feeds(pend).items()}
+                    if obs_on:
+                        obs.observe_hist("executor/stage_put_ms",
+                                         (time.perf_counter() - t0) * 1e3)
                 return ("scan", dev, len(pend))
 
             def ship_singles(pend):
                 for feed in pend:
-                    t0 = time.perf_counter() if obs_on else 0.0
-                    dev = {k: v if isinstance(v, jax.Array)
-                           else jax.device_put(np.asarray(v))
-                           for k, v in feed.items()}
-                    if obs_on:
-                        obs.observe_hist("executor/stage_put_ms",
-                                         (time.perf_counter() - t0) * 1e3)
+                    with (obs.tracing.span("pipeline/stage",
+                                           kind="single", steps=1)
+                          if obs_on else nullcontext()):
+                        t0 = time.perf_counter() if obs_on else 0.0
+                        dev = {k: v if isinstance(v, jax.Array)
+                               else jax.device_put(np.asarray(v))
+                               for k, v in feed.items()}
+                        if obs_on:
+                            obs.observe_hist(
+                                "executor/stage_put_ms",
+                                (time.perf_counter() - t0) * 1e3)
                     yield ("single", dev, 1)
 
             pend, sig = [], None
@@ -1131,26 +1191,40 @@ class Executor:
 
         staged_reader = _prefetch(staged,
                                   buffer_size=max(1, int(prefetch_depth)),
-                                  num_workers=1, instrument=obs_on)
-        for kind, dev, n in staged_reader():
-            if kind == "scan":
-                outs = self.run_steps(
-                    n, program, feed=dev, fetch_list=fetch_list,
-                    scope=scope, return_numpy=return_numpy,
-                    is_test=is_test, feeds_stacked=True)
-                for i in range(n):
-                    yield [o[i] if o is not None else None for o in outs]
-            else:
-                # per-step fallback: stream tail, or a partially-filled
-                # stack flushed by a padding-bucket signature change —
-                # visible in telemetry so a bucketing mistake that
-                # degrades every dispatch to singles is diagnosable
-                # (K=1 dispatches singles by design: not a fallback)
-                if obs_on and K > 1:
-                    obs.inc_counter("pipeline/fallback_steps")
-                yield self.run(program, feed=dev, fetch_list=fetch_list,
-                               scope=scope, return_numpy=return_numpy,
-                               is_test=is_test)
+                                  num_workers=1, instrument=obs_on,
+                                  trace_parent=root)
+        try:
+            for kind, dev, n in staged_reader():
+                if kind == "scan":
+                    with (obs.tracing.attach(root) if root is not None
+                          else nullcontext()):
+                        outs = self.run_steps(
+                            n, program, feed=dev, fetch_list=fetch_list,
+                            scope=scope, return_numpy=return_numpy,
+                            is_test=is_test, feeds_stacked=True)
+                    for i in range(n):
+                        yield [o[i] if o is not None else None
+                               for o in outs]
+                else:
+                    # per-step fallback: stream tail, or a partially-
+                    # filled stack flushed by a padding-bucket signature
+                    # change — visible in telemetry so a bucketing
+                    # mistake that degrades every dispatch to singles is
+                    # diagnosable (K=1 dispatches singles by design:
+                    # not a fallback)
+                    if obs_on and K > 1:
+                        obs.inc_counter("pipeline/fallback_steps")
+                    with (obs.tracing.attach(root) if root is not None
+                          else nullcontext()):
+                        out = self.run(program, feed=dev,
+                                       fetch_list=fetch_list,
+                                       scope=scope,
+                                       return_numpy=return_numpy,
+                                       is_test=is_test)
+                    yield out
+        finally:
+            if root is not None:
+                root.end()
 
     def _make_multi(self, program: Program, fetch_names: List[str],
                     is_test: bool, num_steps: int, feeds_stacked: bool):

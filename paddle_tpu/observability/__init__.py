@@ -11,7 +11,14 @@ execution path:
   histograms with fixed buckets) behind the frozen ``METRIC_NAMES`` table;
 * :mod:`.export` — JSONL structured event log (``PADDLE_TPU_METRICS_LOG``),
   ``metrics_snapshot()``, device-memory sampling, ``log_period`` periodic
-  reports, and the ``python -m paddle_tpu stats`` summarizer;
+  reports, multi-file log merging, Prometheus text exposition, and the
+  ``python -m paddle_tpu stats`` summarizer;
+* :mod:`.tracing` — structured spans (frozen ``SPAN_NAMES``) across the
+  reader → staging → dispatch → fetch and serving request chains, with
+  the ``python -m paddle_tpu trace`` timeline/critical-path engine;
+* :mod:`.attribution` — the measured-vs-modeled ``doctor``: step/request
+  budgets, compiled-executable facts, cost-model calibration (imported
+  LAZILY — it pulls analysis.cost_model; repo-lint enforced);
 * :mod:`.nanprov` — eager per-op bisect of a ``check_nan_inf`` failure.
 
 Producers: ``Executor.run/run_steps/run_pipelined`` (per-step wall time,
@@ -28,15 +35,20 @@ computation (tier-1 asserts both — no counter deltas, no retraces).
 """
 from .metrics import (METRIC_NAMES, MetricsRegistry, enabled, inc_counter,
                       observe_hist, registry, set_gauge)
-from .export import (emit_event, log_path, maybe_periodic_report,
-                     metrics_snapshot, periodic_report,
-                     sample_device_memory, summarize_log)
+from .export import (emit_event, iter_log_events, log_path,
+                     maybe_periodic_report, metrics_snapshot,
+                     periodic_report, sample_device_memory, summarize_log,
+                     summarize_logs, to_prometheus)
+from . import tracing
+from .tracing import SPAN_NAMES
 
 __all__ = [
     "METRIC_NAMES", "MetricsRegistry", "registry", "enabled",
     "inc_counter", "set_gauge", "observe_hist",
     "emit_event", "log_path", "metrics_snapshot", "sample_device_memory",
     "periodic_report", "maybe_periodic_report", "summarize_log",
+    "summarize_logs", "iter_log_events", "to_prometheus",
+    "tracing", "SPAN_NAMES",
     "report",
 ]
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -29,6 +30,8 @@ logger = logging.getLogger("paddle_tpu")
 __all__ = [
     "log_path", "emit_event", "metrics_snapshot", "sample_device_memory",
     "periodic_report", "maybe_periodic_report", "summarize_log",
+    "summarize_logs", "iter_log_events", "to_prometheus", "prom_name",
+    "metric_name_from_prom",
 ]
 
 
@@ -191,57 +194,131 @@ def maybe_periodic_report(iters_done: int,
 
 
 # ---------------------------------------------------------------------------
+# Log reading (shared by the `stats` / `trace` / `doctor` engines)
+# ---------------------------------------------------------------------------
+def iter_log_events(paths) -> "tuple[List[dict], List[dict]]":
+    """Read one or more JSONL logs, merged in time order.
+
+    A supervised run that resumed after SIGTERM/exit-75 produces one log
+    per relaunch; summaries should span the whole job, so every CLI
+    consumer accepts multiple files.  Returns ``(events, files)`` where
+    ``files`` records per-file boundaries (path, first/last ts, event and
+    corrupt-line counts) — the restart markers the timeline renders.
+
+    Robustness (the chaos suite's SIGKILL mid-write case): a torn or
+    truncated final line — including one cut inside a multi-byte UTF-8
+    character — is skipped and counted, never fatal (``errors="replace"``
+    keeps the read itself from raising ``UnicodeDecodeError``).  Raises
+    OSError only for an unreadable file (the CLI wraps it).
+    """
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    events: List[dict] = []
+    files: List[dict] = []
+    for path in paths:
+        n = corrupt = 0
+        t_first = t_last = None
+        with open(path, errors="replace") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                n += 1
+                try:
+                    ev = json.loads(line)
+                    if not isinstance(ev, dict):
+                        raise json.JSONDecodeError("not an object", line, 0)
+                except json.JSONDecodeError:
+                    corrupt += 1
+                    continue
+                ts = ev.get("ts")
+                if isinstance(ts, (int, float)) \
+                        and not isinstance(ts, bool):
+                    t_first = ts if t_first is None else t_first
+                    t_last = ts
+                else:
+                    # every writer stamps a numeric ts; a foreign/hand-
+                    # edited line with a missing or string ts must stay
+                    # mergeable (the sort key is numeric), not crash the
+                    # summary — coerce to the file position
+                    ev = {**ev, "ts": t_last if t_last is not None
+                          else 0.0}
+                events.append(ev)
+        if corrupt:
+            logger.warning("metrics log %r: skipped %d corrupt/truncated "
+                           "line(s) (torn writes from a killed process "
+                           "are expected; the summary continues)",
+                           str(path), corrupt)
+        files.append({"file": str(path), "events": n,
+                      "corrupt_lines": corrupt,
+                      "t_first": t_first, "t_last": t_last})
+    if len(files) > 1:
+        files.sort(key=lambda f: (f["t_first"] is None,
+                                  f["t_first"] or 0.0))
+        events.sort(key=lambda e: e.get("ts", 0.0))
+    return events, files
+
+
+# ---------------------------------------------------------------------------
 # Log summarization (the `python -m paddle_tpu stats` engine)
 # ---------------------------------------------------------------------------
 def summarize_log(path: str) -> dict:
-    """Aggregate a JSONL metrics log into one run summary dict.
+    """Aggregate ONE JSONL metrics log into a run summary dict (see
+    :func:`summarize_logs` for the multi-file / resumed-job form)."""
+    return summarize_logs([path])
 
-    Tolerates corrupt lines (counted, not fatal); raises OSError for an
-    unreadable file (the CLI wraps it)."""
+
+def summarize_logs(paths) -> dict:
+    """Aggregate one or more JSONL metrics logs (merged in time order —
+    a resumed job's per-relaunch logs summarize as one run) into one
+    summary dict.  Tolerates corrupt/torn lines (counted, not fatal);
+    raises OSError for an unreadable file (the CLI wraps it)."""
+    events, files = iter_log_events(paths)
     steps: List[dict] = []
     nans: List[dict] = []
     faults: List[dict] = []
     servings: List[dict] = []
     tunings: List[dict] = []
+    spans = 0
     last_snapshot: Optional[dict] = None
-    snapshots = corrupt = total = 0
+    snapshots = 0
     t_first = t_last = None
-    with open(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            total += 1
-            try:
-                ev = json.loads(line)
-            except json.JSONDecodeError:
-                corrupt += 1
-                continue
-            ts = ev.get("ts")
-            if isinstance(ts, (int, float)):
-                t_first = ts if t_first is None else t_first
-                t_last = ts
-            kind = ev.get("kind")
-            if kind == "step":
-                steps.append(ev)
-            elif kind == "snapshot":
-                snapshots += 1
-                last_snapshot = ev
-            elif kind == "nan":
-                nans.append(ev)
-            elif kind == "fault":
-                faults.append(ev)
-            elif kind == "serving":
-                servings.append(ev)
-            elif kind == "tuning":
-                tunings.append(ev)
+    for ev in events:
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)):
+            t_first = ts if t_first is None else min(t_first, ts)
+            t_last = ts if t_last is None else max(t_last, ts)
+        kind = ev.get("kind")
+        if kind == "step":
+            steps.append(ev)
+        elif kind == "snapshot":
+            snapshots += 1
+            last_snapshot = ev
+        elif kind == "nan":
+            nans.append(ev)
+        elif kind == "fault":
+            faults.append(ev)
+        elif kind == "serving":
+            servings.append(ev)
+        elif kind == "tuning":
+            tunings.append(ev)
+        elif kind == "span":
+            spans += 1
 
+    total = sum(f["events"] for f in files)
+    corrupt = sum(f["corrupt_lines"] for f in files)
     summary: dict = {
         "events": total, "corrupt_lines": corrupt,
         "snapshots": snapshots, "nan_events": len(nans),
+        "spans": spans,
         "wall_s": round(t_last - t_first, 3)
         if t_first is not None and t_last is not None else None,
     }
+    if len(files) > 1:
+        # restart boundaries: where each relaunch's log begins
+        summary["restarts"] = [
+            {"file": f["file"], "ts": f["t_first"], "events": f["events"]}
+            for f in files]
     if steps:
         n_steps = sum(int(e.get("steps", 1)) for e in steps)
         # cold dispatches (trace/compile happened inside the call) carry
@@ -360,9 +437,13 @@ def render_summary(summary: dict) -> str:
     lines = [f"events={summary['events']} "
              f"snapshots={summary['snapshots']} "
              f"nan_events={summary['nan_events']} "
+             f"spans={summary.get('spans', 0)} "
              f"corrupt_lines={summary['corrupt_lines']}"
              + (f" wall_s={summary['wall_s']}"
                 if summary.get("wall_s") is not None else "")]
+    for r in summary.get("restarts", []):
+        lines.append(f"  restart boundary: {r['file']} "
+                     f"({r['events']} event(s), from ts={r['ts']})")
     st = summary.get("steps")
     if st:
         lines.append(
@@ -422,3 +503,108 @@ def render_summary(summary: dict) -> str:
         for r in tu["replays"]:
             lines.append(f"  replay: {r['tunable']} -> {r['config']}")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (scrape without a new dependency)
+# ---------------------------------------------------------------------------
+_PROM_PREFIX = "paddle_tpu_"
+
+
+def prom_name(name: str) -> str:
+    """``executor/step_time_ms`` -> ``paddle_tpu_executor_step_time_ms``.
+
+    Reversible because metric SUBSYSTEMS (the part before ``/``) never
+    contain underscores — pinned by the round-trip test against
+    METRIC_NAMES, so a future subsystem cannot silently break scraping.
+    """
+    return _PROM_PREFIX + name.replace("/", "_")
+
+
+def metric_name_from_prom(prom: str) -> str:
+    """Inverse of :func:`prom_name` (accepts the ``_total`` counter
+    suffix the exposition appends)."""
+    if not prom.startswith(_PROM_PREFIX):
+        raise ValueError(f"not a paddle_tpu prometheus name: {prom!r}")
+    body = prom[len(_PROM_PREFIX):]
+    if body.endswith("_total"):
+        body = body[:-len("_total")]
+    sub, sep, rest = body.partition("_")
+    if not sep:
+        raise ValueError(f"unsplittable prometheus name: {prom!r}")
+    return f"{sub}/{rest}"
+
+
+def _prom_escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _prom_num(v: float) -> str:
+    """Full-precision sample formatting: ``%g``'s 6 significant digits
+    would quantize large counters (feed_bytes at 3.2e9 stops moving
+    between scrapes and rate() reads zero)."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 2 ** 63:
+        return str(int(f))
+    return f"{f:.17g}"
+
+
+def to_prometheus(snapshot: Optional[dict] = None) -> str:
+    """Prometheus text exposition of a metrics snapshot.
+
+    ``snapshot``: a :func:`metrics_snapshot` dict (its ``compile``
+    counters are exposed as gauges too), a bare registry snapshot
+    (``{name: metric-snap}``), or None for the live registry — so a
+    serving deployment can scrape via ``python -m paddle_tpu stats
+    <log> --prom`` or an in-process HTTP handler, with no new
+    dependency.  Counters gain the conventional ``_total`` suffix;
+    histograms expose cumulative ``_bucket``/``_sum``/``_count``.
+    """
+    compile_counters: Dict[str, float] = {}
+    if snapshot is None:
+        metrics = _metrics.registry().snapshot()
+    elif "metrics" in snapshot and isinstance(snapshot["metrics"], dict):
+        metrics = snapshot["metrics"]
+        for k, v in (snapshot.get("compile") or {}).items():
+            # "compile/hits" -> paddle_tpu_compile_hits (gauge)
+            if isinstance(v, (int, float)):
+                compile_counters[k] = float(v)
+    else:
+        metrics = snapshot
+    helps = {n: h for n, _k, h in _metrics.METRIC_NAMES}
+    lines: List[str] = []
+    for name, snap in sorted(metrics.items()):
+        base = prom_name(name)
+        help_ = helps.get(name, "")
+        kind = snap.get("kind")
+        if kind == "counter":
+            # HELP/TYPE on the _total name: in the classic text format
+            # only histograms/summaries get suffix grace, so metadata on
+            # the bare base would orphan the sample's family
+            lines.append(f"# HELP {base}_total {_prom_escape(help_)}")
+            lines.append(f"# TYPE {base}_total counter")
+            lines.append(f"{base}_total {_prom_num(snap['value'])}")
+        elif kind == "gauge":
+            if not snap["values"]:
+                continue
+            lines.append(f"# HELP {base} {_prom_escape(help_)}")
+            lines.append(f"# TYPE {base} gauge")
+            for label, v in sorted(snap["values"].items()):
+                sel = f'{{label="{_prom_escape(label)}"}}' if label else ""
+                lines.append(f"{base}{sel} {_prom_num(v)}")
+        elif kind == "histogram":
+            lines.append(f"# HELP {base} {_prom_escape(help_)}")
+            lines.append(f"# TYPE {base} histogram")
+            acc = 0
+            for edge, c in zip(snap["boundaries"], snap["counts"]):
+                acc += c
+                lines.append(f'{base}_bucket{{le="{edge:g}"}} {acc}')
+            lines.append(f'{base}_bucket{{le="+Inf"}} {snap["count"]}')
+            lines.append(f"{base}_sum {_prom_num(snap['sum'])}")
+            lines.append(f"{base}_count {snap['count']}")
+    for k, v in sorted(compile_counters.items()):
+        base = prom_name(k)
+        lines.append(f"# TYPE {base} gauge")
+        lines.append(f"{base} {_prom_num(v)}")
+    return "\n".join(lines) + "\n"

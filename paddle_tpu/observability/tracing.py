@@ -1,0 +1,352 @@
+"""Structured tracing spans: the causal-timeline half of observability.
+
+PR 5's metrics answer "how fast is it" (histograms, counters); this
+module answers "**why**" — every unit of work on the reader → staging →
+dispatch → fetch chain (and the serving admit → batch → dispatch → reply
+chain) emits one **span** record into the existing JSONL stream::
+
+    {"ts": ..., "kind": "span", "name": "executor/dispatch",
+     "trace": "t3f2a-1", "span": "3f2a-4", "parent": "3f2a-2",
+     "t0": <unix s>, "dur_ms": 12.4, "labels": {...}, "events": [...]}
+
+* **trace** — one causal tree: a serving request's lifecycle, or one
+  ``run_pipelined`` generator run with its staging and dispatch children.
+* **span** / **parent** — the tree edges.  Parent linkage is implicit
+  (a thread-local stack maintained by the :func:`span` context manager /
+  :func:`attach`) or explicit (``parent=`` for cross-thread children:
+  the staging worker's spans parent to the pipelined root; a serving
+  request's span starts on the submitting thread and ends on the
+  dispatcher thread).
+* **events** — point-in-time annotations riding inside a span (retry
+  attempts at the dispatch rims, circuit-breaker transitions), the
+  causal complement of the ``fault/*`` JSONL events.
+
+Span names are LITERAL members of the frozen :data:`SPAN_NAMES` table —
+the same discipline as ``metrics.METRIC_NAMES``, with the same repo-lint
+AST gate (``tests/test_repo_lint.py``): a typo'd span name is a test
+failure, not a silently orphaned timeline.
+
+**Zero overhead when off** is inherited from PR 5's contract: span
+creation sites are gated by their callers (``Executor._observing()``,
+the reader engine's ``instrument`` resolution), never here — with
+``observe`` off the hot paths construct no Span objects, write no
+metrics, emit no JSONL, and cannot retrace (tier-1 counter-delta +
+``retrace_guard`` assertions).  Emission itself is a no-op when no
+``metrics_log`` is set, so spans cost ~a dict build when observing
+without an export sink.
+
+``python -m paddle_tpu trace <log.jsonl>`` replays a log's spans into
+per-trace timelines, critical paths and per-name latency stats;
+:func:`build_traces` / :func:`span_stats` are the library form.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import export as _export
+
+__all__ = [
+    "SPAN_NAMES", "Span", "span", "start_span", "current_span",
+    "add_event", "attach", "ROOT",
+    "build_traces", "span_stats", "critical_path", "render_trace",
+]
+
+# ---------------------------------------------------------------------------
+# Frozen span-name registry.  (name, help) — names passed to span()/
+# start_span() MUST appear here as literals (AST-gated in
+# tests/test_repo_lint.py; duplicates rejected at import AND lint time).
+# ---------------------------------------------------------------------------
+SPAN_NAMES = (
+    ("executor/step", "one Executor.run / run_steps call end to end "
+     "(dispatch + state writeback + fetch materialization); labels: "
+     "path, steps, fingerprint"),
+    ("executor/dispatch", "the compiled-step call itself, inside the "
+     "fault-tolerance rim (retry attempts attach as span events)"),
+    ("executor/fetch_block", "host time materializing fetches to numpy "
+     "(the return_numpy conversion barrier)"),
+    ("executor/run_pipelined", "root of one pipelined generator run; "
+     "staging and dispatch spans are its children"),
+    ("pipeline/stage", "staging worker: stack_feeds + device_put for "
+     "one dispatch chunk (kind=scan) or one feed (kind=single)"),
+    ("reader/pipeline", "root of one prefetch/interleave engine run "
+     "(instrumented); per-item worker spans are its children"),
+    ("reader/item", "one worker-produced item: the source pull (decode/"
+     "feed build) up to the queue offer"),
+    ("serving/request", "request lifecycle admit -> terminal completion "
+     "(one trace per request; ends with status=ok or the typed error)"),
+    ("serving/batch", "one coalesced serving batch: staging pickup -> "
+     "dispatch -> reply; labels link member request ids and traces"),
+)
+
+_REGISTERED = tuple(n for n, _ in SPAN_NAMES)
+if len(set(_REGISTERED)) != len(_REGISTERED):      # pragma: no cover
+    raise ValueError("duplicate span name in SPAN_NAMES")
+_REGISTERED_SET = frozenset(_REGISTERED)
+
+# Sentinel parent: force a NEW root trace even when a thread-local span
+# is active (serving requests are one-trace-per-request by contract).
+ROOT = object()
+
+_ids = itertools.count(1)
+_prefix = f"{os.getpid() & 0xfffff:05x}"
+_tls = threading.local()
+
+
+def _next_id() -> str:
+    return f"{_prefix}-{next(_ids):x}"
+
+
+def current_span() -> Optional["Span"]:
+    """Innermost span attached to THIS thread (via :func:`span` /
+    :func:`attach`), or None."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+class Span:
+    """One timed unit of work.  Construct via :func:`start_span` (or the
+    :func:`span` context manager); finish exactly once with :meth:`end`
+    — which emits the JSONL record — or discard with :meth:`cancel`."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "t0",
+                 "labels", "events", "_t0_perf", "_done")
+
+    def __init__(self, name: str, trace_id: str, parent_id: Optional[str],
+                 labels: Dict[str, object]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _next_id()
+        self.parent_id = parent_id
+        self.t0 = time.time()
+        self._t0_perf = time.perf_counter()
+        self.labels = labels
+        self.events: List[dict] = []
+        self._done = False
+
+    def event(self, name: str, **fields):
+        """Attach a point-in-time event (retry, breaker transition) to
+        this span; rides inside the span's JSONL record."""
+        if self._done:
+            return
+        self.events.append({"name": str(name),
+                            "ts": round(time.time(), 6), **fields})
+
+    def end(self, **labels):
+        """Finish the span and emit its record (idempotent)."""
+        if self._done:
+            return
+        self._done = True
+        dur_ms = (time.perf_counter() - self._t0_perf) * 1e3
+        if labels:
+            self.labels = {**self.labels, **labels}
+        payload = {"name": self.name, "trace": self.trace_id,
+                   "span": self.span_id, "parent": self.parent_id,
+                   "t0": round(self.t0, 6), "dur_ms": round(dur_ms, 3)}
+        if self.labels:
+            payload["labels"] = self.labels
+        if self.events:
+            payload["events"] = self.events
+        _export.emit_event("span", **payload)
+
+    def cancel(self):
+        """Discard without emitting (e.g. the reader's final empty pull)."""
+        self._done = True
+
+    def __repr__(self):
+        return (f"Span({self.name!r}, trace={self.trace_id}, "
+                f"span={self.span_id}, parent={self.parent_id})")
+
+
+def start_span(name: str, parent=None, **labels) -> Span:
+    """Begin a span.  ``parent``: another :class:`Span` (cross-thread
+    linkage), :data:`ROOT` (force a new trace), or None (the calling
+    thread's current span, else a new trace).  Labels must be
+    JSON-serializable."""
+    if name not in _REGISTERED_SET:
+        raise KeyError(
+            f"unknown span name {name!r}; span names are frozen in "
+            f"observability.tracing.SPAN_NAMES — add it there (the repo "
+            f"lint enforces literal, registered names)")
+    if parent is None:
+        parent = current_span()
+    elif parent is ROOT:
+        parent = None
+    if parent is None:
+        trace_id, parent_id = "t" + _next_id(), None
+    else:
+        trace_id, parent_id = parent.trace_id, parent.span_id
+    return Span(name, trace_id, parent_id, labels)
+
+
+class _SpanContext:
+    """``with span(...)``: pushes onto the thread-local stack, ends the
+    span on exit.  Also usable around a yield-free region only —
+    generators should hold a Span and use :func:`attach` per resume."""
+
+    __slots__ = ("_sp",)
+
+    def __init__(self, sp: Span):
+        self._sp = sp
+
+    def __enter__(self) -> Span:
+        _tls.__dict__.setdefault("stack", []).append(self._sp)
+        return self._sp
+
+    def __exit__(self, *exc):
+        stack = getattr(_tls, "stack", None)
+        if stack and stack[-1] is self._sp:
+            stack.pop()
+        self._sp.end()
+        return False
+
+
+def span(name: str, parent=None, **labels) -> _SpanContext:
+    """Context manager: start a span, make it the thread's current span,
+    end it on exit."""
+    return _SpanContext(start_span(name, parent=parent, **labels))
+
+
+class _AttachContext:
+    __slots__ = ("_sp",)
+
+    def __init__(self, sp: Span):
+        self._sp = sp
+
+    def __enter__(self) -> Span:
+        _tls.__dict__.setdefault("stack", []).append(self._sp)
+        return self._sp
+
+    def __exit__(self, *exc):
+        stack = getattr(_tls, "stack", None)
+        if stack and stack[-1] is self._sp:
+            stack.pop()
+        return False
+
+
+def attach(sp: Span) -> _AttachContext:
+    """Make ``sp`` the thread's current span for a region WITHOUT ending
+    it on exit — how a long-lived root (a pipelined generator) parents
+    the spans created inside each resume."""
+    return _AttachContext(sp)
+
+
+def add_event(name: str, **fields):
+    """Attach an event to the calling thread's current span (no-op when
+    none is active)."""
+    sp = current_span()
+    if sp is not None:
+        sp.event(name, **fields)
+
+
+# ---------------------------------------------------------------------------
+# Trace reconstruction (the `python -m paddle_tpu trace` engine)
+# ---------------------------------------------------------------------------
+def build_traces(events) -> List[dict]:
+    """Group a log's span events into traces, time-ordered.
+
+    Returns ``[{"trace": id, "t0": s, "dur_ms": span-of-spans wall,
+    "spans": [span events sorted by t0], "roots": [...]}, ...]`` sorted
+    by first span start.  Span events missing ids are skipped.
+    """
+    by_trace: Dict[str, List[dict]] = {}
+    for e in events:
+        if e.get("kind") != "span":
+            continue
+        tid = e.get("trace")
+        if not tid or not e.get("span"):
+            continue
+        by_trace.setdefault(tid, []).append(e)
+    traces = []
+    for tid, spans in by_trace.items():
+        spans.sort(key=lambda e: (e.get("t0", 0.0), e.get("span", "")))
+        ids = {e["span"] for e in spans}
+        roots = [e for e in spans
+                 if not e.get("parent") or e["parent"] not in ids]
+        t0 = min(e.get("t0", 0.0) for e in spans)
+        t1 = max(e.get("t0", 0.0) + e.get("dur_ms", 0.0) / 1e3
+                 for e in spans)
+        traces.append({"trace": tid, "t0": t0,
+                       "dur_ms": round((t1 - t0) * 1e3, 3),
+                       "spans": spans, "roots": roots})
+    traces.sort(key=lambda t: t["t0"])
+    return traces
+
+
+def span_stats(events) -> Dict[str, dict]:
+    """Per-span-name latency stats over a log: count, total, p50/p99/max
+    of dur_ms."""
+    durs: Dict[str, List[float]] = {}
+    for e in events:
+        if e.get("kind") == "span" and e.get("name"):
+            durs.setdefault(e["name"], []).append(float(e.get("dur_ms", 0.0)))
+    out = {}
+    for name, ds in sorted(durs.items()):
+        ds.sort()
+        n = len(ds)
+        out[name] = {
+            "count": n, "total_ms": round(sum(ds), 3),
+            "p50_ms": round(ds[n // 2], 3),
+            "p99_ms": round(ds[min(n - 1, int(n * 0.99))], 3),
+            "max_ms": round(ds[-1], 3),
+        }
+    return out
+
+
+def critical_path(trace: dict) -> List[dict]:
+    """Longest root→leaf chain by end time: from the latest-ending root,
+    repeatedly descend into the child whose end time is latest.  The
+    chain names where a trace's wall clock actually went."""
+    spans = trace["spans"]
+    children: Dict[str, List[dict]] = {}
+    for e in spans:
+        if e.get("parent"):
+            children.setdefault(e["parent"], []).append(e)
+
+    def end(e):
+        return e.get("t0", 0.0) + e.get("dur_ms", 0.0) / 1e3
+
+    path = []
+    roots = trace["roots"] or spans[:1]
+    node = max(roots, key=end, default=None)
+    seen = set()
+    while node is not None and node["span"] not in seen:
+        seen.add(node["span"])
+        path.append(node)
+        kids = children.get(node["span"], [])
+        node = max(kids, key=end, default=None)
+    return path
+
+
+def render_trace(trace: dict, max_spans: int = 40) -> str:
+    """Indented timeline of one trace: offset from trace start, name,
+    duration, labels; children nest under parents."""
+    spans = trace["spans"]
+    by_id = {e["span"]: e for e in spans}
+    depth: Dict[str, int] = {}
+
+    def d(e):
+        sid = e["span"]
+        if sid in depth:
+            return depth[sid]
+        p = e.get("parent")
+        depth[sid] = 0 if not p or p not in by_id else d(by_id[p]) + 1
+        return depth[sid]
+
+    lines = [f"trace {trace['trace']}  ({len(spans)} span(s), "
+             f"{trace['dur_ms']} ms)"]
+    for e in spans[:max_spans]:
+        off = (e.get("t0", 0.0) - trace["t0"]) * 1e3
+        labels = e.get("labels") or {}
+        lbl = " ".join(f"{k}={v}" for k, v in sorted(labels.items())
+                       if not isinstance(v, (list, dict)))
+        evs = "".join(f" !{ev['name']}" for ev in e.get("events", []))
+        lines.append(f"  {'  ' * d(e)}[+{off:9.2f} ms] {e['name']} "
+                     f"({e.get('dur_ms', 0.0):.2f} ms)"
+                     + (f"  {lbl}" if lbl else "") + evs)
+    if len(spans) > max_spans:
+        lines.append(f"  ... {len(spans) - max_spans} more span(s)")
+    return "\n".join(lines)

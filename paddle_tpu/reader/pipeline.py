@@ -75,7 +75,8 @@ def _offer(q: _queue.Queue, stop: threading.Event, msg) -> bool:
 
 
 def _pump(source: Callable[[], object], q: _queue.Queue,
-          stop: threading.Event, instrument: bool = False):
+          stop: threading.Event, instrument: bool = False,
+          span_parent=None, widx: int = 0):
     """Worker loop: drain one source iterable into the shared queue.
 
     ``instrument`` splits the loop's wall time into *busy* (producing —
@@ -83,7 +84,12 @@ def _pump(source: Callable[[], object], q: _queue.Queue,
     a full queue — consumer backpressure); deltas flush into the counters
     every ``_FLUSH_EVERY`` items and at worker exit, so a live pipeline's
     periodic snapshots see current numbers while the loop still pays only
-    two perf_counter reads per item and ~zero lock traffic."""
+    two perf_counter reads per item and ~zero lock traffic.
+
+    ``span_parent`` (instrumented runs): each produce emits a
+    ``reader/item`` span — attached to this worker thread while the
+    source runs, so spans the source itself creates (run_pipelined's
+    ``pipeline/stage``) nest under the item that carried them."""
     busy = wait = 0.0
     n = 0
     try:
@@ -94,12 +100,25 @@ def _pump(source: Callable[[], object], q: _queue.Queue,
         else:
             it = iter(source())
             while True:
+                sp = None
+                if span_parent is not None:
+                    sp = _obs.tracing.start_span(
+                        "reader/item", parent=span_parent,
+                        worker=widx, seq=n)
                 t0 = _time.perf_counter()
                 try:
-                    item = next(it)
+                    if sp is not None:
+                        with _obs.tracing.attach(sp):
+                            item = next(it)
+                    else:
+                        item = next(it)
                 except StopIteration:
                     busy += _time.perf_counter() - t0
+                    if sp is not None:
+                        sp.cancel()      # the final empty pull: no span
                     break
+                if sp is not None:
+                    sp.end()
                 t1 = _time.perf_counter()
                 busy += t1 - t0
                 ok = _offer(q, stop, (_DATA, item))
@@ -127,14 +146,24 @@ def _resolve_instrument(instrument: Optional[bool]) -> bool:
 
 
 def _run(sources: Sequence[Callable], buffer_size: int,
-         instrument: Optional[bool] = None):
+         instrument: Optional[bool] = None, trace_parent=None):
     """Generator over the merged output of ``sources``, each drained by its
-    own worker thread through one bounded queue."""
+    own worker thread through one bounded queue.
+
+    Instrumented runs get a ``reader/pipeline`` root span (parented to
+    ``trace_parent`` when the caller supplies one — run_pipelined joins
+    its staging engine into the pipelined trace this way) with one
+    ``reader/item`` child span per produced item."""
     instrument = _resolve_instrument(instrument)
+    root_sp = _obs.tracing.start_span(
+        "reader/pipeline", parent=trace_parent,
+        workers=len(sources), buffer_size=int(buffer_size)) \
+        if instrument else None
     q: _queue.Queue = _queue.Queue(maxsize=max(1, buffer_size))
     stop = threading.Event()
     threads = [
-        threading.Thread(target=_pump, args=(src, q, stop, instrument),
+        threading.Thread(target=_pump,
+                         args=(src, q, stop, instrument, root_sp, i),
                          daemon=True, name=f"{THREAD_NAME_PREFIX}-{i}")
         for i, src in enumerate(sources)]
     for t in threads:
@@ -167,6 +196,8 @@ def _run(sources: Sequence[Callable], buffer_size: int,
                 break
         for t in threads:
             t.join(timeout=5.0)
+        if root_sp is not None:
+            root_sp.end()
 
 
 def _tuned_defaults(buffer_size: Optional[int], num_workers: Optional[int]):
@@ -192,7 +223,8 @@ def _tuned_defaults(buffer_size: Optional[int], num_workers: Optional[int]):
 def prefetch(reader: Callable, buffer_size: Optional[int] = None,
              num_workers: Optional[int] = None,
              mapper: Optional[Callable] = None,
-             instrument: Optional[bool] = None) -> Callable:
+             instrument: Optional[bool] = None,
+             trace_parent=None) -> Callable:
     """Decode-ahead through ``num_workers`` threads and a bounded queue.
 
     Workers share the source iterator (pulls are serialized under a lock);
@@ -202,8 +234,10 @@ def prefetch(reader: Callable, buffer_size: Optional[int] = None,
     record source.  With ``num_workers == 1`` sample order is preserved
     (drop-in for the old ``buffered``); with more workers, relative order
     across workers is not guaranteed.  ``instrument``: queue-depth/stall/
-    busy metrics into the observability registry (None = follow the
-    global ``observe`` flag).  ``buffer_size``/``num_workers`` default to
+    busy metrics into the observability registry plus ``reader/pipeline``
+    + per-item ``reader/item`` tracing spans (None = follow the global
+    ``observe`` flag); ``trace_parent`` joins those spans into a caller's
+    trace.  ``buffer_size``/``num_workers`` default to
     (8, 1) — or the persisted ``reader/prefetch`` autotuner winner when
     the ``autotune`` flag is on.
     """
@@ -230,14 +264,15 @@ def prefetch(reader: Callable, buffer_size: Optional[int] = None,
                 yield mapper(item) if mapper is not None else item
 
         yield from _run([source] * num_workers, buffer_size,
-                        instrument=instrument)
+                        instrument=instrument, trace_parent=trace_parent)
     return data_reader
 
 
 def interleave(readers: Sequence[Callable], buffer_size: int = 8,
                num_workers: Optional[int] = None,
                mapper: Optional[Callable] = None,
-               instrument: Optional[bool] = None) -> Callable:
+               instrument: Optional[bool] = None,
+               trace_parent=None) -> Callable:
     """Merge N shard readers through parallel workers (tf.data interleave).
 
     Shards are assigned to workers round-robin (worker ``i`` owns shards
@@ -272,5 +307,5 @@ def interleave(readers: Sequence[Callable], buffer_size: int = 8,
             return source
 
         yield from _run([make_source(i) for i in range(W)], buffer_size,
-                        instrument=instrument)
+                        instrument=instrument, trace_parent=trace_parent)
     return data_reader

@@ -122,7 +122,8 @@ class PendingResponse:
     completing thread (or immediately if already terminal)."""
 
     __slots__ = ("id", "model", "feeds", "sig", "deadline", "t_admit",
-                 "outputs", "error", "_event", "_callbacks", "_lock")
+                 "outputs", "error", "span", "_event", "_callbacks",
+                 "_lock")
 
     def __init__(self, req_id, model: str, feeds, deadline: Optional[float]):
         self.id = req_id
@@ -133,6 +134,10 @@ class PendingResponse:
         self.t_admit = time.monotonic()
         self.outputs = None
         self.error: Optional[BaseException] = None
+        # lifecycle tracing span (one trace per request), started at
+        # admission on the submitting thread, ended by _complete on
+        # whichever thread completes the request
+        self.span = None
         self._event = threading.Event()
         self._callbacks: List[Callable] = []
         self._lock = threading.Lock()
@@ -155,6 +160,9 @@ class PendingResponse:
             self._event.set()
         obs.observe_hist("serving/request_ms",
                          (time.monotonic() - self.t_admit) * 1e3)
+        if self.span is not None:
+            self.span.end(status="ok" if error is None
+                          else type(error).__name__)
         for cb in cbs:
             try:
                 cb(self)
@@ -208,7 +216,7 @@ class _ModelRuntime:
             now = time.monotonic() if now is None else now
             return "half_open" if now >= self.breaker_open_until else "open"
 
-    def _note_batch_failure(self, err: BaseException):
+    def _note_batch_failure(self, err: BaseException, span=None):
         opened = False
         with self.lock:
             self.consecutive_failures += 1
@@ -224,12 +232,15 @@ class _ModelRuntime:
             obs.emit_event("serving", event="breaker_open",
                            model=self.model.name,
                            error=f"{type(err).__name__}: {err}")
+            if span is not None:
+                span.event("breaker_open",
+                           error=f"{type(err).__name__}: {err}")
             logger.error("serving: circuit breaker OPEN for model %r "
                          "after %d consecutive failures (%s: %s)",
                          self.model.name, self.consecutive_failures,
                          type(err).__name__, err)
 
-    def _note_batch_success(self):
+    def _note_batch_success(self, span=None):
         closed = False
         with self.lock:
             self.consecutive_failures = 0
@@ -239,6 +250,8 @@ class _ModelRuntime:
         if closed:
             obs.emit_event("serving", event="breaker_close",
                            model=self.model.name)
+            if span is not None:
+                span.event("breaker_close")
             logger.info("serving: circuit breaker closed for model %r "
                         "(probe succeeded)", self.model.name)
 
@@ -449,23 +462,44 @@ class Server:
                     time.sleep((float(ms) if ms else 50.0) / 1e3)
                 else:
                     _fi.raise_for(action, "serving.request")
-        if self._state != READY:
-            raise _faults.ServerClosed(
-                f"server is {self._state}; admission closed")
-        if rt.breaker_state() == "open":
-            raise _faults.ModelUnavailable(
-                f"model {rt.model.name!r}: circuit breaker open "
-                f"(repeated fatal dispatch errors); retry after cooldown")
-        if deadline_ms == -1.0:
-            deadline_ms = self.default_deadline_ms
-        now = time.monotonic()
-        deadline = None if deadline_ms is None else now + deadline_ms / 1e3
         if req_id is None:
             with self._state_lock:
                 self._req_counter += 1
                 req_id = self._req_counter
-        req = PendingResponse(req_id, rt.model.name,
-                              rt.model.coerce_feeds(feeds), deadline)
+        # one trace per request (ROOT forces it even if the submitting
+        # thread is inside some other traced region), started BEFORE the
+        # admission checks so every typed rejection — ServerClosed,
+        # breaker-open ModelUnavailable, feed-validation errors,
+        # Overloaded shedding — reaches the log with its status; those
+        # rejections are exactly what an overload trace needs to show.
+        # The span ends at the terminal completion, or here on a
+        # rejection raise.
+        sp = obs.tracing.start_span(
+            "serving/request", parent=obs.tracing.ROOT,
+            model=rt.model.name, id=req_id)
+        try:
+            if self._state != READY:
+                raise _faults.ServerClosed(
+                    f"server is {self._state}; admission closed")
+            if rt.breaker_state() == "open":
+                raise _faults.ModelUnavailable(
+                    f"model {rt.model.name!r}: circuit breaker open "
+                    f"(repeated fatal dispatch errors); retry after "
+                    f"cooldown")
+            if deadline_ms == -1.0:
+                deadline_ms = self.default_deadline_ms
+            now = time.monotonic()
+            deadline = None if deadline_ms is None \
+                else now + deadline_ms / 1e3
+            req = PendingResponse(req_id, rt.model.name,
+                                  rt.model.coerce_feeds(feeds), deadline)
+            req.span = sp
+            return self._admit(rt, req)
+        except BaseException as e:
+            sp.end(status=type(e).__name__)
+            raise
+
+    def _admit(self, rt: _ModelRuntime, req: PendingResponse):
         shed_req = None
         with rt.cond:
             if rt.closed:
@@ -612,7 +646,7 @@ class Server:
             rt.staging.put(None)        # dispatcher drain sentinel
 
     # -- dispatcher ----------------------------------------------------------
-    def _dispatch_batch(self, rt: _ModelRuntime, padded):
+    def _dispatch_batch(self, rt: _ModelRuntime, padded, span=None):
         """One model call through the injection site + retry rim."""
         def attempt():
             if _fi.ENABLED:
@@ -628,6 +662,9 @@ class Server:
             obs.inc_counter("fault/retries")
             obs.emit_event("fault", event="retry", site="serving.dispatch",
                            attempt=i + 1, delay_s=round(d, 4),
+                           error=f"{type(e).__name__}: {e}")
+            if span is not None:
+                span.event("retry", attempt=i + 1, delay_s=round(d, 4),
                            error=f"{type(e).__name__}: {e}")
 
         if self.retry_policy is None:
@@ -667,16 +704,27 @@ class Server:
                 r._complete(error=_faults.ModelUnavailable(
                     f"model {rt.model.name!r}: circuit breaker open"))
             return
+        bucket = next((int(v.shape[0]) for v in padded.values()), 0)
+        # batch span: its OWN trace (a batch is a join point, not a
+        # child of any single request), linking every member request's
+        # trace by id — retry attempts and breaker transitions attach as
+        # span events, so a degraded batch's story reads in one record
+        bsp = obs.tracing.start_span(
+            "serving/batch", parent=obs.tracing.ROOT,
+            model=rt.model.name, size=len(rows), bucket=bucket,
+            requests=[r.id for _, r in rows],
+            traces=[r.span.trace_id for _, r in rows
+                    if r.span is not None])
         t0 = time.monotonic()
         try:
-            outs = self._dispatch_batch(rt, padded)
+            outs = self._dispatch_batch(rt, padded, span=bsp)
             # materialize + split INSIDE the failure rim: a model whose
             # outputs are not row-wise indexable (scalar fetch, ragged
             # return) is a model failure, not a server crash
             split = [[None if o is None else np.asarray(o[i])
                       for o in outs] for i, _ in rows]
         except BaseException as e:
-            rt._note_batch_failure(e)
+            rt._note_batch_failure(e, span=bsp)
             err = ModelError(
                 f"model {rt.model.name!r}: dispatch failed "
                 f"({type(e).__name__}: {e})")
@@ -685,17 +733,18 @@ class Server:
                            error=f"{type(e).__name__}: {e}")
             for _, r in rows:
                 r._complete(error=err)
+            bsp.end(status=type(e).__name__)
             return
         dispatch_ms = (time.monotonic() - t0) * 1e3
-        rt._note_batch_success()
+        rt._note_batch_success(span=bsp)
         obs.inc_counter("serving/batches")
         obs.observe_hist("serving/batch_size", len(rows))
         with rt.lock:
             rt.dispatched_batches += 1
             rt.served += len(rows)
-        bucket = next((int(v.shape[0]) for v in padded.values()), 0)
         obs.emit_event("serving", event="batch", model=rt.model.name,
                        size=len(rows), bucket=bucket,
                        dispatch_ms=round(dispatch_ms, 3))
         for (_, r), out in zip(rows, split):
             r._complete(outputs=out)
+        bsp.end(status="ok", dispatch_ms=round(dispatch_ms, 3))

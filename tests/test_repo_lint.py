@@ -409,6 +409,148 @@ def test_tunable_registry_matches_ast_scan():
                 f"pending-hardware tunable {n!r} without a decision rule"
 
 
+# ---------------------------------------------------------------------------
+# Span-name gate (paddle_tpu.observability.tracing.SPAN_NAMES) — the
+# tracing mirror of the metric gate: every span name passed to span()/
+# start_span() must be a string literal frozen in SPAN_NAMES.
+# ---------------------------------------------------------------------------
+_SPAN_HELPERS = ("span", "start_span")
+# the tracing module itself passes names through variables by
+# construction (its SPAN_NAMES table is what the gate checks against)
+_SPAN_DEFINING_FILE = "paddle_tpu/observability/tracing.py"
+
+
+def _span_names_table():
+    """Names parsed from the SPAN_NAMES literal — no import, so the gate
+    also covers a syntactically valid but unimportable state."""
+    path = os.path.join(ROOT, "observability", "tracing.py")
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "SPAN_NAMES"
+                for t in node.targets):
+            rows = ast.literal_eval(node.value)
+            return [name for name, _help in rows]
+    raise AssertionError("SPAN_NAMES literal not found in tracing.py")
+
+
+def test_span_names_table_well_formed():
+    names = _span_names_table()
+    dupes = {n for n in names if names.count(n) > 1}
+    assert not dupes, f"duplicate SPAN_NAMES entries: {sorted(dupes)}"
+    assert names, "SPAN_NAMES is empty — the gate has nothing to check"
+    for name in names:
+        assert "/" in name, f"span {name!r} is not namespaced (sub/name)"
+
+
+def test_span_helper_names_are_registered_literals():
+    registered = set(_span_names_table())
+    problems, used = [], set()
+    for rel, tree in _iter_lint_sources():
+        if rel == _SPAN_DEFINING_FILE:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            target = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if target not in _SPAN_HELPERS:
+                continue
+            if not node.args:
+                problems.append(f"{rel}:{node.lineno}: {target} without a "
+                                f"positional span name")
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                problems.append(
+                    f"{rel}:{node.lineno}: {target} span name must be a "
+                    f"string literal (free-form names defeat the typo "
+                    f"gate)")
+                continue
+            used.add(arg.value)
+            if arg.value not in registered:
+                problems.append(
+                    f"{rel}:{node.lineno}: span {arg.value!r} is not in "
+                    f"observability.tracing.SPAN_NAMES — register it "
+                    f"there (typo?)")
+    assert not problems, "\n".join(problems)
+    assert used, "AST scan found no span-helper calls — lint is broken"
+    # the full causal chain is instrumented: every frozen name is LIVE
+    # at some call site (a dead table row is a removed instrumentation
+    # point, which deserves a conscious table edit)
+    assert used == registered, (
+        f"SPAN_NAMES and call sites disagree: "
+        f"unused={sorted(registered - used)} "
+        f"unregistered={sorted(used - registered)}")
+
+
+def test_span_gate_matches_live_registry():
+    from paddle_tpu.observability.tracing import SPAN_NAMES
+    assert [n for n, _ in SPAN_NAMES] == _span_names_table()
+
+
+def test_attribution_module_only_imported_lazily():
+    """The doctor engine (observability/attribution.py) pulls
+    analysis.cost_model; like serving and tuning, only the opted-in
+    surfaces (doctor CLI, bench drivers) may import it — no top-level
+    import outside paddle_tpu/observability/, and the observability
+    package __init__ itself must not import it either (the `observe`
+    hot path stays attribution-free)."""
+    problems = []
+    for rel, tree in _iter_sources():
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            mod = getattr(node, "module", "") or ""
+            names = [a.name for a in node.names]
+            hit = (
+                ("observability.attribution" in mod)
+                or (mod.endswith("observability") and
+                    "attribution" in names)
+                or (isinstance(node, ast.ImportFrom) and node.level > 0
+                    and mod == "" and "attribution" in names)
+                or (isinstance(node, ast.ImportFrom) and node.level > 0
+                    and mod == "attribution")
+                or (isinstance(node, ast.Import) and any(
+                    "observability.attribution" in n for n in names)))
+            if not hit:
+                continue
+            if rel == "paddle_tpu/observability/attribution.py":
+                continue
+            # lazy (inside a function body) is the sanctioned form —
+            # detect top-level by column 0 of module/class scope walk
+            problems.append((rel, node.lineno))
+    # re-scan with function context to keep only TOP-LEVEL hits
+    toplevel = []
+    for rel, lineno in problems:
+        path = os.path.join(ROOT, os.pardir, rel)
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=rel)
+
+        def visit(node, in_func):
+            for child in ast.iter_child_nodes(node):
+                nested = in_func or isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                if getattr(child, "lineno", None) == lineno \
+                        and not in_func \
+                        and isinstance(child,
+                                       (ast.Import, ast.ImportFrom)):
+                    toplevel.append(f"{rel}:{lineno}")
+                visit(child, nested)
+        visit(tree, False)
+    assert not toplevel, (
+        "top-level import of observability.attribution — must be lazy "
+        "(inside a function) so the observe hot path never pays for "
+        "the cost model: " + ", ".join(toplevel))
+    # and the sanctioned lazy site exists (the doctor CLI branch)
+    with open(os.path.join(ROOT, "cli.py")) as fh:
+        assert "from paddle_tpu.observability import attribution" \
+            in fh.read()
+
+
 def test_shard_fn_registry_matches_ast_scan():
     """Same agreement gate for the sharding-propagation rules: every
     live register_shard_fn name is a string literal the duplicate lint
