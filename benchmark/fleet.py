@@ -1,0 +1,458 @@
+"""Fleet-vs-single saturation + chaos benchmark (ISSUE 11 acceptance).
+
+Measures the serving FLEET (N `paddle_tpu serve` replica processes
+behind the queue-depth-aware router, paddle_tpu/serving/fleet.py)
+against itself at N=1 — same artifact, same per-replica resources: each
+replica is CPU-PINNED to one core (``sched_setaffinity``), so "add a
+replica" means "add a core's worth of capacity", the horizontal-scaling
+claim a fleet exists to make.  On this 2-core container that is N=1 vs
+N=2; a chip host raises the sweep (replica-per-chip assignment replaces
+core pinning).
+
+Methodology (this box's external contention swings wall time 1.3-1.4x
+run to run — PR 9/10 budget notes — so one-shot sequential comparisons
+are junk):
+
+* ``saturation`` — an escalating-rate open-loop ladder on the full
+  fleet finds the saturating offered rate; the fleet-rim backlog shed
+  keeps past-saturation arms from thrashing (replica-side shed pays
+  wire+parse on a serving core first — measured ~40% throughput loss).
+* ``capacity`` — fleet-of-1 vs fleet-of-2 as PAIRED ALTERNATING arms on
+  the SAME running fleet: the r1 half CORDONS the second replica
+  (administratively unroutable, process untouched) so the pair flips
+  fleet size in milliseconds and both halves see the same contention
+  regime.  Headline = median of per-pair r2/r1 ratios (PR 2/9
+  convention).
+* ``overload`` — open-loop at 1x and 2x measured capacity with
+  deadlines + fleet-rim shedding: admitted p99 must stay bounded
+  FLEET-WIDE, the PR 8 claim at fleet scope.
+* ``chaos_sigkill`` — closed-loop load, one replica SIGKILLed mid-run:
+  ZERO admitted requests dropped fleet-wide (in-flight work fails over
+  to the survivor), and the victim relaunches through the supervisor
+  gate back to ready.
+
+Results land under the ``fleet`` key of benchmark/serving_results.json
+(the single-server rows stay untouched); TPU rows follow the PR 1
+pending-hardware convention.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from benchmark.serving_common import (closed_loop, export_mlp,  # noqa: E402
+                                      load_artifact, percentile,
+                                      single_example)
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "serving_results.json")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    return dict(os.environ, JAX_PLATFORMS="cpu",
+                PYTHONPATH=REPO + os.pathsep
+                + os.environ.get("PYTHONPATH", ""))
+
+
+def host_parallel_probe(duration_s: float = 3.0) -> dict:
+    """The container's REAL parallel capacity, measured the PR 2 way
+    (host_parallel_efficiency): GEMM throughput of one core-pinned
+    process vs two pinned to different cores.  On this container the
+    pair delivers ~1.2x of the single — the hypervisor hands out ~1.2
+    effective cores regardless of the nominal count — which is the hard
+    ceiling on ANY 2-replica speedup.  The fleet row is judged against
+    this measured ceiling, not against an imaginary 2.0x."""
+    import subprocess
+
+    code = ("import os,sys,time;import numpy as np;"
+            "os.sched_setaffinity(0,{int(sys.argv[1])});"
+            "a=np.random.rand(1024,1024).astype('float32');b=a.copy();"
+            "n=0;t0=time.perf_counter()\n"
+            f"while time.perf_counter()-t0<{duration_s}: a@b; n+=1\n"
+            "print(n/(time.perf_counter()-t0))")
+
+    def run_one(core):
+        return subprocess.Popen([sys.executable, "-c", code, str(core)],
+                                stdout=subprocess.PIPE, text=True)
+
+    p = run_one(0)
+    single = float(p.communicate(timeout=duration_s * 10)[0])
+    ps = [run_one(0), run_one(1)]
+    pair = sum(float(q.communicate(timeout=duration_s * 10)[0])
+               for q in ps)
+    return {"single_gemms_per_s": round(single, 1),
+            "pair_gemms_per_s": round(pair, 1),
+            "pair_over_single": round(pair / max(1e-9, single), 3)}
+
+
+def _make_router(model_dir, n, *, deadline_ms, queue, max_batch,
+                 max_wait_ms, ncores, backlog_limit=None):
+    from paddle_tpu.serving.fleet import (FleetRouter, ProcessReplica,
+                                          serve_argv)
+
+    argv = serve_argv([f"m={model_dir}"], max_batch=max_batch,
+                      max_wait_ms=max_wait_ms, deadline_ms=deadline_ms,
+                      queue=queue, warmup_all=True)
+
+    def factory(i):
+        return ProcessReplica(argv, name=f"replica{i}", env=_env(),
+                              cpu_affinity=[i % ncores])
+
+    return FleetRouter(factory, replicas=n, poll_interval_s=0.1,
+                       max_restarts=3, backlog_limit=backlog_limit,
+                       restart_backoff_base_s=0.1).start(
+                           ready_timeout_s=600)
+
+
+def open_loop(router, example, *, rate, duration_s, deadline_ms):
+    """Fixed-rate submission against a RUNNING fleet; returns the
+    admitted-latency row (the fleet analog of serving.py's arms)."""
+    lock = threading.Lock()
+    lat, errors = [], {}
+    offered = served = 0
+    interval = 1.0 / rate
+    t_start = time.monotonic()
+    t_last = t_start
+    stop = t_start + duration_s
+    pendings = []
+
+    def on_done(fp):
+        nonlocal served, t_last
+        with lock:
+            if fp.error is None:
+                lat.append((time.monotonic() - fp.t_admit))
+                served += 1
+                t_last = time.monotonic()
+            else:
+                k = type(fp.error).__name__
+                errors[k] = errors.get(k, 0) + 1
+
+    next_t = time.monotonic()
+    while time.monotonic() < stop:
+        now = time.monotonic()
+        if now < next_t:
+            time.sleep(min(interval, next_t - now))
+            continue
+        next_t += interval
+        offered += 1
+        try:
+            fp = router.submit(example, deadline_ms=deadline_ms)
+        except BaseException as e:      # typed admission rejection
+            with lock:
+                k = type(e).__name__
+                errors[k] = errors.get(k, 0) + 1
+            continue
+        fp.add_done_callback(on_done)
+        pendings.append(fp)
+    deadline = time.monotonic() + 60
+    for fp in pendings:
+        if not fp.done() and time.monotonic() < deadline:
+            try:
+                fp.result(timeout=max(0.1, deadline - time.monotonic()))
+            except BaseException:
+                pass
+    with lock:
+        lat.sort()
+        # throughput over admit-to-last-completion wall: requests
+        # admitted in the window but completed just past it count at
+        # their true cost instead of vanishing
+        wall = max(duration_s, t_last - t_start)
+        row = {"offered_per_s": round(rate, 1), "offered": offered,
+               "offered_actual_per_s": round(offered / duration_s, 1),
+               "served": served,
+               "served_per_s": round(served / wall, 1),
+               "errors": dict(errors)}
+        if lat:
+            row["latency_ms_p50"] = round(percentile(lat, 0.50) * 1e3, 2)
+            row["latency_ms_p99"] = round(percentile(lat, 0.99) * 1e3, 2)
+        return row
+
+
+def saturation_ladder(router, example, *, duration_s, deadline_ms,
+                      start_rate):
+    """Climb open-loop arms until served_per_s stops improving — keep
+    climbing while an arm is visibly unsaturated (no rejections, served
+    ~= offered) — and return (best_arm, ladder)."""
+    best, ladder = None, []
+    rate = start_rate
+    for _step in range(7):
+        arm = open_loop(router, example, rate=rate,
+                        duration_s=duration_s, deadline_ms=deadline_ms)
+        ladder.append({"offered_per_s": arm["offered_per_s"],
+                       "served_per_s": arm["served_per_s"],
+                       "shed": arm["errors"].get("Overloaded", 0)})
+        unsaturated = (not arm["errors"]
+                       and arm["served_per_s"]
+                       >= 0.92 * arm["offered_actual_per_s"])
+        if best is None or arm["served_per_s"] > \
+                best["served_per_s"] * 1.05:
+            best = arm
+            rate *= 2.0 if unsaturated else 1.5
+            continue
+        if unsaturated:
+            rate *= 2.0                 # not saturated yet: keep going
+            continue
+        break                           # plateaued: done
+    return best, ladder
+
+
+def paired_capacity(router, example, spare_name, *, pairs, duration_s,
+                    deadline_ms, rate):
+    """Fleet-of-1 vs fleet-of-2 as PAIRED ALTERNATING arms on the SAME
+    running fleet: the r1 half cordons the second replica so the pair
+    flips fleet size in milliseconds and both halves sit in the same
+    contention regime.  Headline = median of per-pair r2/r1 ratios."""
+    rows = []
+    for k in range(pairs):
+        router.cordon(spare_name)
+        try:
+            r1 = open_loop(router, example, rate=rate,
+                           duration_s=duration_s,
+                           deadline_ms=deadline_ms)
+        finally:
+            router.cordon(spare_name, cordoned=False)
+        r2 = open_loop(router, example, rate=rate,
+                       duration_s=duration_s, deadline_ms=deadline_ms)
+        rows.append({
+            "pair": k,
+            "r1_served_per_s": r1["served_per_s"],
+            "r2_served_per_s": r2["served_per_s"],
+            "ratio": round(r2["served_per_s"]
+                           / max(1e-9, r1["served_per_s"]), 3),
+            "r1_p99_ms": r1.get("latency_ms_p99"),
+            "r2_p99_ms": r2.get("latency_ms_p99"),
+        })
+        print(json.dumps({"pair": rows[-1]}), flush=True)
+    ratios = sorted(r["ratio"] for r in rows)
+    return {
+        "pairs": rows,
+        "r1_served_per_s_median": sorted(
+            r["r1_served_per_s"] for r in rows)[len(rows) // 2],
+        "r2_served_per_s_median": sorted(
+            r["r2_served_per_s"] for r in rows)[len(rows) // 2],
+        "speedup_median_of_pair_ratios": ratios[len(ratios) // 2],
+        "pairs_favoring_r2": sum(1 for r in rows if r["ratio"] > 1.0),
+    }
+
+
+def chaos_arm(model_dir, example, *, duration_s, ncores, max_batch,
+              max_wait_ms, workers=8):
+    """SIGKILL one of two replicas under closed-loop load: zero admitted
+    drops fleet-wide + supervisor relaunch back to ready."""
+    import paddle_tpu as pt
+
+    router = _make_router(model_dir, 2, deadline_ms=0, queue=4096,
+                          max_batch=max_batch, max_wait_ms=max_wait_ms,
+                          ncores=ncores)
+    try:
+        failovers0 = pt.observability.registry().snapshot()[
+            "fleet/failovers"]["value"]
+        victim = router.replicas[0]
+        kill_at = time.monotonic() + duration_s / 3.0
+
+        def killer():
+            time.sleep(max(0.0, kill_at - time.monotonic()))
+            victim.kill()
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        lat, row = closed_loop(router, example, workers=workers,
+                               duration_s=duration_s, timeout_s=120.0)
+        kt.join(timeout=30)
+        relaunched = False
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if victim.state == "ready":
+                relaunched = True
+                break
+            time.sleep(0.5)
+        failovers = pt.observability.registry().snapshot()[
+            "fleet/failovers"]["value"] - failovers0
+        return {
+            "replicas": 2, "sigkill_at_s": round(duration_s / 3.0, 2),
+            "served": row["served"],
+            "dropped": row["worker_errors"],   # closed_loop counts every
+            # raised error; with shedding/deadlines off any error IS a
+            # dropped admitted request
+            "failovers": int(failovers),
+            "victim_relaunched_ready": relaunched,
+            "victim_restarts": getattr(victim, "restarts", 0),
+            "latency_ms_p99": round(percentile(lat, 0.99) * 1e3, 2),
+            "zero_admitted_drops": row["worker_errors"] == 0,
+        }
+    finally:
+        router.shutdown(timeout_s=120)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny durations (CI smoke, numbers meaningless)")
+    ap.add_argument("--duration-s", type=float, default=5.0)
+    ap.add_argument("--pairs", type=int, default=4)
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--max-wait-ms", type=float, default=10.0)
+    ap.add_argument("--deadline-ms", type=float, default=4000.0)
+    ap.add_argument("--queue", type=int, default=64)
+    ap.add_argument("--out", default=OUT)
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.duration_s, args.pairs = 1.5, 1
+
+    ncores = os.cpu_count() or 1
+    # sized for two constraints: (a) replica-bound — per-request model
+    # time must dominate the ~0.2 ms routing/JSON-wire cost or the
+    # router (one Python process sharing this 2-core host) is what
+    # gets measured; (b) COMPUTE-bound, not bandwidth-bound — batch 32
+    # over 50 MB of weights gives ~11 flops/byte, while a 4096-wide
+    # model at batch 8 streams 200 MB/dispatch and saturates the
+    # SHARED memory bus, which no replica count can scale
+    model_dir = export_mlp("/tmp/pt_fleet_bench_mlp6", in_dim=64,
+                           hidden=(2048,) * 6, classes=16)
+    _, manifest = load_artifact(model_dir)
+    rng = np.random.RandomState(0)
+    example = single_example(manifest, rng)
+    # pre-serialized wire form: the open-loop scheduler must not pay a
+    # tolist() per submission
+    example_wire = {k: v.tolist() for k, v in example.items()}
+
+    result = {
+        "engine": "process-replica fleet (paddle_tpu.serving.fleet): "
+                  "N `paddle_tpu serve` subprocesses behind the "
+                  "queue-depth router",
+        "model": "mlp 64->2048x6->16 (symbolic-batch StableHLO "
+                 "artifact, ~34 MFLOP/request; sized so (a) COMPUTE-"
+                 "bound at batch 32 — a bandwidth-bound model cannot "
+                 "scale with replicas on shared-memory-bus cores — and "
+                 "(b) per-replica capacity sits well under the ~570/s "
+                 "ceiling of the single-process Python load generator, "
+                 "so offered load can actually exceed 2x one replica)",
+        "host_cores": ncores,
+        "replica_pinning": "sched_setaffinity: replica i -> core "
+                           "i % ncores (identical per-replica "
+                           "resources; the scaling claim is capacity "
+                           "per added core)",
+        "note": "router + load generator share the same host as the "
+                "replicas on this container — fleet capacity is net of "
+                "routing/JSON-wire overhead; capacity pairs alternate "
+                "r1/r2 via cordon to cancel this box's 1.3-1.4x "
+                "contention swings",
+    }
+    print(json.dumps({"phase": "host_parallel_probe"}), flush=True)
+    probe = host_parallel_probe()
+    result["host_parallel_probe"] = probe
+    print(json.dumps({"host_parallel_probe": probe}), flush=True)
+
+    router = _make_router(model_dir, 2, deadline_ms=args.deadline_ms,
+                          queue=args.queue, max_batch=args.max_batch,
+                          max_wait_ms=args.max_wait_ms, ncores=ncores,
+                          backlog_limit=args.queue)
+    try:
+        for _ in range(6):              # settle both replicas
+            router.infer(example, deadline_ms=None, timeout=120)
+        print(json.dumps({"phase": "saturation_ladder"}), flush=True)
+        sat, ladder = saturation_ladder(
+            router, example_wire, duration_s=args.duration_s,
+            deadline_ms=args.deadline_ms, start_rate=150.0)
+        result["saturation"] = {**sat, "ladder": ladder}
+        print(json.dumps({"saturation": result["saturation"]}),
+              flush=True)
+        sat_rate = max(sat["served_per_s"] * 1.3, 30.0)
+
+        print(json.dumps({"phase": "paired_capacity",
+                          "rate": round(sat_rate, 1)}), flush=True)
+        cap = paired_capacity(
+            router, example_wire, "replica1", pairs=args.pairs,
+            duration_s=args.duration_s, deadline_ms=args.deadline_ms,
+            rate=sat_rate)
+        result["capacity_pairs"] = cap
+        result["scaling"] = {
+            "replicas": [1, 2],
+            "req_per_s_median": [cap["r1_served_per_s_median"],
+                                 cap["r2_served_per_s_median"]],
+            "speedup": cap["speedup_median_of_pair_ratios"],
+        }
+
+        # overload envelope fleet-wide: 1x vs 2x of measured capacity
+        cap2 = cap["r2_served_per_s_median"]
+        arms = {}
+        for factor in (1.0, 2.0):
+            print(json.dumps({"phase": f"open_loop_{factor}x"}),
+                  flush=True)
+            arms[f"{factor}x"] = open_loop(
+                router, example_wire, rate=max(1.0, cap2 * factor),
+                duration_s=args.duration_s,
+                deadline_ms=args.deadline_ms)
+            print(json.dumps({f"{factor}x": arms[f"{factor}x"]}),
+                  flush=True)
+        result["overload"] = arms
+    finally:
+        router.shutdown(timeout_s=120)
+
+    p99_1x = arms["1.0x"].get("latency_ms_p99")
+    p99_2x = arms["2.0x"].get("latency_ms_p99")
+    speedup = result["scaling"]["speedup"]
+    ceiling = probe["pair_over_single"]
+    # two ways to pass: the absolute claim (a real multi-core host), or
+    # reaching >=85% of THIS host's measured 2-process ceiling — on this
+    # container the hypervisor delivers ~1.2 effective cores no matter
+    # what nominal count /proc advertises, so 1.2x IS perfect scaling
+    # here and the absolute sweep belongs to the TPU-host pending row
+    result["acceptance"] = {
+        "host_parallel_ceiling_2proc": ceiling,
+        "fleet_speedup": speedup,
+        "fleet_over_ceiling": round(speedup / max(1e-9, ceiling), 3),
+        "capacity_scales_with_replicas":
+            (speedup > 1.2
+             and cap["pairs_favoring_r2"] >= (args.pairs + 1) // 2)
+            or speedup >= 0.85 * ceiling,
+        "p99_1x_ms": p99_1x, "p99_2x_ms": p99_2x,
+        "p99_ratio_2x_over_1x": (round(p99_2x / p99_1x, 3)
+                                 if p99_1x and p99_2x else None),
+        "bounded_under_overload": (bool(p99_1x and p99_2x
+                                        and p99_2x < 5.0 * p99_1x)),
+    }
+
+    print(json.dumps({"phase": "chaos_sigkill"}), flush=True)
+    result["chaos_sigkill"] = chaos_arm(
+        model_dir, example, duration_s=max(4.0, args.duration_s),
+        ncores=ncores, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms)
+    print(json.dumps({"chaos_sigkill": result["chaos_sigkill"]}),
+          flush=True)
+
+    result["tpu"] = {
+        "status": "pending hardware",
+        "note": "re-run python benchmark/fleet.py on a chip host and "
+                "commit the filled rows (PR 1 convention); replica "
+                "pinning becomes per-chip assignment there",
+        "rows": [],
+    }
+
+    if not args.smoke:
+        existing = {}
+        if os.path.exists(args.out):
+            with open(args.out) as fh:
+                existing = json.load(fh)
+        existing["fleet"] = result
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(existing, fh, indent=1)
+            fh.write("\n")
+        os.replace(tmp, args.out)
+        print(json.dumps({"wrote": args.out}), flush=True)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,14 @@ the program — a CI gate needing no Python config import.
 runtime (paddle_tpu.serving) over exported StableHLO artifacts: dynamic
 batching with admission control, per-request deadlines, load shedding,
 per-model circuit breaking, and graceful SIGTERM drain — one JSON object
-per line on stdin/stdout (see serving/cli.py for the protocol).
+per line on stdin/stdout (see serving/cli.py for the protocol), or over
+HTTP with ``--http PORT``.
+
+``python -m paddle_tpu fleet --model dir --replicas N --http PORT``
+scales that horizontally: N supervised serve replicas behind a
+queue-depth-aware router and the HTTP front, with bounded-restart
+relaunch of dead replicas and optional metric-driven autoscaling
+(serving/fleet.py).
 
 Feeds come from ``--feed-npz`` (named arrays matching the config's data
 layers, with ``name@LEN`` companions for sequences); ``time`` and
@@ -751,6 +758,11 @@ def main(argv=None):
         # (zero-cost-when-unused guard, tier-1 enforced)
         from paddle_tpu.serving.cli import serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # lazy: the fleet router/autoscaler rides the same
+        # zero-cost-when-unused contract as the serving package
+        from paddle_tpu.serving.fleet import fleet_main
+        return fleet_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="paddle_tpu",
         description="TrainerMain analog: run a v1 config on the TPU "
@@ -766,10 +778,13 @@ def main(argv=None):
                     "prog.json]` explains where the step/request time "
                     "went and calibrates the cost model, `paddle_tpu "
                     "tune <target>` searches and persists autotuner "
-                    "winners, and `paddle_tpu serve --model dir` runs "
+                    "winners, `paddle_tpu serve --model dir` runs "
                     "the batching inference server over exported "
-                    "artifacts (see `paddle_tpu "
-                    "check|plan|stats|trace|doctor|tune|serve --help`).")
+                    "artifacts (stdio JSON, or HTTP with --http), and "
+                    "`paddle_tpu fleet --model dir --replicas N` scales "
+                    "it behind a queue-depth-aware router (see "
+                    "`paddle_tpu check|plan|stats|trace|doctor|tune|"
+                    "serve|fleet --help`).")
     ap.add_argument("--config", required=True, help="v1 config file")
     ap.add_argument("--job", default="train",
                     choices=["train", "test", "time", "checkgrad"])

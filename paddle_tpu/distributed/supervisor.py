@@ -27,13 +27,20 @@ to the retries and preemptions.
 """
 from __future__ import annotations
 
+import os
+import signal as _signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Callable, Optional, Sequence
 
+import logging
+
 from ..faults import EXIT_PREEMPTED, Preempted
 from ..observability import emit_event, inc_counter
+
+logger = logging.getLogger("paddle_tpu")
 
 __all__ = ["Supervisor", "SupervisorGaveUp"]
 
@@ -69,6 +76,16 @@ class Supervisor:
             backoff_max_s=backoff_max_s, jitter=jitter, seed=seed)
         self._sleep = sleep
         self.restarts = 0          # relaunches performed by the last run()
+        # live child of run_command(), for signal forwarding: killing the
+        # supervisor must not orphan the supervised process (the fleet's
+        # drain semantics — SIGTERM the router, every replica drains —
+        # depend on this)
+        self._child: Optional[subprocess.Popen] = None
+        # RLock: terminate() may run inside a signal handler ON the
+        # thread that is blocked in run_command's wait while holding
+        # this lock — a plain Lock would self-deadlock there
+        self._child_lock = threading.RLock()
+        self._terminated = False   # deliberate stop: no relaunch
 
     def _note_restart(self, what: str, outcome: str, delay_s: float):
         """Restart accounting shared by run() and run_command()."""
@@ -83,6 +100,18 @@ class Supervisor:
         self._note_restart(what, outcome, d)
         if d > 0:
             self._sleep(d)
+
+    def relaunch_gate(self, what: str, outcome: str) -> bool:
+        """One bounded-restart decision for callers that own their own
+        process handles (the serving fleet keeps live stdio pipes to its
+        replicas, so it cannot hand the Popen loop to
+        :meth:`run_command`).  Returns False once ``max_restarts``
+        relaunches are spent; otherwise performs the same restart
+        accounting + backoff sleep as the run loops and returns True."""
+        if self.restarts >= self.max_restarts:
+            return False
+        self._backoff(what, outcome)
+        return True
 
     # -- in-process ---------------------------------------------------------
     def run(self, fn: Callable, what: str = "supervised run"):
@@ -108,6 +137,75 @@ class Supervisor:
             raise SupervisorGaveUp(what, self.restarts, e.last) from e
 
     # -- subprocess ---------------------------------------------------------
+    def terminate(self, sig: int = _signal.SIGTERM,
+                  kill_timeout_s: float = 10.0, *,
+                  _in_signal_handler: bool = False) -> None:
+        """Forward ``sig`` to the live :meth:`run_command` child, wait up
+        to ``kill_timeout_s`` for it to exit, then escalate to SIGKILL.
+
+        Marks the supervision loop terminated: the child's subsequent
+        death (even by signal, normally a relaunch trigger) is treated as
+        a deliberate stop, and :meth:`run_command` returns its exit
+        status without relaunching.  Safe to call from any thread or a
+        signal handler; a no-op when no child is running."""
+        with self._child_lock:
+            self._terminated = True
+            child = self._child
+        if child is None or child.poll() is not None:
+            return
+        try:
+            child.send_signal(sig)
+        except (ProcessLookupError, OSError):
+            return
+        if self._reap_bounded(child, kill_timeout_s, _in_signal_handler):
+            return
+        logger.warning(
+            "supervisor: child %d ignored signal %d for %.1fs; "
+            "escalating to SIGKILL", child.pid, sig, kill_timeout_s)
+        try:
+            child.kill()
+        except (ProcessLookupError, OSError):
+            pass
+
+    @staticmethod
+    def _reap_bounded(child: subprocess.Popen, timeout_s: float,
+                      in_signal_handler: bool) -> bool:
+        """True iff ``child`` exited within ``timeout_s``.  Inside a
+        signal handler the interrupted frame underneath us may be
+        suspended INSIDE ``child.wait()`` holding its waitpid lock, so
+        ``poll()`` can never observe the exit — fall back to a direct
+        ``waitpid(WNOHANG)``: the suspended ``wait()`` then resumes to
+        ECHILD, which Popen reports as status 0 (the deliberate-stop
+        path tolerates the lost signal status)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if child.poll() is not None:
+                return True
+            if in_signal_handler:
+                try:
+                    pid, sts = os.waitpid(child.pid, os.WNOHANG)
+                except (ChildProcessError, OSError):
+                    return True         # reaped by the suspended wait()
+                if pid == child.pid:
+                    child.returncode = os.waitstatus_to_exitcode(sts)
+                    return True
+            time.sleep(0.02)
+        return child.poll() is not None
+
+    def install_signal_handlers(self,
+                                signals=(_signal.SIGTERM, _signal.SIGINT),
+                                kill_timeout_s: float = 10.0):
+        """Wire SIGTERM/SIGINT to :meth:`terminate` (main thread only —
+        CPython restricts ``signal.signal``).  Returns the previous
+        handlers so a caller can restore them."""
+        prev = {}
+        for sig in signals:
+            prev[sig] = _signal.signal(
+                sig, lambda *_a, _s=sig: self.terminate(
+                    _s, kill_timeout_s=kill_timeout_s,
+                    _in_signal_handler=True))
+        return prev
+
     def run_command(self, argv: Sequence[str], what: Optional[str] = None,
                     retryable_codes: Sequence[int] = (EXIT_PREEMPTED,),
                     check: bool = True, **popen_kw) -> int:
@@ -119,12 +217,42 @@ class Supervisor:
         the hard-preemption/SIGKILL case; the relaunch resumes from the
         last *periodic* checkpoint).  Exit 0 returns 0; any other status
         raises :class:`SupervisorGaveUp` when ``check`` else returns it.
+
+        The live child is tracked so :meth:`terminate` (or the CLI's
+        SIGTERM/SIGINT handlers) can forward the signal instead of
+        orphaning the process; a child death after :meth:`terminate` is a
+        deliberate stop — its status is returned as-is, never relaunched.
         """
         what = what or f"command {argv[0]!r}"
         self.restarts = 0
+        # subprocess.run-style per-attempt hard cap: not a Popen kwarg
+        timeout = popen_kw.pop("timeout", None)
+        with self._child_lock:
+            self._terminated = False
         while True:
-            proc = subprocess.run(list(argv), **popen_kw)
-            rc = proc.returncode
+            proc = subprocess.Popen(list(argv), **popen_kw)
+            with self._child_lock:
+                if self._terminated:
+                    # terminate() raced the launch: the new child would
+                    # never receive the forwarded signal — stop it now
+                    self._child = None
+                    proc.terminate()
+                else:
+                    self._child = proc
+            try:
+                rc = proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                # subprocess.run semantics: kill, reap, re-raise
+                with self._child_lock:
+                    self._child = None
+                proc.kill()
+                proc.wait()
+                raise
+            with self._child_lock:
+                self._child = None
+                terminated = self._terminated
+            if terminated:
+                return rc
             if rc == 0:
                 return 0
             retryable = rc in tuple(retryable_codes) or rc < 0
@@ -157,6 +285,10 @@ def main(argv=None):  # pragma: no cover - thin CLI shim
     sup = Supervisor(max_restarts=args.max_restarts,
                      backoff_base_s=args.backoff_base_s,
                      backoff_max_s=args.backoff_max_s)
+    # killing the supervisor must kill (not orphan) the supervised child:
+    # forward the signal, wait bounded, escalate to SIGKILL, exit with
+    # the child's status instead of relaunching
+    sup.install_signal_handlers()
     try:
         return sup.run_command(cmd)
     except SupervisorGaveUp as e:

@@ -138,6 +138,41 @@ METRIC_NAMES = (
     ("tuning/replays", "counter",
      "persisted winners replayed into call sites by tuned() (first "
      "lookup per site per process)"),
+    # HTTP serving front (paddle_tpu.serving.http): per-request writes
+    # are unconditional, same rationale as serving/* — the front IS the
+    # instrumented subsystem; training paths never reach these helpers
+    ("http/requests", "counter",
+     "HTTP inference requests received by the serving front"),
+    ("http/rejected", "counter",
+     "HTTP requests answered with a typed-rejection status (429/503/504) "
+     "or a 4xx protocol error"),
+    ("http/auth_failures", "counter",
+     "HTTP requests rejected 401/403 by the token -> model gate"),
+    ("http/request_ms", "histogram",
+     "HTTP request wall time: socket read to last response byte"),
+    # serving fleet (paddle_tpu.serving.fleet): router + autoscaler
+    # writes are unconditional for the same reason
+    ("fleet/requests", "counter",
+     "requests routed to a replica by the fleet router"),
+    ("fleet/failovers", "counter",
+     "admitted requests resubmitted to another replica after their "
+     "replica died or closed mid-flight (the zero-drop path)"),
+    ("fleet/evictions", "counter",
+     "replicas removed from the routable set (breaker open, draining, "
+     "dead, or unresponsive health)"),
+    ("fleet/relaunches", "counter",
+     "dead replicas relaunched through the supervisor's bounded-restart "
+     "accounting"),
+    ("fleet/router_shed", "counter",
+     "requests rejected Overloaded at the FLEET rim (every ready "
+     "replica at the backlog limit) — cheaper than a replica-side shed "
+     "that pays wire+parse first"),
+    ("fleet/scale_outs", "counter",
+     "autoscaler scale-out decisions executed (replica added)"),
+    ("fleet/scale_ins", "counter",
+     "autoscaler scale-in decisions executed (replica drained + removed)"),
+    ("fleet/replicas", "gauge",
+     "current fleet size by state (labels: ready/warming/draining/dead)"),
 )
 
 _MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
@@ -158,6 +193,7 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
     "serving/batch_size": _COUNT_BUCKETS,
     "serving/request_ms": _MS_BUCKETS,
     "tuning/trial_ms": _MS_BUCKETS,
+    "http/request_ms": _MS_BUCKETS,
 }
 _DEFAULT_BUCKETS = _MS_BUCKETS
 

@@ -80,6 +80,11 @@ SPAN_NAMES = (
      "(one trace per request; ends with status=ok or the typed error)"),
     ("serving/batch", "one coalesced serving batch: staging pickup -> "
      "dispatch -> reply; labels link member request ids and traces"),
+    ("http/request", "one HTTP front request: socket read -> backend "
+     "submit(s) -> last response byte; labels: method, path, status"),
+    ("fleet/autoscale", "one executed autoscaler decision: trigger "
+     "snapshot -> replica added or drained+removed; decision details "
+     "attach as span events"),
 )
 
 _REGISTERED = tuple(n for n, _ in SPAN_NAMES)

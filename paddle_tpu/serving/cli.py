@@ -12,8 +12,11 @@ Protocol (one JSON object per line):
 
   stdin  →  {"id": <any>, "model": "<name>"?, "feeds": {name: nested
             list}, "deadline_ms": <float|null>?}
-  stdout ←  {"id":..., "model":..., "outputs": [[...], ...], "ms": ...}
+         |  {"cmd": "health", "id": <any>?}      (control-plane poll)
+  stdout ←  {"id":..., "model":..., "outputs": [[...], ...], "ms": ...,
+            "dispatch_ms": ...}
          |  {"id":..., "error": "<TypeName>", "message": "..."}
+         |  {"id":..., "health": {"state":..., "models": {...}}}
          |  {"event": "state", "state": "warming|ready|draining|stopped"}
          |  {"event": "stopped", "served": N, ...}
 
@@ -48,17 +51,26 @@ __all__ = ["serve_main"]
 
 class _Emitter:
     """Line-atomic JSON writer shared by the reader loop and the
-    completion callbacks (which fire on dispatcher threads)."""
+    completion callbacks (which fire on dispatcher threads).  A broken
+    pipe (the consuming parent — e.g. a fleet router — died) disables
+    the writer instead of crashing the drain path: the following stdin
+    EOF drains the server and exits 0."""
 
     def __init__(self, fh):
         self._fh = fh
         self._lock = threading.Lock()
+        self._dead = False
 
     def emit(self, obj: dict):
         line = json.dumps(obj, default=repr)
         with self._lock:
-            self._fh.write(line + "\n")
-            self._fh.flush()
+            if self._dead:
+                return
+            try:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            except BrokenPipeError:
+                self._dead = True
 
 
 def _response_cb(emitter: _Emitter):
@@ -68,9 +80,16 @@ def _response_cb(emitter: _Emitter):
                           "error": type(pending.error).__name__,
                           "message": str(pending.error)})
         else:
+            # ms: admit -> complete server-side; dispatch_ms: the model
+            # call of the serving batch.  Their difference is the
+            # queue/batch wait — the fleet autoscaler's signal.
             emitter.emit({"id": pending.id, "model": pending.model,
                           "outputs": [None if o is None else o.tolist()
-                                      for o in pending.outputs]})
+                                      for o in pending.outputs],
+                          "ms": round((time.monotonic()
+                                       - pending.t_admit) * 1e3, 3),
+                          "dispatch_ms": None if pending.dispatch_ms is None
+                          else round(pending.dispatch_ms, 3)})
     return cb
 
 
@@ -130,6 +149,19 @@ def serve_main(argv=None) -> int:
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip warmup dispatches (first requests pay "
                          "compile)")
+    ap.add_argument("--warmup-all", action="store_true",
+                    help="warm EVERY batch bucket before ready (not "
+                         "just smallest+largest): steady-state "
+                         "benchmarks/fleets never pay a mid-window "
+                         "compile)")
+    ap.add_argument("--http", type=int, default=None, metavar="PORT",
+                    help="serve HTTP on PORT instead of the stdio "
+                         "protocol (serving/http.py; 0 = ephemeral, "
+                         "printed on the ready line)")
+    ap.add_argument("--http-host", default="127.0.0.1")
+    ap.add_argument("--token", action="append", metavar="TOKEN[=MODEL]",
+                    help="HTTP auth token, optionally bound to one "
+                         "model (repeatable; only with --http)")
     args = ap.parse_args(argv)
 
     srv = Server(
@@ -141,6 +173,8 @@ def serve_main(argv=None) -> int:
         breaker_cooldown_s=args.breaker_cooldown_s,
         warmup=not args.no_warmup,
         autotune=True if args.autotune else None)
+    if args.warmup_all:
+        srv.warmup_buckets = list(srv.buckets)
 
     emitter = _Emitter(sys.stdout)
 
@@ -161,6 +195,37 @@ def serve_main(argv=None) -> int:
 
     emitter.emit({"event": "state", "state": "warming"})
     srv.start()
+
+    if args.http is not None:
+        # HTTP front instead of the stdio loop (lazy: only --http pays
+        # for serving/http.py — the zero-cost-when-unused lint gate)
+        from .http import HttpFront
+
+        tokens = None
+        if args.token:
+            tokens = {}
+            for t in args.token:
+                tok, sep, model = t.partition("=")
+                tokens[tok] = model if sep else None
+        front = HttpFront(srv, host=args.http_host, port=args.http,
+                          tokens=tokens).start()
+        host, port = front.address
+        emitter.emit({"event": "state", "state": "ready",
+                      "host": host, "port": port,
+                      "models": sorted(srv.health()["models"])})
+        while not drain.is_set():
+            drain.wait(0.1)
+        # admission closes first: late HTTP requests get typed 503 +
+        # Connection: close while admitted work completes
+        srv.begin_drain()
+        emitter.emit({"event": "state", "state": "draining"})
+        srv.shutdown(drain=True)
+        front.stop()
+        h = srv.health()
+        emitter.emit({"event": "state", "state": "stopped"})
+        emitter.emit({"event": "stopped", "models": h["models"]})
+        return 0
+
     emitter.emit({"event": "state", "state": "ready",
                   "models": sorted(srv.health()["models"])})
 
@@ -228,8 +293,15 @@ def _handle_line(srv: Server, emitter: _Emitter, cb, line: str) -> int:
     """Parse + submit one request line; returns 1 if admitted."""
     try:
         msg = json.loads(line)
+        if isinstance(msg, dict) and msg.get("cmd") == "health":
+            # control-plane poll (the fleet router's routing signal):
+            # answered inline on the reader loop — queue depth must stay
+            # fresh even when every dispatcher is saturated
+            emitter.emit({"id": msg.get("id"), "health": srv.health()})
+            return 0
         if not isinstance(msg, dict) or "feeds" not in msg:
-            raise ValueError("want {'id', 'feeds': {...}}")
+            raise ValueError("want {'id', 'feeds': {...}} or "
+                             "{'cmd': 'health'}")
     except (json.JSONDecodeError, ValueError) as e:
         emitter.emit({"id": None, "error": "BadRequest", "message": str(e)})
         return 0

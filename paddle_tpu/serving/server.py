@@ -122,8 +122,8 @@ class PendingResponse:
     completing thread (or immediately if already terminal)."""
 
     __slots__ = ("id", "model", "feeds", "sig", "deadline", "t_admit",
-                 "outputs", "error", "span", "_event", "_callbacks",
-                 "_lock")
+                 "outputs", "error", "span", "dispatch_ms", "_event",
+                 "_callbacks", "_lock")
 
     def __init__(self, req_id, model: str, feeds, deadline: Optional[float]):
         self.id = req_id
@@ -134,6 +134,11 @@ class PendingResponse:
         self.t_admit = time.monotonic()
         self.outputs = None
         self.error: Optional[BaseException] = None
+        # model-dispatch wall of the batch that served this request (ms);
+        # None for rejected/expired requests.  total latency minus this
+        # is the queue/batch/staging wait — the fleet autoscaler's
+        # scale-out signal (serving_budget's decomposition, live)
+        self.dispatch_ms: Optional[float] = None
         # lifecycle tracing span (one trace per request), started at
         # admission on the submitting thread, ended by _complete on
         # whichever thread completes the request
@@ -746,5 +751,6 @@ class Server:
                        size=len(rows), bucket=bucket,
                        dispatch_ms=round(dispatch_ms, 3))
         for (_, r), out in zip(rows, split):
+            r.dispatch_ms = dispatch_ms
             r._complete(outputs=out)
         bsp.end(status="ok", dispatch_ms=round(dispatch_ms, 3))

@@ -9,6 +9,7 @@ tests/test_chaos_kill.py."""
 import hashlib
 import os
 import signal
+import time
 
 import numpy as np
 import pytest
@@ -793,6 +794,96 @@ def test_supervisor_run_command_relaunches_on_preempt_exit(tmp_path):
     with pytest.raises(SupervisorGaveUp):
         sup2.run_command([sys.executable, str(bad)])
     assert sup2.restarts == 0
+
+
+def test_supervisor_relaunch_gate_is_bounded():
+    """The fleet's composition surface: same restart accounting and
+    backoff as the run loops, exhausted after max_restarts."""
+    from paddle_tpu.distributed import Supervisor
+
+    sleeps = []
+    sup = Supervisor(max_restarts=2, backoff_base_s=0.25, jitter=0.0,
+                     sleep=sleeps.append)
+    assert sup.relaunch_gate("replica r0", "exit status -9") is True
+    assert sup.relaunch_gate("replica r0", "exit status -9") is True
+    assert sup.relaunch_gate("replica r0", "exit status -9") is False
+    assert sup.restarts == 2
+    assert sleeps == [0.25, 0.5]          # exponential, deterministic
+
+
+def _run_command_in_thread(sup, argv):
+    """Run sup.run_command(argv) in a thread; returns (thread, box)."""
+    import threading
+
+    box = {}
+
+    def target():
+        try:
+            box["rc"] = sup.run_command(argv)
+        except BaseException as e:   # noqa: BLE001 — surfaced via box
+            box["error"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    # wait for the child to exist so terminate() has a target
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        with sup._child_lock:
+            if sup._child is not None:
+                return t, box
+        time.sleep(0.005)
+    raise AssertionError("run_command never spawned its child")
+
+
+def test_supervisor_terminate_forwards_signal_without_relaunch():
+    """Killing the supervisor must kill the child, not orphan it — and a
+    signal death *caused by* terminate() is a deliberate stop, never a
+    relaunch trigger (signal deaths are otherwise retryable)."""
+    import sys
+
+    from paddle_tpu.distributed import Supervisor
+
+    sup = Supervisor(max_restarts=5, backoff_base_s=0.0, jitter=0.0,
+                     sleep=lambda s: None)
+    t, box = _run_command_in_thread(
+        sup, [sys.executable, "-c", "import time; time.sleep(60)"])
+    child = sup._child
+    sup.terminate()                       # forwards SIGTERM
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert "error" not in box, f"unexpected: {box.get('error')}"
+    assert box["rc"] == -signal.SIGTERM   # child died by the signal...
+    assert sup.restarts == 0              # ...and was NOT relaunched
+    assert child.poll() is not None       # and is reaped, not orphaned
+
+
+def test_supervisor_terminate_escalates_to_sigkill(tmp_path):
+    """A child that ignores SIGTERM is escalated to SIGKILL after the
+    bounded wait instead of stalling the drain forever."""
+    import sys
+
+    from paddle_tpu.distributed import Supervisor
+
+    flag = tmp_path / "ignoring"
+    script = (
+        "import signal, time, sys\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        f"open({str(flag)!r}, 'w').close()\n"
+        "time.sleep(60)\n")
+    sup = Supervisor(max_restarts=5, backoff_base_s=0.0, jitter=0.0,
+                     sleep=lambda s: None)
+    t, box = _run_command_in_thread(sup, [sys.executable, "-c", script])
+    deadline = time.monotonic() + 10.0
+    while not flag.exists():              # handler installed before TERM
+        assert time.monotonic() < deadline, "child never started"
+        time.sleep(0.005)
+    t0 = time.monotonic()
+    sup.terminate(kill_timeout_s=0.5)
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert box["rc"] == -signal.SIGKILL
+    assert time.monotonic() - t0 < 10.0   # bounded, not a hang
+    assert sup.restarts == 0
 
 
 # ---------------------------------------------------------------------------

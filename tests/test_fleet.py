@@ -1,0 +1,345 @@
+"""Serving fleet (ISSUE 11 tentpole b+c), in-process half: the
+queue-depth-aware router, health-state eviction/re-add, breaker
+eviction, death failover (the zero-drop path), and the autoscaling
+policy matrix + an end-to-end scale-out/in round — all over
+:class:`LocalReplica` fleet members (threaded, single process), so
+tier-1 stays lean.  Real multi-process rounds (SIGKILL chaos, the fleet
+CLI) live in tests/test_fleet_chaos.py under @pytest.mark.slow.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import faults
+from paddle_tpu.serving import Server
+from paddle_tpu.serving.fleet import (AutoscalePolicy, FleetRouter,
+                                      LocalReplica)
+
+from test_serving import FakeModel, _mk_server, _req
+
+
+def _counter(name):
+    return pt.observability.registry().snapshot()[name]["value"]
+
+
+class _FleetFixture:
+    """N FakeModel-backed LocalReplicas behind a router; keeps handles
+    to every fake and server for gating/poisoning."""
+
+    def __init__(self, n=2, policy=None, server_kw=None, **router_kw):
+        self.fakes = []
+        self.servers = []
+        self.server_kw = dict(server_kw or {})
+
+        def factory(i):
+            fake = FakeModel()
+            srv = _mk_server(fake, **self.server_kw)
+            self.fakes.append(fake)
+            self.servers.append(srv)
+            return LocalReplica(srv, name=f"rep{i}")
+
+        router_kw.setdefault("poll_interval_s", 0.02)
+        self.router = FleetRouter(factory, replicas=n, **router_kw)
+        self.router.start()
+
+    def replica(self, i) -> LocalReplica:
+        return self.router.replicas[i]
+
+    def shutdown(self):
+        self.router.shutdown(timeout_s=20)
+
+
+@pytest.fixture
+def fleet2():
+    f = _FleetFixture(n=2)
+    yield f
+    f.shutdown()
+
+
+def test_router_serves_and_health_aggregates(fleet2):
+    out = fleet2.router.submit(_req(1)).result(timeout=10)
+    np.testing.assert_array_equal(out[0], np.full(2, 2.0, "float32"))
+    h = fleet2.router.health()
+    assert h["ready"] is True and h["state"] == "ready"
+    assert sorted(h["replicas"]) == ["rep0", "rep1"]
+    assert all(v["routable"] for v in h["replicas"].values())
+
+
+def test_routes_to_least_loaded_replica():
+    f = _FleetFixture(n=2, server_kw={"deadline_ms": None})
+    try:
+        # build real queue depth on rep0 by gating its model
+        f.fakes[0].gate = threading.Event()
+        held = [f.servers[0].submit(_req(100 + i)) for i in range(4)]
+        f.router._poll_all()              # refresh the routing signal
+        assert f.replica(0).queue_depth() > 0
+        out = f.router.submit(_req(5)).result(timeout=10)
+        assert out is not None
+        assert 5.0 in f.fakes[1].rows     # routed around the deep queue
+        assert 5.0 not in f.fakes[0].rows
+        f.fakes[0].open_gate_forever()
+        for r in held:
+            assert r.result(timeout=10) is not None
+    finally:
+        f.fakes[0].open_gate_forever()
+        f.shutdown()
+
+
+def test_draining_replica_is_evicted(fleet2):
+    rep0 = fleet2.replica(0)              # before the reaper drops it
+    before = _counter("fleet/evictions")
+    rep0.server.begin_drain()
+    fleet2.router._poll_all()
+    assert not fleet2.router._is_routable(rep0)
+    assert _counter("fleet/evictions") >= before + 1
+    for i in range(3):
+        fleet2.router.submit(_req(i)).result(timeout=10)
+    assert not fleet2.fakes[0].rows       # all routed to the survivor
+    assert len(fleet2.fakes[1].rows) == 3
+
+
+def test_breaker_open_is_an_eviction_signal_and_readds():
+    f = _FleetFixture(n=2, server_kw={"breaker_threshold": 1,
+                                      "breaker_cooldown_s": 0.05,
+                                      "retry_policy": None})
+    try:
+        f.fakes[0].fail = [RuntimeError("poison")]
+        with pytest.raises(Exception):
+            f.servers[0].infer(_req(1), timeout=10)
+        assert f.servers[0].health()["models"]["fake"]["breaker"] == "open"
+        f.router._poll_all()
+        assert not f.router._is_routable(f.replica(0))   # evicted
+        f.router.submit(_req(2)).result(timeout=10)
+        assert 2.0 in f.fakes[1].rows
+        # cooldown passes; a successful probe recloses -> re-added
+        time.sleep(0.1)
+        f.servers[0].infer(_req(3), timeout=10)
+        f.router._poll_all()
+        assert f.router._is_routable(f.replica(0))
+    finally:
+        f.shutdown()
+
+
+def test_replica_death_fails_over_admitted_requests_zero_drop():
+    """A replica aborting admitted work (the in-process analog of
+    SIGKILL) must not surface to the client: the router resubmits to a
+    survivor and the ONE client handle completes with real outputs."""
+    f = _FleetFixture(n=2, server_kw={"deadline_ms": None,
+                                      "max_batch": 1,
+                                      "staging_depth": 1},
+                      poll_interval_s=30.0)   # manual polls only
+    try:
+        rep0, rep1 = f.replica(0), f.replica(1)
+        f.fakes[0].gate = threading.Event()
+        # park rep1 (stale health = unroutable) so every submit lands on
+        # rep0: fp1 dispatching (gated), fp2 staged, fp3 in the blocked
+        # batcher's hands, fp4 in the ADMISSION QUEUE
+        rep1.last_health_ts = 0.0
+        fps = [f.router.submit(_req(10 + i)) for i in range(4)]
+        time.sleep(0.2)
+        rep1.poll_health()                # survivor back in the pool
+        before = _counter("fleet/failovers")
+        killer = threading.Thread(target=rep0.kill, daemon=True)
+        killer.start()                    # aborts fp4 (queued) first
+        time.sleep(0.1)
+        f.fakes[0].open_gate_forever()    # free the wedged dispatches
+        killer.join(timeout=15)
+        for fp in fps:
+            out = fp.result(timeout=15)   # all complete despite the kill
+            assert out is not None
+        assert _counter("fleet/failovers") >= before + 1
+        served = sorted(set(f.fakes[0].rows + f.fakes[1].rows))
+        assert served == [10.0, 11.0, 12.0, 13.0]     # none lost
+        assert 13.0 in f.fakes[1].rows    # the aborted one failed over
+    finally:
+        f.fakes[0].open_gate_forever()
+        f.shutdown()
+
+
+def test_router_backlog_limit_sheds_at_the_fleet_rim():
+    """With every ready replica at the backlog limit, the router
+    rejects Overloaded WITHOUT paying the replica's wire+parse — but a
+    failover resubmission (already admitted fleet-wide) is exempt."""
+    f = _FleetFixture(n=1, server_kw={"deadline_ms": None},
+                      backlog_limit=2, poll_interval_s=30.0)
+    try:
+        before = _counter("fleet/router_shed")
+        f.fakes[0].gate = threading.Event()
+        fp1 = f.router.submit(_req(1))   # dispatching (gated)
+        fp2 = f.router.submit(_req(2))   # backlog 2 = at the limit
+        with pytest.raises(faults.Overloaded, match="fleet saturated"):
+            f.router.submit(_req(3))
+        assert _counter("fleet/router_shed") == before + 1
+        assert 3.0 not in f.fakes[0].rows      # never hit the replica
+        f.fakes[0].open_gate_forever()
+        assert fp1.result(timeout=10) is not None
+        assert fp2.result(timeout=10) is not None
+    finally:
+        f.fakes[0].open_gate_forever()
+        f.shutdown()
+
+
+def test_cordon_removes_and_readds_without_touching_the_process(fleet2):
+    """Administrative cordon: unroutable immediately, process and
+    admitted work untouched; uncordon restores routing."""
+    fleet2.router.cordon("rep0")
+    for i in range(3):
+        fleet2.router.submit(_req(i)).result(timeout=10)
+    assert not fleet2.fakes[0].rows       # all routed around the cordon
+    assert fleet2.replica(0).alive        # process untouched
+    fleet2.router.cordon("rep0", cordoned=False)
+    fleet2.replica(1).cordoned = True     # force the other way
+    fleet2.router.submit(_req(9)).result(timeout=10)
+    assert 9.0 in fleet2.fakes[0].rows
+    with pytest.raises(ValueError):
+        fleet2.router.cordon("ghost")
+
+
+def test_fleet_draining_rejects_typed(fleet2):
+    fleet2.router.begin_drain()
+    with pytest.raises(faults.ServerClosed):
+        fleet2.router.submit(_req(1))
+    assert fleet2.router.health()["ready"] is False
+
+
+def test_no_routable_replica_raises_model_unavailable():
+    f = _FleetFixture(n=1)
+    try:
+        f.replica(0).server.begin_drain()
+        f.router._poll_all()
+        with pytest.raises(faults.ModelUnavailable):
+            f.router.submit(_req(1))
+    finally:
+        f.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# autoscaling policy (pure decision matrix) + e2e apply
+# ---------------------------------------------------------------------------
+def _snap(**kw):
+    base = {"replicas": 2, "p99_ms": 100.0, "wait_share_p99": 0.8,
+            "queue_depth": 4, "served_per_s": 50.0, "idle_s": 0.0,
+            "since_last_decision_s": 1e9}
+    base.update(kw)
+    return base
+
+
+def test_autoscale_policy_matrix():
+    pol = AutoscalePolicy(wait_share_threshold=0.5, p99_floor_ms=20.0,
+                          idle_rate_per_replica=1.0, idle_for_s=5.0,
+                          min_replicas=1, max_replicas=4, cooldown_s=2.0)
+    # scale-out: wait-dominated p99
+    d = pol.decide(_snap())
+    assert d and d["action"] == "scale_out"
+    assert "queue-wait share" in d["reason"]
+    # dispatch-dominated: more replicas won't help
+    assert pol.decide(_snap(wait_share_p99=0.2)) is None
+    # below the p99 floor: idle jitter never scales
+    assert pol.decide(_snap(p99_ms=5.0)) is None
+    # no window yet: no decision
+    assert pol.decide(_snap(p99_ms=None, wait_share_p99=None)) is None
+    # at max replicas: bounded
+    assert pol.decide(_snap(replicas=4)) is None
+    # cooldown: bounded rate of change
+    assert pol.decide(_snap(since_last_decision_s=0.5)) is None
+    # scale-in: sustained idle
+    d = pol.decide(_snap(wait_share_p99=0.0, p99_ms=1.0, queue_depth=0,
+                         served_per_s=0.0, idle_s=10.0))
+    assert d and d["action"] == "scale_in"
+    # ...but never below min_replicas
+    assert pol.decide(_snap(replicas=1, wait_share_p99=0.0, p99_ms=1.0,
+                            queue_depth=0, served_per_s=0.0,
+                            idle_s=10.0)) is None
+    # ...and not while the queue is non-empty
+    assert pol.decide(_snap(wait_share_p99=0.0, p99_ms=1.0,
+                            queue_depth=3, served_per_s=0.0,
+                            idle_s=10.0)) is None
+    # bad bounds rejected
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+
+
+def test_autoscale_apply_scales_out_then_in():
+    # policy held OUTSIDE the router (no timer thread): the test drives
+    # snapshot -> decide -> apply deterministically
+    pol = AutoscalePolicy(wait_share_threshold=0.5, p99_floor_ms=1.0,
+                          idle_rate_per_replica=1.0, idle_for_s=0.0,
+                          min_replicas=1, max_replicas=3, cooldown_s=0.0)
+    f = _FleetFixture(n=1)
+    try:
+        # seed a wait-dominated window (total 100 ms, dispatch 5 ms)
+        with f.router._lock:
+            for _ in range(32):
+                f.router._window.append((100.0, 5.0))
+        outs_before = _counter("fleet/scale_outs")
+        snap = f.router.autoscale_snapshot()
+        decision = pol.decide(snap)
+        assert decision and decision["action"] == "scale_out"
+        f.router.apply_decision(decision, snap)
+        assert len(f.router.replicas) == 2
+        assert _counter("fleet/scale_outs") == outs_before + 1
+        f.router._poll_all()
+        assert len(f.router._routable()) == 2
+        # the new replica serves
+        for i in range(4):
+            f.router.submit(_req(i)).result(timeout=10)
+        # now idle: scale back in through graceful drain
+        with f.router._lock:
+            f.router._window.clear()
+        f.router.autoscale_snapshot()     # reset the served-rate window
+        time.sleep(0.05)
+        f.router._idle_since = time.monotonic() - 60.0
+        ins_before = _counter("fleet/scale_ins")
+        snap = f.router.autoscale_snapshot()
+        snap["idle_s"] = 60.0
+        decision = pol.decide(snap)
+        assert decision and decision["action"] == "scale_in"
+        f.router.apply_decision(decision, snap)
+        assert _counter("fleet/scale_ins") == ins_before + 1
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            f.router._poll_all()
+            f.router._reap_stopped()
+            if len(f.router.replicas) == 1:
+                break
+            time.sleep(0.05)
+        assert len(f.router.replicas) == 1   # drained + reaped
+        # the survivor still serves
+        f.router.submit(_req(9)).result(timeout=10)
+    finally:
+        f.shutdown()
+
+
+def test_fleet_behind_http_front(fleet2):
+    """The router exposes the server surface, so the HTTP front fronts
+    a fleet unchanged — including drain -> 503 + Connection: close."""
+    import http.client
+    import json
+
+    from paddle_tpu.serving.http import HttpFront
+
+    front = HttpFront(fleet2.router, port=0).start()
+    try:
+        host, port = front.address
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("POST", "/v1/infer",
+                     body=json.dumps({"id": 1, "feeds": {"x": [1.0, 2.0]}}))
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200 and body["outputs"] == [[2.0, 4.0]]
+        conn.close()
+        fleet2.router.begin_drain()
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("POST", "/v1/infer",
+                     body=json.dumps({"id": 2, "feeds": {"x": [1.0, 2.0]}}))
+        resp = conn.getresponse()
+        assert resp.status == 503
+        assert resp.getheader("Connection", "").lower() == "close"
+        conn.close()
+    finally:
+        front.stop()

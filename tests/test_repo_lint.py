@@ -551,6 +551,96 @@ def test_attribution_module_only_imported_lazily():
             in fh.read()
 
 
+def _top_level_serving_submodule_imports(submods=("http", "fleet")):
+    """(rel, lineno) of every TOP-LEVEL import of
+    paddle_tpu/serving/{http,fleet}.py from any OTHER module — including
+    serving/__init__.py and serving/cli.py: importing paddle_tpu.serving
+    (the Server surface) must not load the network front or the fleet
+    router.  Lazy imports inside function bodies are the sanctioned
+    form.  Careful with stdlib collisions: absolute ``import
+    http.client`` is NOT a hit."""
+    own = {f"paddle_tpu/serving/{m}.py" for m in submods}
+
+    def _is_hit(node, rel):
+        in_serving = rel.startswith("paddle_tpu/serving/")
+        full = tuple(f"paddle_tpu.serving.{m}" for m in submods)
+        if isinstance(node, ast.Import):
+            return any(a.name.startswith(full) for a in node.names)
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith(full):
+                return True
+            if mod in ("paddle_tpu.serving", "serving"):
+                return any(a.name in submods for a in node.names)
+            if node.level > 0 and in_serving:
+                # from .http import X / from . import http
+                if mod in submods:
+                    return True
+                if mod == "" and any(a.name in submods
+                                     for a in node.names):
+                    return True
+        return False
+
+    found = []
+    for rel, tree in _iter_sources():
+        if rel in own:
+            continue
+
+        def visit(node, in_func):
+            for child in ast.iter_child_nodes(node):
+                nested = in_func or isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                if _is_hit(child, rel) and not in_func:
+                    found.append((rel, child.lineno))
+                visit(child, nested)
+        visit(tree, False)
+    return found
+
+
+def test_http_and_fleet_modules_only_imported_lazily():
+    """Zero-cost-when-unused for the NEW serving-fleet modules (ISSUE
+    11): importing paddle_tpu — or paddle_tpu.serving itself, i.e.
+    running a plain Server — loads neither serving/http.py nor
+    serving/fleet.py.  Only the opted-in surfaces (`serve --http`, the
+    `fleet` CLI branch) may import them, lazily.
+    tests/test_fleet_chaos.py proves the runtime half in a fresh
+    interpreter (@slow)."""
+    problems = [
+        f"{rel}:{lineno}: top-level import of serving.http/serving.fleet "
+        f"— must be lazy (inside a function) so `import paddle_tpu"
+        f".serving` stays front/fleet-free"
+        for rel, lineno in _top_level_serving_submodule_imports()]
+    assert not problems, "\n".join(problems)
+    # and the sanctioned lazy sites exist
+    with open(os.path.join(ROOT, "serving", "cli.py")) as fh:
+        assert "from .http import HttpFront" in fh.read()   # serve --http
+    with open(os.path.join(ROOT, "cli.py")) as fh:
+        assert "from paddle_tpu.serving.fleet import fleet_main" \
+            in fh.read()                                    # fleet branch
+    with open(os.path.join(ROOT, "serving", "fleet.py")) as fh:
+        assert "from .http import HttpFront" in fh.read()   # fleet_main
+
+
+def test_lint_gate_covers_http_and_fleet_modules():
+    """serving/http.py + serving/fleet.py are inside every lint's scan
+    set, their http/* + fleet/* metric names are frozen in METRIC_NAMES,
+    and their span names are frozen in SPAN_NAMES (the used==registered
+    span check then keeps both instrumented)."""
+    rels = {rel for rel, _ in _iter_sources()}
+    assert "paddle_tpu/serving/http.py" in rels
+    assert "paddle_tpu/serving/fleet.py" in rels
+    registered = {n for n, _ in _metric_names_table()}
+    assert {n for n in registered if n.startswith("http/")} >= {
+        "http/requests", "http/rejected", "http/auth_failures",
+        "http/request_ms"}
+    assert {n for n in registered if n.startswith("fleet/")} >= {
+        "fleet/requests", "fleet/failovers", "fleet/evictions",
+        "fleet/relaunches", "fleet/router_shed", "fleet/scale_outs",
+        "fleet/scale_ins", "fleet/replicas"}
+    spans = set(_span_names_table())
+    assert {"http/request", "fleet/autoscale"} <= spans
+
+
 def test_shard_fn_registry_matches_ast_scan():
     """Same agreement gate for the sharding-propagation rules: every
     live register_shard_fn name is a string literal the duplicate lint
