@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""ISSUE 12 acceptance run: the eager per-op profiler over three real
+training programs (wide_deep CTR, one CIFAR resnet basic block, a small
+LSTM classifier), committed as ``benchmark/opprof_results.json``.
+
+Each row is one ``observability.opprof.profile_program`` report reduced
+to the acceptance facts:
+
+* the per-op measured table sums to the eager-replay total within the
+  pinned tolerance (``opprof.TOLERANCE`` = ``BUDGET_TOLERANCE`` = 15%),
+* the top-3 ops by measured time are NAMED (with phase + roofline
+  verdict where the static model joined),
+* the ranked XLA-loses-here op classes, carrying the pre-registered
+  Pallas-candidate rule IDs where one matches,
+* the measured-vs-modeled peak-HBM position from the liveness walk.
+
+The merged per-op-class calibration table (the format-2
+``attribution.save_op_class_calibration`` document) is embedded under
+``"calibration"`` — ``attribution.load_op_class_ratios`` reads it
+directly and ``paddle_tpu plan --calibration benchmark/opprof_results...``
+is NOT the supported spelling (the table is nested); use
+
+    python -m paddle_tpu profile prog.json --calibration-out table.json
+    python -m paddle_tpu plan prog.json --mesh dp=8 --calibration table.json
+
+for the live workflow.  Run:
+
+    python benchmark/opprof.py [--smoke] [--out PATH]
+
+Prints one JSON line per model, then writes the results document.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+RESULTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "opprof_results.json")
+
+
+# ---------------------------------------------------------------------------
+# Model builders (fixed shapes, seeded feeds — reruns profile the same
+# program on the same data)
+# ---------------------------------------------------------------------------
+def build_wide_deep(rng):
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    B, nsparse, vocab, dense_d = 64, 8, 1000, 13
+    sparse = [layers.data(f"s{i}", shape=[1], dtype="int64")
+              for i in range(nsparse)]
+    dense = layers.data("dense", shape=[dense_d], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="float32")
+    ctr = models.wide_deep(sparse, dense, [vocab] * nsparse)
+    loss = layers.mean(layers.log_loss(ctr, label))
+    pt.optimizer.Adam(1e-3).minimize(loss)
+    feeds = {f"s{i}": rng.randint(0, vocab, (B, 1)) for i in range(nsparse)}
+    feeds["dense"] = rng.rand(B, dense_d).astype("float32")
+    feeds["label"] = rng.randint(0, 2, (B, 1)).astype("float32")
+    return feeds, B
+
+
+def build_resnet_block(rng):
+    """One CIFAR basic block (conv-bn-relu x2 + residual add) + head —
+    the conv/batch_norm op-class row without resnet-20's 60+ op walk."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.models.resnet import basic_block
+
+    B = 16
+    img = layers.data("img", shape=[16, 16, 16], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    block = basic_block(img, 16, 16, 1)
+    pool = layers.pool2d(block, pool_type="avg", global_pooling=True)
+    pred = layers.fc(pool, size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    pt.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(loss)
+    feeds = {"img": rng.rand(B, 16, 16, 16).astype("float32"),
+             "label": rng.randint(0, 10, (B, 1))}
+    return feeds, B
+
+
+def build_lstm(rng):
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    B, T, vocab = 16, 24, 2000
+    words = layers.data("words", shape=[], dtype="int64", lod_level=1)
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = models.lstm_text_classification(
+        words, vocab_size=vocab, num_classes=2, emb_dim=32,
+        hidden_size=64, lstm_num=1)
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    pt.optimizer.Adam(1e-3).minimize(loss)
+    feeds = {"words": rng.randint(0, vocab, (B, T)),
+             "words@LEN": np.full(B, T),
+             "label": rng.randint(0, 2, (B, 1))}
+    return feeds, B
+
+
+MODELS = {"wide_deep": build_wide_deep,
+          "resnet_block": build_resnet_block,
+          "lstm": build_lstm}
+
+
+# ---------------------------------------------------------------------------
+def profile_model(name, *, reps, warmup):
+    import paddle_tpu as pt
+    from paddle_tpu.observability import opprof
+
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    pt.unique_name.reset()
+    rng = np.random.RandomState(7)
+    feeds, batch = MODELS[name](rng)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    report = opprof.profile_program(
+        pt.default_main_program(), executor=exe, feed=feeds,
+        batch=batch, reps=reps, warmup=warmup)
+
+    def top_row(r):
+        out = {"op_type": r["op_type"], "index": r["index"],
+               "phase": r["phase"], "wall_ms": r["wall_ms"],
+               "share": round(r["wall_ms"] / report["per_op_sum_ms"], 4)
+               if report["per_op_sum_ms"] else 0.0}
+        m = r.get("modeled")
+        if m:
+            out["roofline"] = m["roofline"]
+            out["ratio"] = r.get("ratio")
+        return out
+
+    mem = report["memory"]
+    row = {
+        "model": name, "program": report["program"],
+        "batch": batch, "reps": reps, "warmup": warmup,
+        "ops": report["ops"],
+        "eager_total_ms": report["eager_total_ms"],
+        "per_op_sum_ms": report["per_op_sum_ms"],
+        "sum_gap_frac": report["sum_gap_frac"],
+        "tolerance": report["tolerance"],
+        "within_tolerance": report["within_tolerance"],
+        "top3": [top_row(r) for r in report["top"][:3]],
+        "xla_loses_here": report["xla_loses_here"][:5],
+        "memory": {k: mem[k] for k in
+                   ("state_bytes", "peak_bytes", "peak_index", "peak_op",
+                    "modeled_peak_bytes", "peak_ratio") if k in mem},
+    }
+    return row, report["op_classes"]
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="reps=1/warmup=1 sanity pass; does not rewrite "
+                         "the committed results unless --out is given")
+    ap.add_argument("--reps", type=int, default=5,
+                    help="timed windows per op (median; default 5)")
+    ap.add_argument("--warmup", type=int, default=2,
+                    help="discarded warmup windows per op (default 2)")
+    ap.add_argument("--out", default=None,
+                    help=f"results path (default {RESULTS_PATH}; "
+                         f"--smoke without --out prints only)")
+    args = ap.parse_args()
+    reps, warmup = (1, 1) if args.smoke else (args.reps, args.warmup)
+
+    rows = []
+    op_classes = {}
+    for name in MODELS:
+        row, classes = profile_model(name, reps=reps, warmup=warmup)
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+        for c in classes:
+            op_classes[f"{c['program']}:{c['op_type']}"] = c
+
+    doc = {
+        "description":
+            "ISSUE 12 acceptance artifact: eager per-op profiles "
+            "(observability.opprof) of three REAL in-container training "
+            "steps — per-op measured table vs the one-shot eager-replay "
+            "total (must reconcile within opprof.TOLERANCE=0.15), top-3 "
+            "ops named with phase + roofline verdict, ranked "
+            "XLA-loses-here op classes carrying the pre-registered "
+            "Pallas-candidate rule IDs, and the liveness walk's "
+            "measured-vs-modeled peak HBM.  'calibration' is the "
+            "format-2 attribution calibration document whose op_classes "
+            "section analysis.planner.plan(op_class_ratios=...) "
+            "consumes via attribution.load_op_class_ratios.",
+        "platform": "cpu (no TPU reachable this session; ~1 effective "
+                    "host core — eager per-op walls are HOST-dominated "
+                    "dispatch costs, so the measured/predicted ratios "
+                    "calibrate the CPU fallback, not chip silicon; "
+                    "rerun on hardware to commit chip ratios)",
+        "rows": rows,
+        "calibration": {"format": 2, "programs": {},
+                        "op_classes": op_classes},
+    }
+    out = args.out or (None if args.smoke else RESULTS_PATH)
+    if out:
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        print(json.dumps({"wrote": out,
+                          "models": [r["model"] for r in rows],
+                          "all_within_tolerance":
+                          all(r["within_tolerance"] for r in rows)}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

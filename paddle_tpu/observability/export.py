@@ -215,7 +215,7 @@ def iter_log_events(paths) -> "tuple[List[dict], List[dict]]":
         paths = [paths]
     events: List[dict] = []
     files: List[dict] = []
-    for path in paths:
+    for src, path in enumerate(paths):
         n = corrupt = 0
         t_first = t_last = None
         with open(path, errors="replace") as fh:
@@ -243,13 +243,18 @@ def iter_log_events(paths) -> "tuple[List[dict], List[dict]]":
                     # summary — coerce to the file position
                     ev = {**ev, "ts": t_last if t_last is not None
                           else 0.0}
+                # carry the SOURCE FILE index through the time-ordered
+                # merge: relaunch logs interleave by coerced ts only, so
+                # without it a rendered row cannot be attributed to the
+                # right attempt (the `files` list maps index -> path)
+                ev["_src"] = src
                 events.append(ev)
         if corrupt:
             logger.warning("metrics log %r: skipped %d corrupt/truncated "
                            "line(s) (torn writes from a killed process "
                            "are expected; the summary continues)",
                            str(path), corrupt)
-        files.append({"file": str(path), "events": n,
+        files.append({"file": str(path), "index": src, "events": n,
                       "corrupt_lines": corrupt,
                       "t_first": t_first, "t_last": t_last})
     if len(files) > 1:
@@ -315,9 +320,12 @@ def summarize_logs(paths) -> dict:
         if t_first is not None and t_last is not None else None,
     }
     if len(files) > 1:
-        # restart boundaries: where each relaunch's log begins
+        # restart boundaries: where each relaunch's log begins; "source"
+        # is the index fault-timeline rows carry (the original argument
+        # position, stable across the time-order sort)
         summary["restarts"] = [
-            {"file": f["file"], "ts": f["t_first"], "events": f["events"]}
+            {"file": f["file"], "source": f["index"], "ts": f["t_first"],
+             "events": f["events"]}
             for f in files]
     if steps:
         n_steps = sum(int(e.get("steps", 1)) for e in steps)
@@ -373,14 +381,24 @@ def summarize_logs(paths) -> dict:
         for e in faults:
             key = str(e.get("event", "unknown"))
             by_event[key] = by_event.get(key, 0) + 1
+        multi = len(files) > 1
+
+        def _fault_row(e):
+            row = {k: e.get(k) for k in
+                   ("event", "site", "index", "action", "step",
+                    "attempt", "error", "delay_s")
+                   if e.get(k) is not None}
+            if multi:
+                # a merged timeline interleaves relaunch logs by ts
+                # only; the source-file index makes each row
+                # attributable to the right attempt
+                row["source"] = e.get("_src")
+            return row
+
         summary["faults"] = {
             "events": len(faults), "by_event": by_event,
             # first few, enough to see a run's failure story at a glance
-            "timeline": [{k: e.get(k) for k in
-                          ("event", "site", "index", "action", "step",
-                           "attempt", "error", "delay_s")
-                          if e.get(k) is not None}
-                         for e in faults[:10]],
+            "timeline": [_fault_row(e) for e in faults[:10]],
         }
     if servings:
         by_event: Dict[str, int] = {}
@@ -442,7 +460,8 @@ def render_summary(summary: dict) -> str:
              + (f" wall_s={summary['wall_s']}"
                 if summary.get("wall_s") is not None else "")]
     for r in summary.get("restarts", []):
-        lines.append(f"  restart boundary: {r['file']} "
+        lines.append(f"  restart boundary: [{r.get('source', '?')}] "
+                     f"{r['file']} "
                      f"({r['events']} event(s), from ts={r['ts']})")
     st = summary.get("steps")
     if st:
@@ -473,8 +492,9 @@ def render_summary(summary: dict) -> str:
         lines.append(f"faults: {fl['events']} event(s): {kinds}")
         for e in fl["timeline"]:
             lines.append("  fault: " + " ".join(
-                f"{k}={e[k]}" for k in ("event", "site", "index", "action",
-                                        "step", "attempt", "delay_s",
+                f"{k}={e[k]}" for k in ("source", "event", "site",
+                                        "index", "action", "step",
+                                        "attempt", "delay_s",
                                         "error") if k in e))
     sv = summary.get("serving")
     if sv:

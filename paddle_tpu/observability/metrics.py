@@ -173,6 +173,15 @@ METRIC_NAMES = (
      "autoscaler scale-in decisions executed (replica drained + removed)"),
     ("fleet/replicas", "gauge",
      "current fleet size by state (labels: ready/warming/draining/dead)"),
+    # per-op profiler (observability.opprof): writes are cold paths by
+    # construction — a profile run IS the workload, like tuning; training
+    # paths never reach these helpers (opprof is lazy-import gated)
+    ("opprof/runs", "counter",
+     "per-op profile runs executed (profile CLI / doctor --per-op)"),
+    ("opprof/ops", "counter",
+     "ops measured by the per-op profiler (one per op per run)"),
+    ("opprof/op_ms", "histogram",
+     "measured per-op eager wall time (median of timed windows)"),
 )
 
 _MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
@@ -194,6 +203,7 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
     "serving/request_ms": _MS_BUCKETS,
     "tuning/trial_ms": _MS_BUCKETS,
     "http/request_ms": _MS_BUCKETS,
+    "opprof/op_ms": _MS_BUCKETS,
 }
 _DEFAULT_BUCKETS = _MS_BUCKETS
 

@@ -23,7 +23,47 @@ from typing import Dict, Optional
 
 logger = logging.getLogger("paddle_tpu")
 
-__all__ = ["bisect_step", "format_diagnosis"]
+__all__ = ["bisect_step", "format_diagnosis", "make_eager_context"]
+
+
+def make_eager_context(executor, program, feed_arrays, state, step: int,
+                       is_test: bool = False):
+    """``(env, ctx, bw_idx)`` for an eager per-op replay of one step,
+    replicating the compiled step's input dtype coercion EXACTLY
+    (core/executor.py ``_make_fn``): compute_dtype upcast first, then
+    pure-inference AMP bf16.  Shared by the NaN bisect here and the
+    per-op profiler (``observability.opprof``) so both replay at the
+    SAME precision the compiled step computed at — a diagnosis or a
+    per-op timing taken at another precision would describe a different
+    computation."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.executor import Env, LoweringContext, _to_bf16
+
+    ops = program.global_block().ops
+    bw_idx = next((i for i, op in enumerate(ops)
+                   if op.type == "backward"), None)
+
+    env = Env(program.global_block())
+    env.local.update({k: jnp.asarray(v) for k, v in state.items()})
+    env.local.update({k: jnp.asarray(v) for k, v in feed_arrays.items()})
+    if executor.compute_dtype is not None:
+        cd = jnp.dtype(executor.compute_dtype)
+        env.local = {k: v.astype(cd) if hasattr(v, "dtype")
+                     and jnp.issubdtype(v.dtype, jnp.floating)
+                     else v for k, v in env.local.items()}
+    if executor.amp and bw_idx is None:
+        env.local = {k: _to_bf16(v) for k, v in env.local.items()}
+
+    base_key = jax.random.fold_in(
+        jax.random.PRNGKey(program.random_seed), step)
+    ctx = LoweringContext(
+        program, base_key, is_test=is_test, amp=executor.amp,
+        mesh=getattr(executor, "mesh", None),
+        compute_dtype=executor.compute_dtype,
+        conv1x1_pallas=executor.conv1x1_pallas)
+    return env, ctx, bw_idx
 
 
 def _nonfinite(value) -> Optional[Dict[str, int]]:
@@ -77,30 +117,15 @@ def bisect_step(executor, program, feed_arrays, state, step: int,
 
 
 def _bisect(executor, program, feed_arrays, state, step, is_test):
-    import jax
-    import jax.numpy as jnp
-
-    from ..core.executor import (Env, LoweringContext, _run_backward,
-                                 _to_bf16, grad_var_name, run_op)
+    from ..core.executor import _run_backward, grad_var_name, run_op
 
     ops = program.global_block().ops
-    bw_idx = next((i for i, op in enumerate(ops)
-                   if op.type == "backward"), None)
-
-    env = Env(program.global_block())
-    env.local.update({k: jnp.asarray(v) for k, v in state.items()})
-    env.local.update({k: jnp.asarray(v) for k, v in feed_arrays.items()})
-    # replicate the compiled step's input dtype coercion (executor
-    # _make_fn): compute_dtype upcast, then pure-inference AMP bf16 — a
-    # non-finite that arose at the compiled precision must reproduce at
-    # the SAME precision, or the bisect could blame the wrong op
-    if executor.compute_dtype is not None:
-        cd = jnp.dtype(executor.compute_dtype)
-        env.local = {k: v.astype(cd) if hasattr(v, "dtype")
-                     and jnp.issubdtype(v.dtype, jnp.floating)
-                     else v for k, v in env.local.items()}
-    if executor.amp and bw_idx is None:
-        env.local = {k: _to_bf16(v) for k, v in env.local.items()}
+    # the shared context replicates the compiled step's input dtype
+    # coercion — a non-finite that arose at the compiled precision must
+    # reproduce at the SAME precision, or the bisect could blame the
+    # wrong op
+    env, ctx, bw_idx = make_eager_context(
+        executor, program, feed_arrays, state, step, is_test)
 
     # a poisoned INPUT is not an op's fault — report it as the feed/state
     # (checked AFTER the casts: what the compiled step actually consumed)
@@ -113,14 +138,6 @@ def _bisect(executor, program, feed_arrays, state, step, is_test):
                     "shape": list(getattr(value, "shape", ())),
                     "dtype": str(getattr(value, "dtype", "?")),
                     "nan_count": bad["nan"], "inf_count": bad["inf"]}
-
-    base_key = jax.random.fold_in(
-        jax.random.PRNGKey(program.random_seed), step)
-    ctx = LoweringContext(
-        program, base_key, is_test=is_test, amp=executor.amp,
-        mesh=getattr(executor, "mesh", None),
-        compute_dtype=executor.compute_dtype,
-        conv1x1_pallas=executor.conv1x1_pallas)
 
     for idx, op in enumerate(ops):
         if idx == bw_idx:

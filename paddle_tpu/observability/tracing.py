@@ -85,6 +85,8 @@ SPAN_NAMES = (
     ("fleet/autoscale", "one executed autoscaler decision: trigger "
      "snapshot -> replica added or drained+removed; decision details "
      "attach as span events"),
+    ("opprof/op", "one op's measured windows in a per-op profile run "
+     "(observability.opprof eager replay); labels: op_type, index"),
 )
 
 _REGISTERED = tuple(n for n, _ in SPAN_NAMES)

@@ -29,7 +29,13 @@ def _json_lines(out):
     return lines
 
 
+@pytest.mark.slow
 def test_cli_train_saves_and_test_loads(tmp_path):
+    """@slow: two full `python -m paddle_tpu` subprocesses against the
+    REFERENCE v1 config (~10-15s of jax import per round on this
+    container); the train/test job wiring stays tier-1-covered
+    in-process by tests/test_graft_entry.py's config-build round and
+    tests/test_trainer.py."""
     save = str(tmp_path / "model")
     r = _run("--config", CONF, "--job", "train", "--num_passes", "2",
              "--steps_per_pass", "5", "--save_dir", save)
@@ -46,7 +52,9 @@ def test_cli_train_saves_and_test_loads(tmp_path):
     assert outs and np.isfinite(outs[0]["mean"])
 
 
+@pytest.mark.slow
 def test_cli_time(tmp_path):
+    """@slow: one jax-importing subprocess round (REFERENCE v1 config)."""
     r = _run("--config", CONF, "--job", "time", "--iters", "8",
              )
     assert r.returncode == 0, r.stderr
@@ -54,7 +62,9 @@ def test_cli_time(tmp_path):
     assert rec["ms_per_batch"] > 0 and rec["batches_per_sec"] > 0
 
 
+@pytest.mark.slow
 def test_cli_checkgrad():
+    """@slow: one jax-importing subprocess round (REFERENCE v1 config)."""
     r = _run("--config", CONF, "--job", "checkgrad")
     assert r.returncode == 0, r.stderr + r.stdout
     recs = _json_lines(r.stdout)

@@ -510,6 +510,38 @@ def test_truncated_final_line_counted_not_fatal(tmp_path):
     assert merged["corrupt_lines"] == 1 and merged["steps"]["steps"] == 4
 
 
+def test_merged_fault_timeline_carries_source_index(tmp_path):
+    """A relaunched job produces one log per attempt; the merged faults
+    timeline interleaves them by coerced ts ONLY, so each rendered row
+    must also carry the source-file index (argument position) — without
+    it an event is not attributable to the right attempt."""
+    a, b = tmp_path / "attempt0.jsonl", tmp_path / "attempt1.jsonl"
+    def fault(ts, event, **kw):
+        return json.dumps({"ts": ts, "kind": "fault",
+                           "event": event, **kw}) + "\n"
+    # attempt 1's first fault lands BETWEEN attempt 0's two faults on
+    # the clock (overlapping supervisor/child shutdown) — exactly the
+    # interleaving ts-order cannot disambiguate
+    a.write_text(fault(1.0, "inject", site="dispatch", step=3)
+                 + fault(3.0, "relaunch", attempt=1, delay_s=0.5))
+    b.write_text(fault(2.0, "restore", step=3)
+                 + fault(4.0, "inject", site="dispatch", step=7))
+    merged = obs_export.summarize_logs([str(a), str(b)])
+    tl = merged["faults"]["timeline"]
+    assert [(e.get("source"), e["event"]) for e in tl] == \
+        [(0, "inject"), (1, "restore"), (0, "relaunch"), (1, "inject")]
+    # restart boundaries name the same index the rows carry
+    assert [(r["source"], r["file"]) for r in merged["restarts"]] == \
+        [(0, str(a)), (1, str(b))]
+    text = obs_export.render_summary(merged)
+    assert "source=1 event=restore" in text
+    assert "[1] " + str(b) in text
+    # single-file summaries stay unchanged: no source column
+    single = obs_export.summarize_logs([str(a)])
+    assert all("source" not in e for e in single["faults"]["timeline"])
+    assert "source=" not in obs_export.render_summary(single)
+
+
 def test_prometheus_name_mangling_round_trip():
     names = [n for n, _k, _h in obs.METRIC_NAMES]
     mangled = [obs_export.prom_name(n) for n in names]
