@@ -41,6 +41,13 @@ queue-depth-aware router and the HTTP front, with bounded-restart
 relaunch of dead replicas and optional metric-driven autoscaling
 (serving/fleet.py).
 
+``python -m paddle_tpu elastic --config conf.py --data 'parts/*' --workers
+K --root dir`` runs the elastic multi-worker training service
+(distributed/elastic.py): K supervised trainer processes over the
+master's slot-sharded exactly-once streams, die/rejoin with
+bit-identical resume, and checkpointed mesh RESIZE on membership change
+(drain -> merge replicas -> planner re-plan -> re-shard -> relaunch).
+
 Feeds come from ``--feed-npz`` (named arrays matching the config's data
 layers, with ``name@LEN`` companions for sequences); ``time`` and
 ``checkgrad`` synthesize random feeds from the declared shapes when none
@@ -904,6 +911,12 @@ def main(argv=None):
         # zero-cost-when-unused contract as the serving package
         from paddle_tpu.serving.fleet import fleet_main
         return fleet_main(argv[1:])
+    if argv and argv[0] == "elastic":
+        # lazy: the elastic training service (distributed/elastic.py)
+        # rides the same zero-cost-when-unused contract — importing
+        # paddle_tpu (or running a plain trainer) never loads it
+        from paddle_tpu.distributed.elastic import elastic_main
+        return elastic_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="paddle_tpu",
         description="TrainerMain analog: run a v1 config on the TPU "
@@ -926,9 +939,13 @@ def main(argv=None):
                     "the batching inference server over exported "
                     "artifacts (stdio JSON, or HTTP with --http), and "
                     "`paddle_tpu fleet --model dir --replicas N` scales "
-                    "it behind a queue-depth-aware router (see "
+                    "it behind a queue-depth-aware router, and "
+                    "`paddle_tpu elastic --config conf.py --data "
+                    "'parts/*' --workers K --root dir` runs the elastic "
+                    "multi-worker training service with checkpointed "
+                    "mesh resize (see "
                     "`paddle_tpu check|plan|stats|trace|doctor|profile|"
-                    "tune|serve|fleet --help`).")
+                    "tune|serve|fleet|elastic --help`).")
     ap.add_argument("--config", required=True, help="v1 config file")
     ap.add_argument("--job", default="train",
                     choices=["train", "test", "time", "checkgrad"])

@@ -3,20 +3,81 @@ fabric launcher + trainer_id/num_gradient_servers flags, Flags.cpp).
 
 On TPU pods: jax.distributed.initialize() wires all hosts into one XLA
 runtime; afterwards jax.devices() spans the pod and meshes may cross hosts
-(DCN-aware axes)."""
+(DCN-aware axes).
+
+Hardening: a cold pod's coordinator is routinely the LAST process up, so
+``jax.distributed.initialize`` is wrapped in the package retry policy
+(``faults.retry_call`` — exponential backoff, seeded jitter); once the
+budget is spent a typed :class:`CoordinatorTimeoutError` names the
+coordinator address and the lapsed budget instead of whatever transport
+error the final attempt died with.  The overall budget comes from
+``timeout_s`` / ``PADDLE_TPU_COORDINATOR_TIMEOUT_S`` (default
+:data:`DEFAULT_COORDINATOR_TIMEOUT_S`).
+"""
 from __future__ import annotations
 
+import logging
 import os
+from typing import Optional
 
-import jax
+from ..faults import RetriesExhausted, RetryPolicy, retry_call
+
+logger = logging.getLogger("paddle_tpu")
 
 _initialized = False
 
+DEFAULT_COORDINATOR_TIMEOUT_S = 60.0
+
+
+class CoordinatorTimeoutError(TimeoutError):
+    """Multi-host init could not reach the coordinator within the retry
+    budget.  Carries ``address`` and ``timeout_s`` so a supervisor can
+    report WHICH endpoint never answered."""
+
+    def __init__(self, address: Optional[str], timeout_s: float,
+                 last: Optional[BaseException] = None):
+        super().__init__(
+            f"jax.distributed.initialize: coordinator "
+            f"{address or '<flag-resolved>'} unreachable within "
+            f"{timeout_s:g}s: {type(last).__name__ if last else '?'}: "
+            f"{last}")
+        self.address = address
+        self.timeout_s = timeout_s
+        self.last = last
+
+
+def _coordinator_timeout_s(timeout_s: Optional[float]) -> float:
+    if timeout_s is not None:
+        return float(timeout_s)
+    env = os.environ.get("PADDLE_TPU_COORDINATOR_TIMEOUT_S")
+    return float(env) if env else DEFAULT_COORDINATOR_TIMEOUT_S
+
+
+def _retry_policy(timeout_s: float) -> RetryPolicy:
+    """A seeded backoff schedule whose total sleep stays within the
+    budget: 1s base doubling to an 8s cap gives attempts at roughly
+    t=0, 1, 3, 7, 15, 23, ... — max_attempts is the count that fits."""
+    attempts, acc, delay = 1, 0.0, 1.0
+    while acc + delay <= timeout_s:
+        acc += delay
+        delay = min(delay * 2.0, 8.0)
+        attempts += 1
+    return RetryPolicy(max_attempts=max(attempts, 1), backoff_base_s=1.0,
+                       backoff_max_s=8.0, jitter=0.1, seed=0)
+
 
 def init_distributed(coordinator_address: str = None, num_processes: int = None,
-                     process_id: int = None):
+                     process_id: int = None,
+                     timeout_s: Optional[float] = None):
     """Initialize multi-host JAX.  No-op when single-process (the common
-    dev case) or already initialized."""
+    dev case) or already initialized.
+
+    ``coordinator_address`` falls back to ``PADDLE_TPU_COORDINATOR``;
+    with neither set and no explicit ``num_processes`` this is
+    single-process mode.  Connection attempts retry with seeded
+    exponential backoff until the ``timeout_s`` /
+    ``PADDLE_TPU_COORDINATOR_TIMEOUT_S`` budget lapses, then raise
+    :class:`CoordinatorTimeoutError`."""
     global _initialized
     if _initialized:
         return
@@ -25,10 +86,37 @@ def init_distributed(coordinator_address: str = None, num_processes: int = None,
     if coordinator_address is None and num_processes is None:
         _initialized = True   # single-process mode
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes, process_id=process_id)
+    import jax
+
+    budget = _coordinator_timeout_s(timeout_s)
+    policy = _retry_policy(budget)
+
+    def _attempt():
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+
+    def _on_retry(i, e, d):
+        logger.warning(
+            "init_distributed: coordinator %s attempt %d failed "
+            "(%s: %s); retrying in %.1fs", coordinator_address, i + 1,
+            type(e).__name__, e, d)
+
+    try:
+        retry_call(_attempt, policy, what="jax.distributed.initialize",
+                   on_retry=_on_retry)
+    except RetriesExhausted as e:
+        raise CoordinatorTimeoutError(coordinator_address, budget,
+                                      e.last) from e
     _initialized = True
+
+
+def reset_distributed_state():
+    """Testing hook: forget that :func:`init_distributed` ran so the
+    no-op/env-var paths can be exercised repeatedly in one process.
+    Does NOT tear down a live jax.distributed runtime."""
+    global _initialized
+    _initialized = False
 
 
 def is_initialized() -> bool:
@@ -36,8 +124,10 @@ def is_initialized() -> bool:
 
 
 def process_index() -> int:
+    import jax
     return jax.process_index()
 
 
 def process_count() -> int:
+    import jax
     return jax.process_count()

@@ -39,22 +39,46 @@ class Task:
 
 
 class Master:
+    """``world=None`` is the classic racy-pull queue (any trainer takes
+    the next task).  ``world=K`` turns on **slot-sharded serving** — the
+    elastic training service's data plane: worker slot ``w`` of ``K`` is
+    served only tasks with ``task_id % K == w``, lowest id first, so
+    each slot's stream is a DETERMINISTIC function of (dataset, slot,
+    world) and a killed-and-relaunched worker replays bit-identically.
+    Exactly-once is anchored to the worker's *committed* state: a slot
+    re-registers with the cursor its checkpoint carries and the master
+    reconciles its shard to that cursor (tasks committed stay done,
+    uncommitted leases re-serve in order).
+
+    The membership layer (``register_worker``/``heartbeat``/``members``)
+    is the etcd-membership analog: lease-style staleness against
+    ``heartbeat_lease_s``, a per-slot command channel (the coordinator's
+    drain signal rides on heartbeat replies), all serialized in
+    :meth:`state_dict` so membership survives a coordinator restart."""
+
     def __init__(self, chunks_per_task: int = 1, timeout_s: float = 60.0,
                  failure_max: int = 3, snapshot_path: Optional[str] = None,
-                 num_epochs: int = 1):
+                 num_epochs: int = 1, world: Optional[int] = None,
+                 heartbeat_lease_s: float = 10.0):
         self.chunks_per_task = chunks_per_task
         self.timeout_s = timeout_s
         self.failure_max = failure_max
         self.snapshot_path = snapshot_path
         self.num_epochs = num_epochs
+        if world is not None and world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        self.world = world
+        self.heartbeat_lease_s = float(heartbeat_lease_s)
         self._lock = threading.Lock()
         self.todo: List[Task] = []
-        self.pending = {}           # task_id -> (Task, deadline)
+        self.pending = {}           # task_id -> (Task, deadline, slot)
         self.done: List[Task] = []
         self.epoch = 0
         self._next_id = 0
         self._saving_trainer = ""
         self._saving_until = 0.0
+        self._members: dict = {}    # slot -> {last_heartbeat, cursor, pid}
+        self._commands: dict = {}   # slot -> pending command string
 
     # -- dataset -----------------------------------------------------------
     def set_dataset(self, chunks: List):
@@ -73,9 +97,28 @@ class Master:
         self.pending = {}
 
     # -- trainer RPCs ------------------------------------------------------
-    def get_task(self) -> Optional[Task]:
+    def get_task(self, slot: Optional[int] = None) -> Optional[Task]:
         with self._lock:
             self._requeue_timeouts()
+            if self.world is not None:
+                # sharded serving: deterministic per-slot stream (lowest
+                # remaining id of this slot's shard); no epoch recycle —
+                # an epoch barrier across slots belongs to the
+                # coordinator, not a racy per-slot recycle
+                if slot is None:
+                    raise ValueError(
+                        "this master serves slot-sharded streams "
+                        f"(world={self.world}); call get_task(slot=...)")
+                slot = int(slot)
+                mine = [t for t in self.todo
+                        if t.task_id % self.world == slot]
+                if not mine:
+                    return None
+                t = min(mine, key=lambda t: t.task_id)
+                self.todo.remove(t)
+                self.pending[t.task_id] = (t, time.time() + self.timeout_s,
+                                           slot)
+                return t
             if not self.todo:
                 if not self.pending and self.done \
                         and self.epoch + 1 < self.num_epochs:
@@ -88,7 +131,8 @@ class Master:
                 else:
                     return None
             t = self.todo.pop(0)
-            self.pending[t.task_id] = (t, time.time() + self.timeout_s)
+            self.pending[t.task_id] = (t, time.time() + self.timeout_s,
+                                       slot)
             return t
 
     def task_finished(self, task_id: int):
@@ -155,10 +199,175 @@ class Master:
                 self._saving_until = now + block_dur_s
             return need
 
+    # -- membership (the etcd-membership analog) ---------------------------
+    def register_worker(self, slot: int, cursor: Optional[int] = None,
+                        pid: Optional[int] = None) -> dict:
+        """(Re-)join the membership as ``slot``.  ``cursor`` is the count
+        of this slot's shard tasks the worker's COMMITTED checkpoint
+        covers: the shard is reconciled to it — the first ``cursor``
+        tasks (ascending id) are forced done, and any lease the slot's
+        previous incarnation still holds returns to todo so the stream
+        re-serves in deterministic order.  Exactly-once is therefore
+        anchored to committed state, not to the wire."""
+        slot = int(slot)
+        with self._lock:
+            now = time.time()
+            self._members[slot] = {"registered_at": now,
+                                   "last_heartbeat": now,
+                                   "cursor": cursor, "pid": pid}
+            shard_done = None
+            if self.world is not None:
+                self._release_slot_leases(slot)
+                if cursor is not None:
+                    self._reconcile_cursor(slot, int(cursor))
+                # the authoritative committed count for this shard: the
+                # worker adopts it as its cursor (post-resize there is no
+                # per-worker cursor to carry — the re-shard rebased it).
+                # Failure-budget drops are EXCLUDED: the worker's cursor
+                # counts tasks it was served and committed, and a
+                # dropped task was never part of that stream.
+                shard_done = sum(1 for t in self.done
+                                 if t.task_id % self.world == slot
+                                 and not self._is_dropped(t))
+            return {"ok": True, "world": self.world, "slot": slot,
+                    "shard_done": shard_done}
+
+    def heartbeat(self, slot: int) -> dict:
+        """Refresh ``slot``'s lease; the reply carries the coordinator's
+        pending command for this slot (the drain channel)."""
+        slot = int(slot)
+        with self._lock:
+            m = self._members.get(slot)
+            if m is None:          # heartbeat from a never-registered slot
+                now = time.time()
+                m = {"registered_at": now, "last_heartbeat": now,
+                     "cursor": None, "pid": None}
+                self._members[slot] = m
+            m["last_heartbeat"] = time.time()
+            cmd = self._commands.get(slot)
+        from ..observability import inc_counter
+        inc_counter("elastic/heartbeats")
+        return {"ok": True, "cmd": cmd}
+
+    def members(self) -> dict:
+        """{slot: {age_s, stale, cursor, pid}} — staleness is lease-style
+        against ``heartbeat_lease_s``."""
+        with self._lock:
+            now = time.time()
+            out = {}
+            for slot, m in self._members.items():
+                age = now - m["last_heartbeat"]
+                out[int(slot)] = {"age_s": round(age, 3),
+                                  "stale": age > self.heartbeat_lease_s,
+                                  "cursor": m.get("cursor"),
+                                  "pid": m.get("pid")}
+            return out
+
+    def deregister_worker(self, slot: int):
+        """Remove ``slot`` from membership and return its leases."""
+        slot = int(slot)
+        with self._lock:
+            self._members.pop(slot, None)
+            self._commands.pop(slot, None)
+            if self.world is not None:
+                self._release_slot_leases(slot)
+
+    def set_command(self, cmd: Optional[str], slot: Optional[int] = None):
+        """Queue a command for one slot (or every registered slot) to be
+        delivered on its next heartbeat; ``cmd=None`` clears."""
+        with self._lock:
+            slots = [int(slot)] if slot is not None \
+                else list(self._members)
+            for s in slots:
+                if cmd is None:
+                    self._commands.pop(s, None)
+                else:
+                    self._commands[s] = str(cmd)
+
+    def resize(self, world: int):
+        """Re-shard the remaining work for a new world size (the mesh
+        RESIZE boundary): every lease returns to todo, membership and
+        commands reset — the relaunched workers re-register against the
+        new shards.  ``done`` is global (task ids), so committed work
+        stays committed across the re-shard."""
+        world = int(world)
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        with self._lock:
+            self.world = world
+            for tid in list(self.pending):
+                t, _deadline, _slot = self.pending.pop(tid)
+                self.todo.append(t)
+            self._members.clear()
+            self._commands.clear()
+
+    def _release_slot_leases(self, slot: int):
+        """(locked) return every lease held by ``slot`` to todo."""
+        for tid in list(self.pending):
+            t, _deadline, holder = self.pending[tid]
+            if holder == slot:
+                del self.pending[tid]
+                self.todo.append(t)
+
+    def _is_dropped(self, t: Task) -> bool:
+        """A task retired by the FAILURE BUDGET, not by training — it
+        lives in done but was never committed by anyone, so cursor
+        arithmetic must not count it."""
+        return t.num_failures >= self.failure_max
+
+    def _reconcile_cursor(self, slot: int, cursor: int):
+        """(locked) force the first ``cursor`` tasks of ``slot``'s shard
+        (ascending id, EXCLUDING failure-budget drops — the worker was
+        never served those, so its cursor doesn't count them) done;
+        anything later that is marked done but NOT covered by the
+        committed cursor goes back to todo (it finished on the wire but
+        its model update was never committed)."""
+        shard = sorted(
+            t.task_id
+            for t in self.todo + self.done +
+            [e[0] for e in self.pending.values()]
+            if t.task_id % self.world == slot
+            and not self._is_dropped(t))
+        committed = set(shard[:cursor])
+        keep_todo = []
+        for t in self.todo:
+            if t.task_id in committed:
+                self.done.append(t)
+            else:
+                keep_todo.append(t)
+        self.todo = keep_todo
+        keep_done = []
+        for t in self.done:
+            if t.task_id % self.world == slot \
+                    and t.task_id not in committed \
+                    and not self._is_dropped(t):
+                self.todo.append(t)
+            else:
+                keep_done.append(t)
+        self.done = keep_done
+        for tid in list(self.pending):
+            t, _deadline, _holder = self.pending[tid]
+            if t.task_id in committed:
+                del self.pending[tid]
+                self.done.append(t)
+
     def _requeue_timeouts(self):
         now = time.time()
         for tid in list(self.pending):
-            t, deadline = self.pending[tid]
+            t, deadline, slot = self.pending[tid]
+            if now > deadline and self.world is not None \
+                    and slot is not None:
+                # sharded mode: the task deadline is subordinate to the
+                # MEMBERSHIP lease — a live (heartbeating) holder is
+                # still training it, and re-serving the same task to
+                # the same slot would double-train it and corrupt the
+                # committed-cursor accounting.  Only a stale/absent
+                # holder forfeits the lease.
+                m = self._members.get(slot)
+                if m is not None and \
+                        now - m["last_heartbeat"] <= self.heartbeat_lease_s:
+                    self.pending[tid] = (t, now + self.timeout_s, slot)
+                    continue
             if now > deadline:
                 del self.pending[tid]
                 t.num_failures += 1
@@ -183,11 +392,20 @@ class Master:
         Pending tasks serialize into todo: a lease held at snapshot time
         must be re-served after a restore."""
         with self._lock:
-            return {"epoch": self.epoch,
-                    "todo": [dataclasses.asdict(t) for t in self.todo],
-                    "pending": [dataclasses.asdict(t)
-                                for t, _ in self.pending.values()],
-                    "done": [dataclasses.asdict(t) for t in self.done]}
+            return self._state_dict_locked()
+
+    def _state_dict_locked(self) -> dict:
+        return {"epoch": self.epoch,
+                "todo": [dataclasses.asdict(t) for t in self.todo],
+                "pending": [dataclasses.asdict(t)
+                            for t, _, _ in self.pending.values()],
+                "done": [dataclasses.asdict(t) for t in self.done],
+                "world": self.world,
+                # membership rides along so a restarted coordinator
+                # still knows its fleet (ages computed lazily, so a
+                # long outage reads as every member stale — correct)
+                "membership": {str(s): dict(m) for s, m in
+                               self._members.items()}}
 
     def load_state_dict(self, state: dict):
         """Restore queue state captured by :meth:`state_dict` (locked)."""
@@ -199,27 +417,27 @@ class Master:
             self.done = [Task(**t) for t in state["done"]]
             self._next_id = max(
                 [t.task_id for t in self.todo + self.done] + [-1]) + 1
+            if state.get("world") is not None:
+                self.world = int(state["world"])
+            # JSON round-trips dict keys as strings; slots are ints
+            self._members = {int(s): dict(m) for s, m in
+                             state.get("membership", {}).items()}
+            self._commands = {}
 
     def _snapshot(self):
         if not self.snapshot_path:
             return
-        state = {"epoch": self.epoch,
-                 "todo": [dataclasses.asdict(t) for t in self.todo],
-                 "pending": [dataclasses.asdict(t)
-                             for t, _ in self.pending.values()],
-                 "done": [dataclasses.asdict(t) for t in self.done]}
+        # the state_dict body, verbatim (copy-paste drift here once lost
+        # the world/membership fields on the snapshot path)
         with open(self.snapshot_path, "w") as f:
-            json.dump(state, f)
+            json.dump(self._state_dict_locked(), f)
 
     def restore_snapshot(self):
         if not self.snapshot_path:
             return
         with open(self.snapshot_path) as f:
             state = json.load(f)
-        self.epoch = state["epoch"]
-        self.todo = [Task(**t) for t in
-                     state["todo"] + state["pending"]]
-        self.done = [Task(**t) for t in state["done"]]
+        self.load_state_dict(state)
 
 
 class MasterServer:
@@ -233,7 +451,8 @@ class MasterServer:
 
     METHODS = ("get_task", "task_finished", "task_failed", "task_returned",
                "set_dataset", "set_dataset_if_empty", "stats", "ping",
-               "request_save_model")
+               "request_save_model", "register_worker", "heartbeat",
+               "members", "deregister_worker", "state_dict")
 
     def __init__(self, master: Master, host: str = "127.0.0.1",
                  port: int = 0):
@@ -271,8 +490,20 @@ class MasterServer:
         if method == "ping":
             return "pong"
         if method == "get_task":
-            t = self.master.get_task()
+            t = self.master.get_task(slot=params.get("slot"))
             return dataclasses.asdict(t) if t is not None else None
+        if method == "register_worker":
+            return self.master.register_worker(
+                params["slot"], cursor=params.get("cursor"),
+                pid=params.get("pid"))
+        if method == "heartbeat":
+            return self.master.heartbeat(params["slot"])
+        if method == "members":
+            return self.master.members()
+        if method == "deregister_worker":
+            return self.master.deregister_worker(params["slot"])
+        if method == "state_dict":
+            return self.master.state_dict()
         if method == "set_dataset":
             return self.master.set_dataset(params["chunks"])
         if method == "set_dataset_if_empty":
@@ -396,9 +627,36 @@ class MasterClient:
                             pass
 
     # -- Master duck-type --------------------------------------------------
-    def get_task(self) -> Optional[Task]:
-        d = self._call("get_task")
+    def get_task(self, slot: Optional[int] = None) -> Optional[Task]:
+        params = {} if slot is None else {"slot": int(slot)}
+        d = self._call("get_task", **params)
         return Task(**d) if d is not None else None
+
+    def register_worker(self, slot: int, cursor: Optional[int] = None,
+                        pid: Optional[int] = None) -> dict:
+        return self._call("register_worker", slot=int(slot), cursor=cursor,
+                          pid=pid)
+
+    def heartbeat(self, slot: int) -> dict:
+        """Single-attempt, <=2 s best-effort lease refresh: a heartbeat
+        that cannot reach the master is LOST, not retried — the
+        coordinator reads the resulting staleness, which is the signal
+        heartbeats exist to carry."""
+        return self._call("heartbeat", _retries=1, _timeout=2.0,
+                          _sock_deadline=2.0, slot=int(slot))
+
+    def members(self) -> dict:
+        m = self._call("members")
+        return {int(k): v for k, v in m.items()}
+
+    def deregister_worker(self, slot: int):
+        return self._call("deregister_worker", slot=int(slot))
+
+    def state_dict(self) -> dict:
+        """Remote form of ``Master.state_dict`` so a worker's
+        ``train(master=client)`` checkpoint embedding works unchanged
+        against a served master."""
+        return self._call("state_dict")
 
     def task_finished(self, task_id: int):
         return self._call("task_finished", task_id=task_id)

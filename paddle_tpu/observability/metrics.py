@@ -173,6 +173,22 @@ METRIC_NAMES = (
      "autoscaler scale-in decisions executed (replica drained + removed)"),
     ("fleet/replicas", "gauge",
      "current fleet size by state (labels: ready/warming/draining/dead)"),
+    # elastic training service (paddle_tpu.distributed.elastic): writes
+    # are unconditional cold paths like fault/* — membership churn and
+    # resize boundaries are rare events whose history must survive into
+    # `stats`; training hot paths never reach these helpers
+    ("elastic/workers", "gauge",
+     "elastic worker count by state (labels: ready = live process, "
+     "done = exited 0 with its shard complete)"),
+    ("elastic/heartbeats", "counter",
+     "worker heartbeats received through the master's membership layer"),
+    ("elastic/drains", "counter",
+     "coordinator-commanded worker drains completed at a task boundary"),
+    ("elastic/resizes", "counter",
+     "committed mesh resize boundaries (drain -> merge -> re-plan -> "
+     "relaunch)"),
+    ("elastic/resize_ms", "histogram",
+     "wall time of one resize boundary: drain start to workers relaunched"),
     # per-op profiler (observability.opprof): writes are cold paths by
     # construction — a profile run IS the workload, like tuning; training
     # paths never reach these helpers (opprof is lazy-import gated)
@@ -204,6 +220,7 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
     "tuning/trial_ms": _MS_BUCKETS,
     "http/request_ms": _MS_BUCKETS,
     "opprof/op_ms": _MS_BUCKETS,
+    "elastic/resize_ms": _MS_BUCKETS,
 }
 _DEFAULT_BUCKETS = _MS_BUCKETS
 

@@ -87,6 +87,9 @@ SPAN_NAMES = (
      "attach as span events"),
     ("opprof/op", "one op's measured windows in a per-op profile run "
      "(observability.opprof eager replay); labels: op_type, index"),
+    ("elastic/resize", "one committed mesh resize boundary of the "
+     "elastic training service: drain -> merge replicas -> re-plan -> "
+     "re-shard -> relaunch; phase completions attach as span events"),
 )
 
 _REGISTERED = tuple(n for n, _ in SPAN_NAMES)

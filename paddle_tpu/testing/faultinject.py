@@ -60,6 +60,23 @@ Registered sites:
                           ``timeout`` makes it overrun its budget
                           (recorded ``timeout``) — both INSIDE the
                           containment rim, so the search must survive
+``elastic.worker``        per completed batch in an elastic worker
+                          (``distributed.elastic.ElasticWorker``; index =
+                          the worker's global batch counter, restored
+                          across relaunches).  ``kill`` sends the worker
+                          a REAL SIGKILL (hard death mid-pass: no
+                          handler, no emergency checkpoint — the chaos
+                          suite's zero-task-loss case); ``preempt``
+                          requests a graceful preemption exactly like a
+                          SIGTERM (emergency checkpoint at the boundary,
+                          exit 75)
+``master.heartbeat``      per heartbeat SENT by an elastic worker
+                          (hit-count indexed).  ``drop`` loses the
+                          heartbeat on the wire (the worker swallows the
+                          injected ConnectionError, best-effort
+                          semantics) — enough consecutive drops and the
+                          coordinator sees lease staleness, which is the
+                          membership-change trigger being tested
 ========================  ==================================================
 
 Every firing increments the ``fault/injected`` counter and emits a
@@ -81,7 +98,8 @@ __all__ = [
 
 KNOWN_SITES = ("trainer.step", "reader.item", "executor.dispatch",
                "master.call", "ckpt.write", "serving.request",
-               "serving.dispatch", "tuning.trial")
+               "serving.dispatch", "tuning.trial", "elastic.worker",
+               "master.heartbeat")
 
 # THE zero-overhead gate: call sites guard every hook with
 # ``if faultinject.ENABLED:`` — one attribute load when off.

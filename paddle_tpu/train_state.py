@@ -76,6 +76,13 @@ class TrainState:
     # Master.state_dict() captured at the same boundary — commits
     # ATOMICALLY with the model (None when no master rides along)
     master: Optional[dict] = None
+    # elastic-service position (distributed/elastic.py): slot, committed
+    # task cursor + within-task batch offset, world size and the resize
+    # epoch of the membership generation this state belongs to — the
+    # durable half of a resize-boundary record (None outside elastic
+    # runs; an optional field, so version stays 1 and old checkpoints
+    # load unchanged)
+    elastic: Optional[dict] = None
 
     def to_array(self) -> np.ndarray:
         payload = json.dumps(dataclasses.asdict(self), sort_keys=True)
@@ -109,7 +116,7 @@ class Checkpointer:
     def __init__(self, checkpoint_dir: str, exe,
                  save_every_n_steps: Optional[int] = None,
                  master=None, max_to_keep: int = 3,
-                 handle_signals: bool = True):
+                 handle_signals: bool = True, extra_state=None):
         if save_every_n_steps is not None and save_every_n_steps < 1:
             raise ValueError(f"save_every_n_steps must be >= 1, got "
                              f"{save_every_n_steps}")
@@ -120,8 +127,14 @@ class Checkpointer:
         self.manager = CheckpointManager(checkpoint_dir,
                                          max_to_keep=max_to_keep)
         self.handle_signals = handle_signals
+        # extra_state(): JSON-serializable dict captured at every save
+        # into TrainState.elastic — the elastic worker's stream position
+        # (cursor/offset), read back on resume.  Called AT the boundary,
+        # so it sees the exact committed position.
+        self._extra_state = extra_state
         self._old_handlers: dict = {}
         self._preempt_sig: Optional[int] = None
+        self._save_requested = False
         self._base_step: Optional[int] = None
         self.emitted = 0
         self.iters_done = 0
@@ -260,6 +273,19 @@ class Checkpointer:
     def preempt_requested(self) -> bool:
         return self._preempt_sig is not None
 
+    def request_save(self):
+        """Ask for a BLOCKING checkpoint at the next dispatch boundary,
+        independent of the periodic cadence — how the elastic worker
+        commits at task boundaries (its ``task_finished`` report to the
+        master waits on the commit, which is what anchors the stream's
+        exactly-once contract to durable state)."""
+        self._save_requested = True
+
+    @property
+    def save_pending(self) -> bool:
+        """True while a :meth:`request_save` has not yet committed."""
+        return self._save_requested
+
     # -- per-batch hook -----------------------------------------------------
     def on_batch_done(self, pass_id: int, batch_id: int,
                       step_now: Optional[int] = None):
@@ -285,6 +311,10 @@ class Checkpointer:
                 "committed in %r; exiting %d for the supervisor",
                 self._preempt_sig, self.emitted, self.dir, EXIT_PREEMPTED)
             raise Preempted(self.emitted, self.dir)
+        if self._save_requested:
+            self._save(pass_id, batch_id + 1, blocking=True)
+            self._save_requested = False
+            return
         if self.save_every is not None and \
                 self.emitted - self.last_saved >= self.save_every:
             self._save(pass_id, batch_id + 1)
@@ -298,9 +328,16 @@ class Checkpointer:
         copy to a crash window for no benefit."""
         r = getattr(self, "_restored", None)
         if r is not None and r.pass_id >= num_passes \
-                and self.emitted == r.emitted:
+                and self.emitted == r.emitted \
+                and not self._save_requested:
+            # a pending request_save still commits: a zero-batch tail
+            # (e.g. the elastic stream's empty final tasks) advances
+            # state the extra_state hook must see durable — dropping it
+            # here would leave its task_finished reports forever gated
+            # on save_pending
             return
         self._save(num_passes, 0, blocking=True)
+        self._save_requested = False   # the final commit satisfies it
 
     # -- save ---------------------------------------------------------------
     def _save(self, next_pass: int, next_batch: int,
@@ -322,7 +359,9 @@ class Checkpointer:
             batch_id=next_batch, emitted=self.emitted,
             iters_done=self.iters_done, random_seed=self._seed,
             optimizer=self._opt_fp, emergency=emergency,
-            master=master_state)
+            master=master_state,
+            elastic=self._extra_state() if self._extra_state is not None
+            else None)
         scope = self._scope
         scope.set(TRAIN_STATE_VAR, ts.to_array())
         try:

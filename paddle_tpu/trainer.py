@@ -79,7 +79,7 @@ class SGD:
               auto_shard=None,
               checkpoint_dir: Optional[str] = None, resume: bool = False,
               save_every_n_steps: Optional[int] = None, master=None,
-              handle_signals: bool = True):
+              handle_signals: bool = True, elastic=None):
         """reader yields batches (lists of rows); feeding maps data-layer
         names to row positions (v2 trainer.py feeding) or pass feed_list.
 
@@ -168,6 +168,23 @@ class SGD:
         snapshot should commit alongside each checkpoint (and be restored
         on resume).  ``handle_signals=False`` skips installing handlers
         (e.g. when embedding the trainer in a host that owns them).
+
+        ``elastic``: a duck-typed elastic-worker hook (normally a
+        ``distributed.elastic.ElasticWorker`` — the trainer itself never
+        imports the elastic module, so the zero-cost-when-unused
+        contract holds statically).  The hook's ``state()`` rides in
+        every checkpoint's ``TrainState.elastic``; ``bind(ckpt, ts)``
+        runs after restore (registering with the membership layer and
+        rewinding the master-sharded stream — which is WHY the
+        batch-skip resume fast-forward is forced to zero here: a
+        master-backed stream resumes by task re-serve + within-task
+        offset, not by replaying the reader from the top);
+        ``after_batch()`` runs per completed batch (heartbeat, drain
+        command, injection sites, post-commit ``task_finished``);
+        ``on_complete()`` runs after the final save.  Requires
+        ``checkpoint_dir`` and the per-batch dispatch path
+        (``steps_per_dispatch == 1``, no ``pipeline``) — the elastic
+        commit protocol needs every batch to be a dispatch boundary.
         """
         event_handler = event_handler or (lambda e: None)
         if not checkpoint_dir:
@@ -183,6 +200,15 @@ class SGD:
                 raise ValueError("train(master=...) snapshots the task "
                                  "queue into checkpoints — pass "
                                  "checkpoint_dir")
+            if elastic is not None:
+                raise ValueError("train(elastic=...) commits its stream "
+                                 "position inside checkpoints — pass "
+                                 "checkpoint_dir")
+        if elastic is not None and (pipeline or steps_per_dispatch > 1):
+            raise ValueError(
+                "train(elastic=...) needs the per-batch dispatch path "
+                "(steps_per_dispatch=1, pipeline=False): the elastic "
+                "task-commit protocol saves at every batch boundary")
         if auto_shard:
             self._enable_auto_shard(auto_shard)
         # validate is a PER-CALL override: restore the executor's own
@@ -212,7 +238,10 @@ class SGD:
                 ckpt = Checkpointer(checkpoint_dir, self.exe,
                                     save_every_n_steps=save_every_n_steps,
                                     master=master,
-                                    handle_signals=handle_signals)
+                                    handle_signals=handle_signals,
+                                    extra_state=(elastic.state
+                                                 if elastic is not None
+                                                 else None))
                 ts = None
                 if resume:
                     ts = ckpt.restore(
@@ -232,6 +261,21 @@ class SGD:
                         master.load_state_dict(ts.master)
                 ckpt.begin(global_scope(), ts,
                            self.main_program.random_seed, opt_fp)
+                if elastic is not None:
+                    # register with the membership layer and rewind the
+                    # master-sharded stream to the COMMITTED position;
+                    # the stream resumes by task re-serve + within-task
+                    # offset, so the batch-skip fast-forward must not
+                    # also skip (it would double-skip the replay).  The
+                    # pass cursor is also stream-defined: a drained
+                    # worker's final state says pass_id=num_passes, but
+                    # its shard may still hold work (or regain some
+                    # after a resize) — always re-enter the pass loop
+                    # and let the master decide whether anything is
+                    # left (an already-complete worker pulls nothing
+                    # and final_save's idempotency skips the re-commit)
+                    elastic.bind(ckpt, ts)
+                    start_pass, resume_skip = 0, 0
 
             fetch = [self.cost] + self.extra
             # resolve the pipelined-loop knobs ONCE — including the
@@ -296,6 +340,8 @@ class SGD:
                         _fi.raise_for(action, "trainer.step", gcount[0])
                 if ckpt is not None:
                     ckpt.on_batch_done(pass_id, batch_id, step_now)
+                if elastic is not None:
+                    elastic.after_batch()
 
             # reader wrapper: resume skip for the first resumed pass +
             # the reader.item injection site.  The plain path stays the
@@ -405,6 +451,10 @@ class SGD:
                 event_handler(events.EndPass(pass_id))
             if ckpt is not None:
                 ckpt.final_save(num_passes)
+            if elastic is not None:
+                # the final save above committed the last task's state;
+                # the hook now reports it finished and deregisters
+                elastic.on_complete()
         finally:
             self.exe.validate = prev_validate
             self.exe.autotune = prev_autotune

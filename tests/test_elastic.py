@@ -1,0 +1,466 @@
+"""Elastic training service — the FAST deterministic subset (in-process,
+faultinject-driven; real subprocess chaos lives in
+tests/test_elastic_chaos.py under @slow).
+
+Contracts pinned here:
+
+1. **Slot-sharded exactly-once streams** — worker ``w`` of ``K`` sees
+   exactly the tasks ``task_id % K == w``, lowest id first; cursor
+   reconcile on re-register anchors exactly-once to COMMITTED state.
+2. **Elastic bit-identity** (the PR 6 pin extended): a preempted worker
+   relaunched against the same master produces a merged fetch stream
+   bit-identical to the uninterrupted run — including a preemption
+   landing MID-task (the within-task offset resume).
+3. **Drain at a task boundary** — the coordinator's command ends the
+   stream after the current task with its state committed, and a later
+   relaunch finishes the remainder.
+4. **Replica merge** — elementwise float mean, chief's non-floats,
+   TrainState re-armed for the new generation (pass loop restarts,
+   ``elastic`` carries the resize lineage).
+5. **Re-plan** — ``plan_for_world`` validates with zero PT030/PT031
+   findings for every world size the resize round uses.
+"""
+import dataclasses
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.distributed.elastic import (ElasticWorker, merge_checkpoints,
+                                            plan_for_world)
+from paddle_tpu.distributed.master import Master, MasterServer
+from paddle_tpu.faults import Preempted
+from paddle_tpu.testing import faultinject as fi
+from paddle_tpu.train_state import TRAIN_STATE_VAR, TrainState
+
+
+@pytest.fixture(autouse=True)
+def _clean_spec():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+def _write_chunks(tmp_path, n_chunks=4, recs_per_chunk=8, seed=0):
+    rng = np.random.RandomState(seed)
+    chunks = []
+    for i in range(n_chunks):
+        p = str(tmp_path / f"part-{i:03d}.pickle")
+        recs = [(rng.rand(8).astype("float32"),
+                 rng.randint(0, 3, (1,))) for _ in range(recs_per_chunk)]
+        with open(p, "wb") as f:
+            pickle.dump(recs, f)
+        chunks.append(p)
+    return chunks
+
+
+def _build_trainer():
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    h = layers.fc(x, size=16, act="relu")
+    h = layers.dropout(h, dropout_prob=0.3)   # RNG stream must resume too
+    pred = layers.fc(h, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    return pt.trainer.SGD(cost=loss,
+                          update_equation=pt.optimizer.Momentum(0.05, 0.9))
+
+
+def _fresh():
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    pt.unique_name.reset()
+
+
+def _run_worker(master, ckpt_dir, slot=0, batch_size=4, spec=None):
+    """One in-process elastic worker pass; returns (cost hexes, worker)."""
+    srv = MasterServer(master).start()
+    _fresh()
+    tr = _build_trainer()
+    w = ElasticWorker(srv.address, slot=slot, batch_size=batch_size,
+                      heartbeat_interval_s=0.0)   # heartbeat every batch
+    if spec:
+        fi.configure(spec)
+    out = []
+
+    def handler(e):
+        if isinstance(e, pt.trainer.events.EndIteration):
+            out.append(float(e.cost).hex())
+
+    try:
+        tr.train(w.reader, num_passes=1, event_handler=handler,
+                 elastic=w, checkpoint_dir=str(ckpt_dir), resume=True)
+    except Preempted:
+        w.preempted = True
+    finally:
+        if spec:
+            # firing counts snapshot BEFORE the spec reset wipes them
+            w.fired = {s: fi.fired(s)
+                       for s in ("elastic.worker", "master.heartbeat")}
+            fi.clear()
+        srv.stop()
+    return out, w
+
+
+# ---------------------------------------------------------------------------
+# 1. Slot-sharded exactly-once serving
+# ---------------------------------------------------------------------------
+def test_sharded_master_deterministic_disjoint_streams():
+    m = Master(world=2, timeout_s=30.0)
+    m.set_dataset([f"c{i}" for i in range(6)])
+    with pytest.raises(ValueError):
+        m.get_task()                       # sharded: slot is required
+    s0 = [m.get_task(slot=0).task_id for _ in range(3)]
+    s1 = [m.get_task(slot=1).task_id for _ in range(3)]
+    assert s0 == [0, 2, 4] and s1 == [1, 3, 5]   # ascending, disjoint
+    assert m.get_task(slot=0) is None and m.get_task(slot=1) is None
+
+
+def test_register_cursor_reconciles_shard():
+    """Committed-cursor reconcile: done-but-uncommitted tasks re-serve
+    in order; committed-but-unreported tasks stay done."""
+    m = Master(world=2, timeout_s=30.0)
+    m.set_dataset([f"c{i}" for i in range(6)])
+    # slot 0 pulls tasks 0 and 2, finishes 0 on the wire, commits NOTHING
+    t0 = m.get_task(slot=0)
+    m.task_finished(t0.task_id)
+    m.get_task(slot=0)                     # task 2 leased, never finished
+    # crash + relaunch with cursor=0: nothing committed -> everything of
+    # the shard re-serves, in order, exactly once
+    resp = m.register_worker(0, cursor=0)
+    assert resp["shard_done"] == 0
+    ids = [m.get_task(slot=0).task_id for _ in range(3)]
+    assert ids == [0, 2, 4]
+    # now the opposite: committed 2 tasks but the wire reports lagged
+    m2 = Master(world=2, timeout_s=30.0)
+    m2.set_dataset([f"c{i}" for i in range(6)])
+    resp = m2.register_worker(0, cursor=2)   # checkpoint covers 0 and 2
+    assert resp["shard_done"] == 2
+    assert m2.get_task(slot=0).task_id == 4  # only the tail remains
+    assert m2.stats()["done"] == 2
+
+
+def test_resize_reshards_remaining_work():
+    m = Master(world=4, timeout_s=30.0)
+    m.set_dataset([f"c{i}" for i in range(8)])
+    m.register_worker(0, cursor=1)         # task 0 committed
+    m.register_worker(1, cursor=1)         # task 1 committed
+    leased = m.get_task(slot=2)            # task 2 leased at resize time
+    assert leased.task_id == 2
+    m.resize(2)
+    assert m.world == 2 and m.members() == {}
+    # remaining 6 tasks re-shard by id % 2; the lease returned to todo
+    s0 = []
+    while True:
+        t = m.get_task(slot=0)
+        if t is None:
+            break
+        s0.append(t.task_id)
+    assert s0 == [2, 4, 6]                 # 0 stays done
+    s1 = []
+    while True:
+        t = m.get_task(slot=1)
+        if t is None:
+            break
+        s1.append(t.task_id)
+    assert s1 == [3, 5, 7]                 # 1 stays done
+
+
+def test_live_member_lease_renews_instead_of_requeueing():
+    """Sharded mode: a task whose DEADLINE lapsed but whose holder is
+    still heartbeating is the holder's slow task, not a dead worker's —
+    re-serving it to the same slot would double-train it and corrupt
+    the committed-cursor accounting.  The lease renews while the member
+    is fresh and forfeits once the membership lease goes stale."""
+    import time as _time
+    m = Master(world=1, timeout_s=0.05, heartbeat_lease_s=0.5)
+    m.set_dataset(["c0", "c1"])
+    m.register_worker(0)
+    t = m.get_task(slot=0)
+    assert t.task_id == 0
+    _time.sleep(0.1)                       # task deadline lapses...
+    m.heartbeat(0)                         # ...but the holder is alive
+    t2 = m.get_task(slot=0)
+    assert t2.task_id == 1                 # NOT a re-serve of task 0
+    assert t2.num_failures == 0
+    assert m.stats()["pending"] == 2
+    # now the member itself goes stale: the lease finally forfeits
+    _time.sleep(0.6)
+    got = {m.get_task(slot=0).task_id for _ in range(2)}
+    assert got == {0, 1}
+
+
+def test_empty_tasks_all_commit(tmp_path):
+    """Two consecutive ZERO-batch tasks (empty part files) must both
+    report finished after the next commit — a scalar pending-commit
+    would overwrite the first and leak its lease."""
+    import pickle as _pickle
+    chunks = []
+    for i, recs in enumerate(([], [],
+                              _write_chunk_records(8))):
+        p = str(tmp_path / f"part-{i:03d}.pickle")
+        with open(p, "wb") as f:
+            _pickle.dump(recs, f)
+        chunks.append(p)
+    m = Master(world=1, timeout_s=30.0)
+    m.set_dataset(chunks)
+    out, w = _run_worker(m, tmp_path / "ck")
+    assert len(out) == 2                   # only the real task's batches
+    assert w.cursor == 3
+    assert m.stats() == {"todo": 0, "pending": 0, "done": 3, "epoch": 0}
+
+
+def _write_chunk_records(n, seed=3):
+    rng = np.random.RandomState(seed)
+    return [(rng.rand(8).astype("float32"),
+             rng.randint(0, 3, (1,))) for _ in range(n)]
+
+
+def test_reconcile_ignores_failure_budget_drops():
+    """A task retired by the failure budget sits in done UNCOMMITTED;
+    the positional cursor must skip it — counting it would mark a
+    never-trained task committed and re-serve (double-train) a
+    genuinely committed one."""
+    import time as _time
+    m = Master(world=1, timeout_s=0.05, failure_max=1,
+               heartbeat_lease_s=0.05)
+    m.set_dataset(["c0", "c1", "c2"])
+    m.register_worker(0)
+    t0 = m.get_task(slot=0)
+    assert t0.task_id == 0
+    _time.sleep(0.12)          # task deadline AND membership lease lapse
+    t1 = m.get_task(slot=0)    # sweep drops task 0 (budget 1); serves 1
+    assert t1.task_id == 1
+    assert m.stats()["done"] == 1          # the drop
+    # worker committed task 1 but crashed before task_finished landed
+    resp = m.register_worker(0, cursor=1)
+    assert resp["shard_done"] == 1
+    # committed = first 1 of the NON-dropped shard [1, 2] = {1}: task 1
+    # stays done, only task 2 re-serves, the drop stays dropped
+    t = m.get_task(slot=0)
+    assert t.task_id == 2
+    assert m.get_task(slot=0) is None
+
+
+@pytest.mark.timeout(180)
+def test_zero_batch_tail_after_drained_resume_commits(tmp_path):
+    """A drained worker resumed onto a tail of EMPTY tasks trains zero
+    batches — the final save must still honor the pending task-boundary
+    commit so those tasks report finished (a dropped request would
+    leave them leased forever and the job never completes)."""
+    import pickle as _pickle
+    chunks = []
+    for i, recs in enumerate((_write_chunk_records(8), [], [])):
+        p = str(tmp_path / f"part-{i:03d}.pickle")
+        with open(p, "wb") as f:
+            _pickle.dump(recs, f)
+        chunks.append(p)
+    m = Master(world=1, timeout_s=30.0)
+    m.set_dataset(chunks)
+    m.register_worker(0)
+    m.set_command("drain", slot=0)
+    out1, w1 = _run_worker(m, tmp_path / "ck")
+    assert w1.drained and len(out1) == 2
+    assert m.stats()["done"] == 1
+    out2, w2 = _run_worker(m, tmp_path / "ck")
+    assert out2 == [] and w2.cursor == 3
+    assert m.stats() == {"todo": 0, "pending": 0, "done": 3, "epoch": 0}
+
+
+# ---------------------------------------------------------------------------
+# 2. Worker training over the sharded stream: exactly-once + bit-identity
+# ---------------------------------------------------------------------------
+@pytest.mark.timeout(180)
+def test_two_slot_workers_consume_disjoint_shards(tmp_path):
+    chunks = _write_chunks(tmp_path, n_chunks=4)
+    m = Master(world=2, timeout_s=30.0)
+    m.set_dataset(chunks)
+    out0, w0 = _run_worker(m, tmp_path / "s0", slot=0)
+    out1, w1 = _run_worker(m, tmp_path / "s1", slot=1)
+    # 2 tasks per slot x 8 recs / batch 4 = 4 batches each, all committed
+    assert len(out0) == 4 and len(out1) == 4
+    assert w0.cursor == 2 and w1.cursor == 2
+    assert m.stats() == {"todo": 0, "pending": 0, "done": 4, "epoch": 0}
+    # completion deregistered both slots
+    assert m.members() == {}
+    # each slot's TrainState carries its committed elastic position
+    for d, slot in ((tmp_path / "s0", 0), (tmp_path / "s1", 1)):
+        sc = pt.core.scope.Scope() if hasattr(pt.core, "scope") else None
+        from paddle_tpu.core.scope import Scope
+        sc = Scope()
+        CheckpointManager(str(d)).restore(scope=sc)
+        ts = TrainState.from_array(sc.get(TRAIN_STATE_VAR))
+        assert ts.elastic["slot"] == slot
+        assert ts.elastic["cursor"] == 2 and ts.elastic["offset"] == 0
+
+
+@pytest.mark.timeout(300)
+def test_elastic_preempt_resume_bit_identity_mid_task(tmp_path):
+    """The acceptance pin, in-process: a worker preempted MID-task
+    (emergency checkpoint carries cursor + within-task offset) and
+    relaunched against the same master produces a merged stream
+    bit-identical to the uninterrupted run — no lost batch, no replayed
+    batch."""
+    chunks = _write_chunks(tmp_path, n_chunks=4)
+
+    base_master = Master(world=1, timeout_s=30.0)
+    base_master.set_dataset(chunks)
+    baseline, _ = _run_worker(base_master, tmp_path / "ck-base")
+    assert len(baseline) == 8              # 4 tasks x 2 batches
+
+    m = Master(world=1, timeout_s=30.0)
+    m.set_dataset(chunks)
+    ck = tmp_path / "ck-int"
+    # tasks are 2 batches long: index 5 lands mid-task-3 (the preempt is
+    # honored at the NEXT boundary, so the emergency state has offset>0)
+    part1, w1 = _run_worker(m, ck, spec="elastic.worker@5=preempt")
+    assert getattr(w1, "preempted", False)
+    assert 0 < len(part1) < 8
+    part2, w = _run_worker(m, ck)
+    assert part1 + part2 == baseline       # bit-identical, zero overlap
+    assert w.cursor == 4
+    assert m.stats()["done"] == 4
+
+
+@pytest.mark.timeout(180)
+def test_drain_command_ends_stream_at_task_boundary(tmp_path):
+    """A pre-armed drain command stops the worker after its FIRST task
+    with that task committed; a relaunch finishes the remainder."""
+    chunks = _write_chunks(tmp_path, n_chunks=3)
+    m = Master(world=1, timeout_s=30.0)
+    m.set_dataset(chunks)
+    m.register_worker(0)                   # make the slot commandable
+    m.set_command("drain", slot=0)
+    out1, w1 = _run_worker(m, tmp_path / "ck")
+    assert w1.drained
+    assert len(out1) == 2                  # exactly one task's batches
+    assert m.stats()["done"] == 1          # committed AND reported
+    out2, w2 = _run_worker(m, tmp_path / "ck")
+    assert not w2.drained
+    assert len(out2) == 4
+    assert m.stats()["done"] == 3
+
+
+def test_heartbeat_drop_injection_is_survivable(tmp_path):
+    """master.heartbeat@*=drop: every heartbeat is lost on the wire; the
+    worker keeps training (best-effort semantics) and the master simply
+    sees staleness."""
+    chunks = _write_chunks(tmp_path, n_chunks=2)
+    m = Master(world=1, timeout_s=30.0, heartbeat_lease_s=0.0)
+    m.set_dataset(chunks)
+    out, w = _run_worker(m, tmp_path / "ck",
+                         spec="master.heartbeat@*=drop")
+    assert len(out) == 4                   # training unaffected
+    assert w.fired["master.heartbeat"] >= 1
+    # registration happened (bind), but no heartbeat ever refreshed it
+    # (the worker deregistered at completion, so membership is empty)
+    assert m.stats()["done"] == 2
+
+
+# ---------------------------------------------------------------------------
+# 3. train(elastic=...) surface validation
+# ---------------------------------------------------------------------------
+def test_train_elastic_requires_checkpoint_dir_and_per_batch_path():
+    tr = _build_trainer()
+
+    class Hook:
+        pass
+
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        tr.train(lambda: iter([]), elastic=Hook())
+    with pytest.raises(ValueError, match="per-batch"):
+        tr.train(lambda: iter([]), elastic=Hook(), checkpoint_dir="/x",
+                 pipeline=True)
+    with pytest.raises(ValueError, match="per-batch"):
+        tr.train(lambda: iter([]), elastic=Hook(), checkpoint_dir="/x",
+                 steps_per_dispatch=4)
+
+
+def test_train_state_elastic_field_round_trips():
+    ts = TrainState(emitted=7, elastic={"slot": 3, "cursor": 5,
+                                        "offset": 1, "world": 8,
+                                        "resize_epoch": 2})
+    back = TrainState.from_array(ts.to_array())
+    assert back.elastic == ts.elastic
+    # old checkpoints (no elastic key) still load
+    legacy = dataclasses.replace(ts, elastic=None)
+    assert TrainState.from_array(legacy.to_array()).elastic is None
+
+
+# ---------------------------------------------------------------------------
+# 4. Replica merge
+# ---------------------------------------------------------------------------
+def _write_replica(d, params, emitted, elastic):
+    from paddle_tpu.core.scope import Scope
+    sc = Scope()
+    for k, v in params.items():
+        sc.set(k, v)
+    ts = TrainState(emitted=emitted, exe_step=emitted, pass_id=1,
+                    elastic=elastic)
+    sc.set(TRAIN_STATE_VAR, ts.to_array())
+    CheckpointManager(str(d), async_save=False).save(emitted, sc,
+                                                     blocking=True)
+
+
+def test_merge_checkpoints_elementwise_mean_and_lineage(tmp_path):
+    w = np.array([1.0, 3.0], np.float32)
+    _write_replica(tmp_path / "s0",
+                   {"w": w, "step": np.array([4], np.int64)},
+                   emitted=4, elastic={"slot": 0, "cursor": 2,
+                                       "offset": 0, "world": 2,
+                                       "resize_epoch": 0})
+    _write_replica(tmp_path / "s1",
+                   {"w": w + 2.0, "step": np.array([9], np.int64)},
+                   emitted=6, elastic={"slot": 1, "cursor": 3,
+                                       "offset": 0, "world": 2,
+                                       "resize_epoch": 0})
+    info = merge_checkpoints([str(tmp_path / "s0"), str(tmp_path / "s1")],
+                             str(tmp_path / "base"), world=1,
+                             resize_epoch=1)
+    assert len(info["merged_from"]) == 2
+    assert info["emitted"] == 6            # chief = most-emitted replica
+    from paddle_tpu.core.scope import Scope
+    sc = Scope()
+    CheckpointManager(str(tmp_path / "base")).restore(scope=sc)
+    np.testing.assert_allclose(np.asarray(sc.get("w")), w + 1.0)  # mean
+    assert int(np.asarray(sc.get("step"))[0]) == 9   # chief's non-float
+    ts = TrainState.from_array(sc.get(TRAIN_STATE_VAR))
+    # the pass loop restarts and the lineage carries the NEW generation
+    assert ts.pass_id == 0 and ts.batch_id == 0
+    assert ts.elastic == {"slot": None, "cursor": None, "offset": 0,
+                          "world": 1, "resize_epoch": 1}
+    assert ts.emitted == 6                 # counters continue, no reset
+
+
+def test_merge_skips_empty_and_requires_one(tmp_path):
+    os.makedirs(tmp_path / "empty")
+    with pytest.raises(FileNotFoundError):
+        merge_checkpoints([str(tmp_path / "empty")],
+                          str(tmp_path / "base"), world=1, resize_epoch=1)
+    _write_replica(tmp_path / "s0", {"w": np.ones(2, np.float32)},
+                   emitted=1, elastic=None)
+    info = merge_checkpoints([str(tmp_path / "empty"),
+                              str(tmp_path / "s0")],
+                             str(tmp_path / "base"), world=1,
+                             resize_epoch=1)
+    assert info["merged_from"] == [str(tmp_path / "s0")]
+
+
+# ---------------------------------------------------------------------------
+# 5. Re-plan validation (the resize record's static proof)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("world", [8, 4, 2, 1])
+def test_plan_for_world_zero_sharding_findings(world):
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(h, size=3, act="softmax")
+    layers.mean(layers.cross_entropy(pred, y))
+    payload = plan_for_world(pt.default_main_program(), world,
+                             assume_batch=16)
+    assert payload["lint_findings"] == []
+    assert payload["mesh"] == {"dp": world}
+    assert payload["plan"]["feed_specs"]        # feeds batch-sharded

@@ -1,0 +1,135 @@
+"""distributed/launch.py hardening: single-process no-op, env-var
+resolution, retry-with-backoff around jax.distributed.initialize, and
+the typed coordinator-timeout error (PADDLE_TPU_COORDINATOR_TIMEOUT_S).
+
+The multi-host paths monkeypatch ``jax.distributed.initialize`` — no
+real coordinator is reachable in this container (and the CPU backend's
+real multi-process collectives are a known pre-existing gap covered by
+tests/test_multiprocess_launch.py)."""
+import pytest
+
+import jax
+
+from paddle_tpu.distributed import launch
+from paddle_tpu.faults import RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    launch.reset_distributed_state()
+    monkeypatch.delenv("PADDLE_TPU_COORDINATOR", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_COORDINATOR_TIMEOUT_S", raising=False)
+    yield
+    launch.reset_distributed_state()
+
+
+def test_single_process_noop(monkeypatch):
+    """No coordinator anywhere: init is a no-op that still marks the
+    process initialized (idempotent), and never touches jax.distributed."""
+    def boom(**kw):
+        raise AssertionError("initialize must not be called")
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    assert not launch.is_initialized()
+    launch.init_distributed()
+    assert launch.is_initialized()
+    launch.init_distributed()          # second call: still a no-op
+    assert launch.is_initialized()
+
+
+def test_env_var_coordinator_path(monkeypatch):
+    """PADDLE_TPU_COORDINATOR alone routes into the multi-host path with
+    the env-provided address."""
+    seen = {}
+
+    def fake_init(coordinator_address=None, num_processes=None,
+                  process_id=None):
+        seen.update(address=coordinator_address, n=num_processes,
+                    pid=process_id)
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setenv("PADDLE_TPU_COORDINATOR", "10.0.0.1:1234")
+    launch.init_distributed(num_processes=2, process_id=1)
+    assert seen == {"address": "10.0.0.1:1234", "n": 2, "pid": 1}
+    assert launch.is_initialized()
+
+
+def test_transient_failures_retry_then_succeed(monkeypatch):
+    """Connection-flavored failures retry with the seeded backoff; a
+    later success initializes normally."""
+    calls = {"n": 0}
+
+    def flaky(**kw):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionRefusedError("coordinator not up yet")
+    monkeypatch.setattr(jax.distributed, "initialize", flaky)
+    sleeps = []
+    monkeypatch.setattr(launch, "retry_call",
+                        lambda fn, policy, **kw: _drive_retry(
+                            fn, policy, sleeps, kw))
+    launch.init_distributed(coordinator_address="h:1", num_processes=2,
+                            process_id=0, timeout_s=30.0)
+    assert calls["n"] == 3
+    assert launch.is_initialized()
+    assert len(sleeps) == 2 and all(s > 0 for s in sleeps)
+
+
+def _drive_retry(fn, policy, sleeps, kw):
+    """Run the real retry_call with an instrumented no-op sleep."""
+    from paddle_tpu.faults import retry_call
+    kw = dict(kw)
+    kw["sleep"] = sleeps.append
+    return retry_call(fn, policy, **kw)
+
+
+def test_timeout_budget_raises_typed_error(monkeypatch):
+    """A coordinator that never answers exhausts the budget and raises
+    CoordinatorTimeoutError carrying address + budget (not the raw
+    transport error)."""
+    def dead(**kw):
+        raise ConnectionRefusedError("nobody home")
+    monkeypatch.setattr(jax.distributed, "initialize", dead)
+    # a tiny budget via the env knob; zero real sleeping (policy still
+    # schedules delays, so neutralize time.sleep inside retry_call)
+    monkeypatch.setenv("PADDLE_TPU_COORDINATOR_TIMEOUT_S", "3")
+    import paddle_tpu.faults as faults
+    monkeypatch.setattr(faults.time, "sleep", lambda s: None)
+    with pytest.raises(launch.CoordinatorTimeoutError) as ei:
+        launch.init_distributed(coordinator_address="h:9", num_processes=2,
+                                process_id=0)
+    err = ei.value
+    assert err.address == "h:9"
+    assert err.timeout_s == 3.0
+    assert isinstance(err.last, ConnectionRefusedError)
+    assert isinstance(err, TimeoutError)
+    assert not launch.is_initialized()
+
+
+def test_fatal_failures_do_not_retry(monkeypatch):
+    """A deterministic setup error (bad arguments) propagates on the
+    first attempt — retrying a ValueError would just stall the pod."""
+    calls = {"n": 0}
+
+    def bad(**kw):
+        calls["n"] += 1
+        raise ValueError("num_processes mismatch")
+    monkeypatch.setattr(jax.distributed, "initialize", bad)
+    with pytest.raises(ValueError):
+        launch.init_distributed(coordinator_address="h:1",
+                                num_processes=2, process_id=0)
+    assert calls["n"] == 1
+    assert not launch.is_initialized()
+
+
+def test_retry_policy_fits_budget():
+    """The derived schedule's total sleep stays within the budget and is
+    deterministic (seeded)."""
+    for budget in (1.0, 10.0, 60.0, 300.0):
+        policy = launch._retry_policy(budget)
+        assert isinstance(policy, RetryPolicy)
+        total = sum(policy.delay(i)
+                    for i in range(policy.max_attempts - 1))
+        assert total <= budget * (1.0 + policy.jitter) + 1e-6, budget
+    # same args -> same schedule (the chaos-determinism convention)
+    a, b = launch._retry_policy(60.0), launch._retry_policy(60.0)
+    assert [a.delay(i) for i in range(a.max_attempts - 1)] == \
+        [b.delay(i) for i in range(b.max_attempts - 1)]

@@ -181,6 +181,103 @@ def test_requeue_timeouts_redispatch_exactly_once():
     assert st["done"] == 2 and st["pending"] == 0
 
 
+def test_membership_register_heartbeat_members_over_rpc():
+    """The etcd-membership analog end to end over the wire: register,
+    heartbeat refresh, lease-style staleness, command delivery on the
+    heartbeat reply, deregister."""
+    m = Master(chunks_per_task=1, timeout_s=30.0, world=2,
+               heartbeat_lease_s=0.2)
+    m.set_dataset([["a"], ["b"]])
+    srv = _start(m)
+    try:
+        c = MasterClient(srv.address)
+        resp = c.register_worker(0, cursor=None, pid=123)
+        assert resp["ok"] and resp["world"] == 2
+        assert resp["shard_done"] == 0
+        hb = c.heartbeat(0)
+        assert hb["ok"] and hb["cmd"] is None
+        mem = c.members()
+        assert mem[0]["stale"] is False and mem[0]["pid"] == 123
+        time.sleep(0.3)                      # lease lapses
+        assert c.members()[0]["stale"] is True
+        c.heartbeat(0)                       # refresh recovers the lease
+        assert c.members()[0]["stale"] is False
+        # command channel: the coordinator's drain rides the reply
+        m.set_command("drain", slot=0)
+        assert c.heartbeat(0)["cmd"] == "drain"
+        m.set_command(None, slot=0)
+        assert c.heartbeat(0)["cmd"] is None
+        c.deregister_worker(0)
+        assert c.members() == {}
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_heartbeat_from_unregistered_slot_auto_registers():
+    m = Master(heartbeat_lease_s=5.0)
+    assert m.heartbeat(3)["ok"]
+    assert 3 in m.members() and not m.members()[3]["stale"]
+
+
+def test_membership_survives_state_dict_round_trip():
+    """Membership + world serialize in state_dict, so a coordinator
+    restart (job-record restore) still knows its fleet; a long outage
+    reads as every member stale — which is correct."""
+    m = Master(world=4, heartbeat_lease_s=0.05)
+    m.set_dataset([[i] for i in range(4)])
+    m.register_worker(0, cursor=1, pid=11)
+    m.register_worker(2, cursor=0, pid=22)
+    state = m.state_dict()
+    # JSON round-trip (the job record is a JSON file)
+    state = json.loads(json.dumps(state))
+    fresh = Master(heartbeat_lease_s=0.05)   # lease is config, not state
+    fresh.load_state_dict(state)
+    assert fresh.world == 4
+    mem = fresh.members()
+    assert set(mem) == {0, 2} and mem[0]["pid"] == 11
+    time.sleep(0.06)
+    assert all(v["stale"] for v in fresh.members().values())
+    # the queue state round-tripped too (task 0 reconciled done)
+    assert fresh.stats()["done"] == 1
+
+
+def test_snapshot_path_preserves_sharded_mode(tmp_path):
+    """The per-task_finished snapshot file carries the same payload as
+    state_dict (world + membership included) — a snapshot restore of a
+    sharded master must not silently fall back to the racy pull queue."""
+    p = str(tmp_path / "snap.json")
+    m = Master(world=2, snapshot_path=p)
+    m.set_dataset([["a"], ["b"], ["c"], ["d"]])
+    m.register_worker(0, pid=7)
+    t = m.get_task(slot=0)
+    m.task_finished(t.task_id)             # writes the snapshot
+    m2 = Master(snapshot_path=p)
+    m2.restore_snapshot()
+    assert m2.world == 2
+    assert m2.members()[0]["pid"] == 7
+    with pytest.raises(ValueError):
+        m2.get_task()                      # still slot-sharded
+    assert m2.get_task(slot=0).task_id == 2
+
+
+def test_state_dict_rpc_duck_types_for_checkpoint_embedding():
+    """MasterClient.state_dict(): train(master=client) can embed a
+    REMOTE master's queue position in its checkpoint's TrainState."""
+    m = Master(chunks_per_task=1, timeout_s=30.0)
+    m.set_dataset([["a"], ["b"]])
+    srv = _start(m)
+    try:
+        c = MasterClient(srv.address)
+        t = c.get_task()
+        c.task_finished(t.task_id)
+        state = c.state_dict()
+        assert len(state["done"]) == 1 and len(state["todo"]) == 1
+        c.close()
+    finally:
+        srv.stop()
+
+
 def test_task_returned_nowait_succeeds_against_live_master():
     """The fast path is not only for dead masters: against a live one it
     really returns the task (re-queued immediately, no budget burn)."""

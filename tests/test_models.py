@@ -107,7 +107,11 @@ def test_wide_deep_trains(rng):
 @pytest.mark.parametrize("builder,shape", [
     (models.alexnet, (1, 3, 224, 224)),
     (models.vgg16, (1, 3, 32, 32)),
-    (models.googlenet, (1, 3, 64, 64)),
+    # googlenet costs ~16s on this container (PR 13 budget audit); its
+    # graph is still validated tier-1 by the analysis zoo matrix and
+    # executed by the @slow planner parity matrix
+    pytest.param(models.googlenet, (1, 3, 64, 64),
+                 marks=pytest.mark.slow),
     (lambda x: models.resnet_imagenet(x, depth=18), (1, 3, 64, 64)),
 ])
 def test_imagenet_models_forward(builder, shape, rng):

@@ -808,6 +808,89 @@ def test_lint_gate_covers_http_and_fleet_modules():
     assert {"http/request", "fleet/autoscale"} <= spans
 
 
+def _top_level_distributed_submodule_imports(submod: str):
+    """(rel, lineno) of every TOP-LEVEL import of
+    ``paddle_tpu/distributed/<submod>.py`` from any OTHER module —
+    including distributed/__init__.py: importing paddle_tpu (or the
+    distributed package for its Master/Supervisor surface) must not
+    load the elastic service."""
+    target = f"distributed.{submod}"
+    own = f"paddle_tpu/distributed/{submod}.py"
+
+    def _is_hit(node, rel):
+        in_pkg = rel.startswith("paddle_tpu/distributed/")
+        mod = getattr(node, "module", "") or ""
+        names = [a.name for a in node.names]
+        if isinstance(node, ast.Import):
+            return any(f"paddle_tpu.{target}" in n for n in names)
+        if target in mod:
+            return True
+        if mod.endswith("distributed") and submod in names:
+            return True
+        if node.level > 0 and in_pkg:
+            # from .elastic import X / from . import elastic
+            if mod == submod:
+                return True
+            if mod == "" and submod in names:
+                return True
+        return False
+
+    found = []
+    for rel, tree in _iter_sources():
+        if rel == own:
+            continue
+
+        def visit(node, in_func):
+            for child in ast.iter_child_nodes(node):
+                nested = in_func or isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                if isinstance(child, (ast.Import, ast.ImportFrom)) \
+                        and not in_func and _is_hit(child, rel):
+                    found.append(f"{rel}:{child.lineno}")
+                visit(child, nested)
+        visit(tree, False)
+    return found
+
+
+def test_elastic_module_only_imported_lazily():
+    """Zero-cost-when-unused for the elastic training service (ISSUE
+    13): importing paddle_tpu — or paddle_tpu.distributed itself, i.e.
+    using Master/Supervisor/CheckpointManager — loads neither the
+    elastic coordinator nor its analysis/planner import chain.  Only
+    the opted-in surfaces (the `elastic` CLI branch, an explicit
+    `from paddle_tpu.distributed.elastic import ...`) may load it,
+    lazily."""
+    toplevel = _top_level_distributed_submodule_imports("elastic")
+    assert not toplevel, (
+        "top-level import of distributed.elastic — must be lazy "
+        "(inside a function) so `import paddle_tpu` stays "
+        "elastic-free: " + ", ".join(toplevel))
+    # and the sanctioned lazy site exists (the CLI elastic branch)
+    with open(os.path.join(ROOT, "cli.py")) as fh:
+        assert "from paddle_tpu.distributed.elastic import elastic_main" \
+            in fh.read()
+    # the distributed package __init__ must not re-export it either
+    with open(os.path.join(ROOT, "distributed", "__init__.py")) as fh:
+        assert "elastic" not in fh.read()
+
+
+def test_lint_gate_covers_elastic_module():
+    """distributed/elastic.py is inside every lint's scan set, its
+    elastic/* metric names are frozen in METRIC_NAMES, its span name is
+    frozen in SPAN_NAMES (the used==registered check then keeps the
+    resize boundary instrumented), and the new injection sites are
+    registered in the faultinject harness."""
+    rels = {rel for rel, _ in _iter_sources()}
+    assert "paddle_tpu/distributed/elastic.py" in rels
+    registered = {n for n, _ in _metric_names_table()}
+    assert {n for n in registered if n.startswith("elastic/")} >= {
+        "elastic/workers", "elastic/heartbeats", "elastic/drains",
+        "elastic/resizes", "elastic/resize_ms"}
+    assert "elastic/resize" in set(_span_names_table())
+    from paddle_tpu.testing.faultinject import KNOWN_SITES
+    assert {"elastic.worker", "master.heartbeat"} <= set(KNOWN_SITES)
+
+
 def test_shard_fn_registry_matches_ast_scan():
     """Same agreement gate for the sharding-propagation rules: every
     live register_shard_fn name is a string literal the duplicate lint
