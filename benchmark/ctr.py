@@ -1,31 +1,40 @@
-"""Sparse/CTR training benchmark (BASELINE.json flagship config #4:
-DeepFM / wide-deep CTR with high-dim sparse tables — the workload the
-reference served with SparseRemoteParameterUpdater + SparseRowMatrix
-(RemoteParameterUpdater.h:265, math/SparseRowMatrix.h:206); here the
-embedding is a vocab-shardable jax table, gathers ride XLA, and the
-question is what actually bounds a step at 10M-row scale).
+#!/usr/bin/env python
+"""Giant-embedding CTR benchmark: host-resident sparse parameter server
+vs a dense device-resident embedding (paddle_tpu.sparse; ROADMAP item
+4(a); the reference capability is the pserver sparse-row path —
+SparseRemoteParameterUpdater.h:265, math/SparseRowMatrix.h:206).
 
-Measures rows/s for wide_deep with a 10M-row embedding table (plus
-1M/100k/10k auxiliary fields, criteo-ish 13 dense features) under three
-optimizers that isolate the suspected bottleneck — the dense optimizer
-moment sweep over the big tables:
+The configuration declares a **device HBM embedding budget** and a vocab
+whose full dense table EXCEEDS it (the giant-embedding regime: the table
+cannot live on one device, so it lives on the host and each step pulls
+only the rows a batch touches).  Measured rows, all REAL and in-container
+(CPU; the TPU row is a pending-hardware stub per the PR 1 convention):
 
-  sgd        — no optimizer state: the only table traffic is gather +
-               scatter-add grads (update touches rows... but XLA applies
-               dense w - lr*g over the full table: still a full sweep)
-  adam       — dense fused sweep: reads w,m,v + writes w,m,v every step
-  adam_lazy  — Adam(lazy_mode=True): gather/scatter moment update on the
-               touched rows only (re-validating the round-4 negative
-               result at 10M-row scale, where the dense sweep costs
-               ~2 GB/step of HBM traffic and lazy SHOULD win)
+* ``examples_per_sec`` — wide&deep-style CTR training throughput,
+  host-sparse table vs the dense-embedding control (same model, same
+  feed stream, pinned window form: median of K-step windows);
+* ``lookup_latency_ms`` — p50/p99 of per-batch deduped row pulls;
+* ``push_rows_per_sec`` — sparse-update throughput (host-side per-row
+  Adagrad applied to the pushed gradient rows);
+* ``cache`` — hot-rows cache hit rate under a zipfian id distribution
+  (read-only serving-style traffic);
+* ``doctor`` — the PR 10 measured-vs-modeled step budget attached to
+  the sparse arm, so the host-bound-vs-compute-bound claim is measured,
+  not asserted.
 
-Methodology: pinned compiled-window form — one `Executor.run_steps(K)`
-dispatch per timed window, feeds staged on device once, median of 3
-windows, completion forced by a scalar fetch (axon block_until_ready
-returns early).  Writes benchmark/ctr_results.json.
+Writes benchmark/ctr_results.json.  The round-4 dense-optimizer-moment
+sweep this file used to hold (a REAL TPU v5lite measurement from before
+the sparse subsystem existed) is preserved under
+``legacy_r04_dense_optimizer_sweep``.
+
+Usage::
+
+    python benchmark/ctr.py [--smoke] [--out PATH]
+    python benchmark/run.py --model ctr [--smoke]
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -33,95 +42,357 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import paddle_tpu as pt                      # noqa: E402
-from paddle_tpu import layers, models        # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "ctr_results.json")
 
-VOCABS = [10_000_000, 1_000_000, 100_000, 10_000]
-EMB_DIM = 16
-DENSE_D = 13
-BATCH = 4096
+# -- configuration -----------------------------------------------------------
+# The benchmark's premise, stated up front: a per-device HBM slice
+# budgeted for embeddings (a v5e-lite slice share).  The big table's
+# dense form must NOT fit it.
+HBM_EMBEDDING_BUDGET_MB = 64
+
+FULL = {
+    "batch": 512,
+    "emb_dim": 16,
+    "vocab_big": 2_000_000,      # dense: 2e6*16*4 = 122 MiB > budget
+    "vocab_small": 100_000,
+    "dense_features": 13,
+    "hidden": 64,
+    "warmup_steps": 3,
+    "window_steps": 10,
+    "windows": 3,
+    "cache_rows": 65_536,
+    "cache_batches": 60,
+    "zipf_a": 1.2,
+}
+SMOKE = {
+    "batch": 64,
+    "emb_dim": 8,
+    "vocab_big": 20_000,
+    "vocab_small": 2_000,
+    "dense_features": 4,
+    "hidden": 16,
+    "warmup_steps": 1,
+    "window_steps": 3,
+    "windows": 2,
+    "cache_rows": 1024,
+    "cache_batches": 8,
+    "zipf_a": 1.2,
+}
 
 
-def _build(optimizer):
+def _build_model(cfg, sparse: bool):
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
     pt.core.reset_default_programs()
     pt.core.reset_global_scope()
     pt.unique_name.reset()
-    ids = [layers.data(f"id{i}", shape=[1], dtype="int64")
-           for i in range(len(VOCABS))]
-    dense = layers.data("dense", shape=[DENSE_D], dtype="float32")
+    pt.default_main_program().random_seed = 42
+    pt.default_startup_program().random_seed = 42
+    ids_big = layers.data("ids_big", shape=[1], dtype="int64")
+    ids_small = layers.data("ids_small", shape=[1], dtype="int64")
+    dense = layers.data("dense", shape=[cfg["dense_features"]],
+                        dtype="float32")
     label = layers.data("label", shape=[1], dtype="float32")
-    pred = models.wide_deep(ids, dense, VOCABS, emb_dim=EMB_DIM)
-    loss = layers.mean(layers.log_loss(pred, label))
-    optimizer.minimize(loss)
+    kw = {"sparse": True} if sparse else {}
+    e_big = layers.embedding(ids_big, size=[cfg["vocab_big"],
+                                            cfg["emb_dim"]],
+                             name="ctr_big", **kw)
+    e_small = layers.embedding(ids_small, size=[cfg["vocab_small"],
+                                                cfg["emb_dim"]],
+                               name="ctr_small", **kw)
+    x = layers.concat([e_big, e_small, dense], axis=1)
+    x = layers.fc(x, size=cfg["hidden"], act="relu")
+    pred = layers.fc(x, size=1, act="sigmoid")
+    loss = layers.mean(layers.square(pred - label))
+    pt.optimizer.Adagrad(learning_rate=0.05).minimize(loss)
     return loss
 
 
-def _feeds(rng):
-    f = {f"id{i}": rng.randint(0, v, (BATCH, 1))
-         for i, v in enumerate(VOCABS)}
-    f["dense"] = rng.rand(BATCH, DENSE_D).astype("float32")
-    f["label"] = (rng.rand(BATCH, 1) < 0.3).astype("float32")
-    return f
+def _zipf_ids(rng, a, vocab, size):
+    """Zipfian ids over [0, vocab): heavy head at small ids — the CTR
+    id-frequency shape the hot-rows cache is built for."""
+    draws = rng.zipf(a, size=size).astype(np.int64)
+    return (draws - 1) % vocab
 
 
-def bench_variant(name, optimizer, iters=100, reps=3):
-    import jax
+def _feed_stream(cfg, n, seed=0):
+    rng = np.random.RandomState(seed)
+    B = cfg["batch"]
+    for _ in range(n):
+        yield {
+            "ids_big": _zipf_ids(rng, cfg["zipf_a"], cfg["vocab_big"],
+                                 (B, 1)),
+            "ids_small": _zipf_ids(rng, cfg["zipf_a"],
+                                   cfg["vocab_small"], (B, 1)),
+            "dense": rng.rand(B, cfg["dense_features"]).astype(
+                np.float32),
+            "label": (rng.rand(B, 1) < 0.3).astype(np.float32),
+        }
 
-    rng = np.random.RandomState(0)
-    loss = _build(optimizer)
+
+def _pctl(xs, q):
+    if not xs:
+        return None
+    return float(np.percentile(np.asarray(xs, np.float64), q))
+
+
+def _sparse_tables(cfg, storage="memory", storage_dir=None):
+    from paddle_tpu.sparse import SparseTable
+    kw = dict(optimizer="adagrad", learning_rate=0.05,
+              storage=storage, storage_dir=storage_dir)
+    return {
+        "ctr_big": SparseTable("ctr_big", cfg["vocab_big"],
+                               cfg["emb_dim"], num_shards=8, seed=1,
+                               **kw),
+        "ctr_small": SparseTable("ctr_small", cfg["vocab_small"],
+                                 cfg["emb_dim"], num_shards=4, seed=2,
+                                 **kw),
+    }
+
+
+def run_sparse_arm(cfg, quiet=False):
+    """Sparse-table training throughput + lookup/push micro-metrics."""
+    import paddle_tpu as pt
+    from paddle_tpu.sparse import SparseSession
+
+    loss = _build_model(cfg, sparse=True)
+    tables = _sparse_tables(cfg)
+    # bucket pinned to the batch size: ONE compiled variant regardless
+    # of per-batch unique counts (the production config; the default
+    # power-of-two laddering is for workloads with wild unique-count
+    # variance that cannot afford max-size pulls)
+    sess = SparseSession(tables, bucket_floor=cfg["batch"])
+    sess.bind(pt.default_main_program())
     exe = pt.Executor()
-    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
-    feeds = {k: jax.device_put(v) for k, v in _feeds(rng).items()}
-    # warmup compiles the SAME scan length as the timed windows
-    (lv,) = exe.run_steps(iters, feed=feeds, fetch_list=[loss],
-                          return_numpy=False)
-    if not np.isfinite(float(np.asarray(lv)[-1])):
-        raise FloatingPointError(f"{name}: non-finite warmup loss")
-    times = []
-    for _ in range(reps):
+    exe.run(pt.default_startup_program())
+
+    steps = cfg["warmup_steps"] + cfg["windows"] * cfg["window_steps"]
+    feeds = list(_feed_stream(cfg, steps))
+    pull_ms, push_rows, push_ms = [], 0, 0.0
+    windows, last_window_pulls = [], []
+    k = 0
+    for w in range(-1, cfg["windows"]):      # window -1 = warmup
+        n = cfg["warmup_steps"] if w < 0 else cfg["window_steps"]
         t0 = time.perf_counter()
-        (lv,) = exe.run_steps(iters, feed=feeds, fetch_list=[loss],
-                              return_numpy=False)
-        last = float(np.asarray(lv)[-1])     # completion barrier
-        times.append(time.perf_counter() - t0)
-    if not np.isfinite(last):
-        raise FloatingPointError(f"{name}: non-finite timed loss")
-    med = float(np.median(times)) / iters
-    row = {"variant": name, "ms_per_step": round(med * 1e3, 3),
-           "rows_per_sec": round(BATCH / med),
-           "spread_pct": round(100 * (max(times) - min(times))
-                               / np.median(times), 2)}
-    print(json.dumps(row), flush=True)
+        for _ in range(n):
+            feed = feeds[k]
+            k += 1
+            s0 = dict(sess.stats)
+            out = sess.run(exe, pt.default_main_program(), feed, [loss])
+            float(out[0])                    # force completion
+            if w >= 0:
+                dt = sess.stats["pull_ms"] - s0["pull_ms"]
+                pull_ms.append(dt)
+                if w == cfg["windows"] - 1:
+                    last_window_pulls.append(dt)
+                push_rows += sess.stats["pushed_rows"] \
+                    - s0["pushed_rows"]
+                push_ms += sess.stats["push_ms"] - s0["push_ms"]
+        if w >= 0:
+            windows.append(cfg["batch"] * n
+                           / (time.perf_counter() - t0))
+    row = {
+        "examples_per_sec": round(float(np.median(windows)), 1),
+        "examples_per_sec_windows": [round(x, 1) for x in windows],
+        # all-windows latency includes the lazy cold-row initialization
+        # of the zipf tail (real CTR behavior); the warm row is the
+        # last window alone, where most pulls hit resident rows
+        "lookup_latency_ms": {"p50": round(_pctl(pull_ms, 50), 3),
+                              "p99": round(_pctl(pull_ms, 99), 3)},
+        "lookup_latency_warm_ms": {
+            "p50": round(_pctl(last_window_pulls, 50), 3),
+            "p99": round(_pctl(last_window_pulls, 99), 3)},
+        "push_rows_per_sec": round(push_rows / (push_ms / 1e3), 1)
+        if push_ms else None,
+        "pushed_rows": int(push_rows),
+        "live_rows": {n: t.live_rows for n, t in tables.items()},
+        "host_table_mb": round(sum(t.host_bytes()
+                                   for t in tables.values()) / 2**20, 2),
+    }
+    if not quiet:
+        print(json.dumps({"arm": "sparse", **row}), flush=True)
+    return row, sess, exe, loss
+
+
+def run_dense_control(cfg, quiet=False):
+    """Dense device-resident embedding control: same model, same feeds.
+    This is the arm the HBM budget rules out at real scale — on CPU it
+    is merely slow (every step materializes and sweeps the full dense
+    gradient of each table)."""
+    import paddle_tpu as pt
+
+    loss = _build_model(cfg, sparse=False)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    steps = cfg["warmup_steps"] + cfg["windows"] * cfg["window_steps"]
+    feeds = list(_feed_stream(cfg, steps))
+    windows, k = [], 0
+    for w in range(-1, cfg["windows"]):
+        n = cfg["warmup_steps"] if w < 0 else cfg["window_steps"]
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = exe.run(pt.default_main_program(), feed=feeds[k],
+                          fetch_list=[loss])
+            float(out[0])
+            k += 1
+        if w >= 0:
+            windows.append(cfg["batch"] * n
+                           / (time.perf_counter() - t0))
+    row = {"examples_per_sec": round(float(np.median(windows)), 1),
+           "examples_per_sec_windows": [round(x, 1) for x in windows]}
+    if not quiet:
+        print(json.dumps({"arm": "dense_control", **row}), flush=True)
     return row
 
 
-def main():
-    import jax
+def run_cache_arm(cfg, quiet=False):
+    """Hot-rows cache hit rate under zipfian read-only traffic (the
+    serving path: pull-only, cache-first)."""
+    import paddle_tpu as pt
+    from paddle_tpu.sparse import SparseSession
 
-    # analytic accounting for the expected regimes, printed next to data:
-    # dense Adam sweep traffic/step = 3 reads + 3 writes of every table
-    table_bytes = 4 * sum(v * (EMB_DIM + 1) for v in VOCABS)
-    rows = {"device": str(jax.devices()[0]),
-            "batch": BATCH, "vocabs": VOCABS, "emb_dim": EMB_DIM,
-            "table_bytes": table_bytes,
-            "expected_dense_sweep_ms_at_675GBps":
-                round(6 * table_bytes / 675e9 * 1e3, 2),
-            "variants": []}
-    for name, opt in [
-        ("sgd", pt.optimizer.SGD(learning_rate=0.1)),
-        ("adam_dense", pt.optimizer.Adam(learning_rate=1e-3)),
-        ("adam_lazy", pt.optimizer.Adam(learning_rate=1e-3,
-                                        lazy_mode=True)),
-    ]:
-        rows["variants"].append(bench_variant(name, opt))
-    with open(OUT, "w") as f:
-        json.dump(rows, f, indent=1)
-    print(f"wrote {OUT}")
+    _build_model(cfg, sparse=True)
+    sess = SparseSession(_sparse_tables(cfg),
+                         cache_rows=cfg["cache_rows"])
+    sess.bind(pt.default_main_program())
+    for feed in _feed_stream(cfg, cfg["cache_batches"], seed=7):
+        sess.prepare_feed(feed, is_test=True)
+    cs = sess.cache_stats()
+    row = {"cache_rows": cfg["cache_rows"],
+           "batches": cfg["cache_batches"],
+           "zipf_a": cfg["zipf_a"],
+           "hits": cs["hits"], "misses": cs["misses"],
+           "hit_rate": round(cs["hit_rate"], 4)}
+    if not quiet:
+        print(json.dumps({"arm": "cache", **row}), flush=True)
+    return row
+
+
+def run_doctor_pass(cfg, quiet=False):
+    """One EXTRA observed sparse pass AFTER the timed windows (the
+    instrumentation never touches the A/B): the PR 10 step budget must
+    reconcile measured wall within BUDGET_TOLERANCE, and the sparse
+    pull/push spans ride the same log."""
+    import tempfile
+
+    import paddle_tpu as pt
+    from paddle_tpu import flags
+    from paddle_tpu.observability import attribution
+    from paddle_tpu.sparse import SparseSession
+
+    log = os.path.join(tempfile.gettempdir(),
+                       f"pt_doctor_ctr_{os.getpid()}.jsonl")
+    try:
+        os.remove(log)
+    except OSError:
+        pass
+    loss = _build_model(cfg, sparse=True)
+    sess = SparseSession(_sparse_tables(cfg), observe=True,
+                         bucket_floor=cfg["batch"])
+    sess.bind(pt.default_main_program())
+    exe = pt.Executor(observe=True)
+    exe.run(pt.default_startup_program())
+    feeds = list(_feed_stream(cfg, cfg["window_steps"] + 1, seed=3))
+    # one UNOBSERVED warmup step: the first-trace compile belongs to
+    # startup cost, not to the steady-state budget being doctored
+    float(sess.run(exe, pt.default_main_program(), feeds[0], [loss])[0])
+    prev_obs = flags.get_flag("observe")
+    prev_log = flags.get_flag("metrics_log")
+    flags.set_flag("observe", True)
+    flags.set_flag("metrics_log", log)
+    try:
+        for feed in feeds[1:]:
+            out = sess.run(exe, pt.default_main_program(), feed, [loss])
+            float(out[0])
+    finally:
+        flags.set_flag("observe", prev_obs)
+        flags.set_flag("metrics_log", prev_log or "")
+    report = attribution.doctor_report(
+        [log], program=pt.default_main_program(),
+        assume_batch=cfg["batch"])
+    row = {"doctor": report.get("training")}
+    if not quiet:
+        print(json.dumps({"arm": "doctor", **row}), flush=True)
+    return row
+
+
+def run_all(cfg=None, smoke=False, quiet=False):
+    cfg = cfg or (SMOKE if smoke else FULL)
+    dense_mb = (cfg["vocab_big"] + cfg["vocab_small"]) \
+        * cfg["emb_dim"] * 4 / 2**20
+    sparse_row, sess, exe, loss = run_sparse_arm(cfg, quiet=quiet)
+    dense_row = run_dense_control(cfg, quiet=quiet)
+    cache_row = run_cache_arm(cfg, quiet=quiet)
+    try:
+        doctor_row = run_doctor_pass(cfg, quiet=quiet)
+    except Exception as e:   # A/B rows must survive a doctor failure
+        doctor_row = {"doctor": {"error": f"{type(e).__name__}: {e}"}}
+    speedup = None
+    if dense_row["examples_per_sec"]:
+        speedup = round(sparse_row["examples_per_sec"]
+                        / dense_row["examples_per_sec"], 3)
+    return {
+        "config": {**cfg,
+                   "hbm_embedding_budget_mb": HBM_EMBEDDING_BUDGET_MB,
+                   "dense_tables_mb": round(dense_mb, 1),
+                   "dense_exceeds_budget":
+                       dense_mb > HBM_EMBEDDING_BUDGET_MB},
+        "sparse": sparse_row,
+        "dense_control": dense_row,
+        "sparse_vs_dense_speedup": speedup,
+        "cache": cache_row,
+        **doctor_row,
+        "smoke": bool(smoke),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-fast path check (tiny sizes); does "
+                         "not overwrite the committed results file")
+    ap.add_argument("--out", default=OUT)
+    args = ap.parse_args()
+
+    row = run_all(smoke=args.smoke)
+    print(json.dumps(row, indent=1))
+    if args.smoke:
+        return
+    result = {
+        "benchmark": "ctr_sparse_parameter_server",
+        "device": "cpu (in-container; no TPU reachable)",
+        "cpu": row,
+        "tpu": {
+            "status": "pending-hardware",
+            "plan": "re-run benchmark/ctr.py on a chip host: the "
+                    "sparse arm's device step is the same compiled "
+                    "gather+train step (rows feed [n_unique, dim]); "
+                    "the dense control either OOMs (the budget claim "
+                    "made real) or pays the full-table optimizer "
+                    "sweep the round-4 legacy row below measured",
+            "rows": [],
+        },
+    }
+    legacy_path = os.path.join(os.path.dirname(args.out),
+                               "ctr_results.json")
+    try:
+        with open(legacy_path) as fh:
+            old = json.load(fh)
+        if "variants" in old:    # the pre-rewrite round-4 study
+            result["legacy_r04_dense_optimizer_sweep"] = old
+        elif "legacy_r04_dense_optimizer_sweep" in old:
+            result["legacy_r04_dense_optimizer_sweep"] = \
+                old["legacy_r04_dense_optimizer_sweep"]
+    except (OSError, ValueError):
+        pass
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(f"wrote {args.out}")
 
 
 if __name__ == "__main__":

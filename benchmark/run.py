@@ -207,16 +207,25 @@ def run_autotune(smoke=False):
                 for n in sorted(HOST_TUNABLES)]
 
 
+def run_ctr(smoke=False):
+    """Delegate to benchmark/ctr.py (host-resident sparse parameter
+    server vs dense-embedding control, lookup latency, push throughput,
+    zipfian cache hit rate, doctor budget)."""
+    from benchmark.ctr import run_all
+    return [run_all(smoke=smoke)]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None,
                     help="model config, 'input_pipeline' for the "
                          "naive-vs-pipelined input A/B, 'compile_cache' "
-                         "for the cold-vs-warm startup A/B, or 'autotune' "
-                         "for the tuned-vs-default autotuner A/B")
+                         "for the cold-vs-warm startup A/B, 'autotune' "
+                         "for the tuned-vs-default autotuner A/B, or "
+                         "'ctr' for the sparse-parameter-server CTR A/B")
     ap.add_argument("--smoke", action="store_true",
-                    help="input_pipeline/compile_cache/autotune only: "
-                         "seconds-fast path check")
+                    help="input_pipeline/compile_cache/autotune/ctr "
+                         "only: seconds-fast path check")
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--iters", type=int, default=None,
                     help="steps per timed window (default: 60 for the "
@@ -240,6 +249,9 @@ def main():
         return
     if args.model == "autotune":
         run_autotune(smoke=args.smoke)
+        return
+    if args.model == "ctr":
+        run_ctr(smoke=args.smoke)
         return
     if args.all:
         for name, batch in HEADLINE:

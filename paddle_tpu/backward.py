@@ -38,6 +38,32 @@ def append_backward(loss: Variable,
         params = [p for p in program.all_parameters()
                   if getattr(p, "trainable", True)]
     params = [p for p in params if p.name not in no_grad]
+
+    # Host-resident sparse-table rows (paddle_tpu.sparse): the Rows feed
+    # of every lookup_table_sparse op is a DIFFERENTIABLE FEED — its
+    # scatter-add gradient is what the session pushes back to the host
+    # table — so the default (parameter_list=None) wrt set includes it
+    # even though it is not a Parameter.  An EXPLICIT parameter_list is
+    # the caller's exact wrt contract (calc_gradient zips one grad per
+    # input): sparse rows join only if named, and either way every rows
+    # var in the wrt set is tagged so the optimizer routes its pair
+    # around clip/regularizer/update ops.  Discovery is op-driven so it
+    # survives Program JSON round-trips.
+    sparse_row_names = {n for b in program.blocks for op in b.ops
+                        if op.type == "lookup_table_sparse"
+                        for n in op.input("Rows")}
+    for p in params:
+        if p.name in sparse_row_names:
+            p.is_sparse_rows = True
+    if parameter_list is None:
+        seen = {p.name for p in params}
+        for n in sorted(sparse_row_names):
+            if n in no_grad or n in seen:
+                continue
+            v = block.var(n)
+            v.is_sparse_rows = True
+            params.append(v)
+
     if not params:
         raise ValueError("append_backward: no trainable parameters found")
 

@@ -56,7 +56,8 @@ class Variable:
                  lod_level: int = 0, persistable: bool = False,
                  stop_gradient: bool = False,
                  type: VarType = VarType.LOD_TENSOR, initializer=None,
-                 is_data: bool = False, **kwargs):
+                 is_data: bool = False, session_feed: bool = False,
+                 **kwargs):
         self.block = block
         self.name = name
         self.shape = tuple(int(s) for s in shape) if shape is not None else None
@@ -66,6 +67,10 @@ class Variable:
         self.stop_gradient = stop_gradient
         self.type = type
         self.is_data = is_data
+        # feedable, but injected by a runtime session rim rather than the
+        # user's reader (sparse-table rows/inverse-index feeds): excluded
+        # from auto-built DataFeeder feed lists
+        self.session_feed = session_feed
         self.op = None            # the op that produced this var (last writer)
 
     # -- fluid-compatible sugar -------------------------------------------
@@ -130,6 +135,7 @@ class Variable:
             "stop_gradient": self.stop_gradient,
             "type": self.type.value,
             "is_data": self.is_data,
+            "session_feed": self.session_feed,
             "is_parameter": isinstance(self, Parameter),
             "trainable": getattr(self, "trainable", None),
         }

@@ -16,7 +16,7 @@ implementations are kept as ``*_reference`` for the byte-identity tests
 """
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -27,6 +27,32 @@ def _round_up(n: int, multiple: int) -> int:
     if multiple <= 1:
         return n
     return ((n + multiple - 1) // multiple) * multiple
+
+
+def infer_id_bounds(program) -> Dict[str, int]:
+    """``{ids_var_name: vocab_size}`` for every embedding-lookup site in
+    ``program`` — feed these to ``DataFeeder(id_bounds=...)`` so a bad id
+    fails AT THE FEED RIM with an actionable message instead of deep
+    inside XLA as an opaque gather/scatter failure (or, worse, a silent
+    clamp).  Covers the dense ``lookup_table`` path (vocab from the W
+    parameter's declared shape) and the host-resident
+    ``lookup_table_sparse`` path (vocab from the op's declared attr)."""
+    bounds: Dict[str, int] = {}
+
+    def narrow(name: str, vocab: int):
+        # a var feeding several tables must satisfy the tightest one
+        bounds[name] = min(bounds.get(name, vocab), vocab)
+
+    for b in program.blocks:
+        for op in b.ops:
+            if op.type == "lookup_table":
+                w = b._find_var_recursive(op.input("W")[0]) \
+                    if hasattr(b, "_find_var_recursive") else None
+                if w is not None and w.shape and w.shape[0] > 0:
+                    narrow(op.input("Ids")[0], int(w.shape[0]))
+            elif op.type == "lookup_table_sparse":
+                narrow(op.input("Ids")[0], int(op.attrs["vocab_size"]))
+    return bounds
 
 
 class _StagingCache:
@@ -56,17 +82,65 @@ class _StagingCache:
 
 
 class DataFeeder:
+    """``id_bounds`` (``{var_name: vocab_size}``, see
+    :func:`infer_id_bounds`) turns on per-feed id validation for integer
+    variables: negatives and out-of-vocab ids raise a :class:`ValueError`
+    naming the variable, the offending value and the valid range —
+    instead of surfacing later as an opaque device gather failure.
+    Integer columns always coerce to the variable's DECLARED dtype
+    (int64 is the canonical id dtype); ragged/mixed object columns and
+    float values aimed at an integer variable are rejected with the
+    same actionable form."""
+
     def __init__(self, feed_list: Sequence[Variable], place=None,
                  program=None, seq_bucket_multiple: int = 8,
-                 staging_slots: int = 0):
+                 staging_slots: int = 0,
+                 id_bounds: Optional[Dict[str, int]] = None):
         self.feed_list = list(feed_list)
         self.place = place
         self.seq_bucket_multiple = seq_bucket_multiple
+        self.id_bounds = dict(id_bounds or {})
         # staging_slots > 0 turns on buffer reuse: feed() output arrays are
         # only valid until `staging_slots` further feed() calls (ship or
         # copy them first — np.stack / jax.device_put both do)
         self._staging = _StagingCache(staging_slots) if staging_slots > 0 \
             else None
+
+    def _check_int_feed(self, var: Variable, arr: np.ndarray) -> np.ndarray:
+        """Coerce an integer variable's column to its declared dtype with
+        actionable failures (the id-feed hardening rim)."""
+        dt = np.dtype(var.dtype)
+        if arr.dtype == object:
+            raise ValueError(
+                f"feed {var.name!r}: rows form a ragged/mixed object "
+                f"array — every row must carry the same rectangular "
+                f"shape for a lod_level-0 variable (sequence ids belong "
+                f"in a lod_level>0 variable; canonical id dtype int64)")
+        if arr.dtype.kind == "f":
+            raise ValueError(
+                f"feed {var.name!r}: declared {dt.name} but got float "
+                f"values ({arr.dtype.name}) — truncating floats to ids "
+                f"silently corrupts lookups, convert explicitly")
+        arr = arr.astype(dt, copy=False)
+        self._check_id_range(var, arr)
+        return arr
+
+    def _check_id_range(self, var: Variable, arr: np.ndarray):
+        """id_bounds range rim, shared by the dense and the padded
+        sequence (lod) paths.  Safe on PADDED arrays: pad slots are 0,
+        which is inside every valid vocab range."""
+        bound = self.id_bounds.get(var.name)
+        if bound is None or not arr.size:
+            return
+        lo, hi = int(arr.min()), int(arr.max())
+        if lo < 0 or hi >= bound:
+            bad = lo if lo < 0 else hi
+            raise ValueError(
+                f"feed {var.name!r}: id {bad} outside the embedding "
+                f"table's valid range [0, {bound}) — fix the "
+                f"feature-hashing/vocab map before it reaches the "
+                f"gather (a device lookup would fail opaquely or "
+                f"clamp silently)")
 
     def _out_buffer(self, name: str, shape, dtype,
                     zero: bool = False) -> np.ndarray:
@@ -92,7 +166,19 @@ class DataFeeder:
                                             (len(col),) + col[0].shape, dt)
                     np.stack(col, out=arr)
                 else:
-                    arr = np.asarray(col)
+                    try:
+                        arr = np.asarray(col)
+                    except ValueError as e:
+                        # numpy >= 1.24 raises instead of building an
+                        # object array for ragged rows — keep the
+                        # actionable form either way
+                        raise ValueError(
+                            f"feed {var.name!r}: rows form a ragged/"
+                            f"mixed column ({e}) — every row must carry "
+                            f"the same rectangular shape for a "
+                            f"lod_level-0 variable") from e
+                if dt.kind in "iu":
+                    arr = self._check_int_feed(var, arr)
                 want = var.shape
                 if want is not None and len(want) == arr.ndim + 1 and \
                         want[-1] == 1:
@@ -100,6 +186,8 @@ class DataFeeder:
                 out[var.name] = arr.astype(dt, copy=False)
             elif var.lod_level == 1:
                 arr, lens = self._pad_rows(col, var)
+                if np.dtype(var.dtype).kind in "iu":
+                    self._check_id_range(var, arr)
                 if var.shape is not None and len(var.shape) == arr.ndim + 1 \
                         and var.shape[-1] == 1:
                     arr = arr[..., None]
@@ -107,6 +195,8 @@ class DataFeeder:
                 out[var.name + "@LEN"] = lens
             elif var.lod_level == 2:
                 arr, lens, lens2 = self._pad_nested(col, var)
+                if np.dtype(var.dtype).kind in "iu":
+                    self._check_id_range(var, arr)
                 out[var.name] = arr
                 out[var.name + "@LEN"] = lens
                 out[var.name + "@LEN2"] = lens2

@@ -94,12 +94,23 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
-              padding_idx=None, param_attr=None, dtype="float32", name=None):
+              padding_idx=None, param_attr=None, dtype="float32", name=None,
+              sparse=False):
     """fluid/layers/nn.py:142.  ``is_sparse`` is accepted for parity: the
     scatter-add gradient of gather already gives SelectedRows-style sparse
-    updates under XLA, so no separate path is needed."""
+    updates under XLA, so no separate path is needed.
+
+    ``sparse=True`` declares a **host-resident** table instead
+    (``paddle_tpu.sparse`` — the pserver sparse-row path): no device
+    parameter is created; the op lowers to ``lookup_table_sparse``, whose
+    ``[n_unique, dim]`` rows + inverse-index feeds a
+    :class:`~paddle_tpu.sparse.SparseSession` injects per batch, with
+    the sparse optimizer update applied host-side on push.  The table
+    name is ``name`` (or a generated unique); discover declared tables
+    with ``paddle_tpu.sparse.table_specs(program)``.  ``padding_idx`` is
+    a device-table feature and is rejected with ``sparse=True`` (map the
+    pad id to a dedicated vocab row instead)."""
     helper = LayerHelper("embedding", param_attr=param_attr, name=name)
-    w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
     in_shape = input.shape or (-1, 1)
     if in_shape and in_shape[-1] == 1:
         out_shape = tuple(in_shape[:-1]) + (size[1],)
@@ -107,6 +118,39 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
         out_shape = tuple(in_shape) + (size[1],)
     out = helper.create_variable_for_type_inference(
         dtype, out_shape, lod_level=input.lod_level)
+    if sparse:
+        if padding_idx is not None:
+            raise ValueError(
+                "embedding(sparse=True) does not support padding_idx — "
+                "the host table has no zero-row convention; reserve a "
+                "vocab id for padding instead")
+        table_name = name or unique_name.generate("sparse_table")
+        block = helper.block
+        rows_name = table_name + "@ROWS"
+        if block.has_var(rows_name):
+            raise ValueError(
+                f"embedding(sparse=True): a sparse table named "
+                f"{table_name!r} already exists in this program — one "
+                f"embedding site per table (share its output instead)")
+        rows = block.create_var(
+            name=rows_name, shape=(-1, size[1]), dtype=dtype,
+            is_data=True, session_feed=True)
+        rows.is_sparse_rows = True
+        inv = block.create_var(
+            name=table_name + "@RIDX", shape=out_shape[:-1],
+            dtype="int32", is_data=True, session_feed=True)
+        helper.append_op(type="lookup_table_sparse",
+                         inputs={"Rows": [rows], "Ids": [input],
+                                 "Inverse": [inv]},
+                         outputs={"Out": [out]},
+                         attrs={"table_name": table_name,
+                                "vocab_size": int(size[0]),
+                                "dim": int(size[1]),
+                                "dtype": str(dtype)})
+        if input.lod_level:
+            _copy_len(helper, input, out)
+        return out
+    w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
     helper.append_op(type="lookup_table",
                      inputs={"W": [w], "Ids": [input]},
                      outputs={"Out": [out]},

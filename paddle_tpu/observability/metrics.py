@@ -198,6 +198,29 @@ METRIC_NAMES = (
      "ops measured by the per-op profiler (one per op per run)"),
     ("opprof/op_ms", "histogram",
      "measured per-op eager wall time (median of timed windows)"),
+    # sparse parameter server (paddle_tpu.sparse): the session resolves
+    # an observe switch ONCE at construction (obs.enabled() unless
+    # overridden) and only writes when observing — training paths that
+    # never build a session never reach these helpers (the package is
+    # lazy-import gated like serving/tuning/elastic)
+    ("sparse/pulls", "counter",
+     "sparse-table pulls executed (one per bound table per batch)"),
+    ("sparse/pulled_rows", "counter",
+     "unique live rows pulled from host sparse tables"),
+    ("sparse/pushes", "counter",
+     "sparse-table gradient pushes applied (one per table per batch)"),
+    ("sparse/pushed_rows", "counter",
+     "rows updated by host-side sparse optimizer pushes"),
+    ("sparse/pull_ms", "histogram",
+     "host wall time of one table pull (dedup'd batch rows, cache-first)"),
+    ("sparse/push_ms", "histogram",
+     "host wall time of one gradient push (sparse optimizer update)"),
+    ("sparse/cache_hits", "counter",
+     "hot-rows cache hits on the pull path"),
+    ("sparse/cache_misses", "counter",
+     "hot-rows cache misses on the pull path (row fetched from shard)"),
+    ("sparse/live_rows", "gauge",
+     "lazily-materialized rows resident per table (labels: table name)"),
 )
 
 _MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
@@ -221,6 +244,8 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
     "http/request_ms": _MS_BUCKETS,
     "opprof/op_ms": _MS_BUCKETS,
     "elastic/resize_ms": _MS_BUCKETS,
+    "sparse/pull_ms": _MS_BUCKETS,
+    "sparse/push_ms": _MS_BUCKETS,
 }
 _DEFAULT_BUCKETS = _MS_BUCKETS
 

@@ -90,6 +90,12 @@ SPAN_NAMES = (
     ("elastic/resize", "one committed mesh resize boundary of the "
      "elastic training service: drain -> merge replicas -> re-plan -> "
      "re-shard -> relaunch; phase completions attach as span events"),
+    ("sparse/pull", "one batch's pre-dispatch sparse-table pulls "
+     "(id dedup + cache-first row fetch + feed injection across all "
+     "bound tables); labels: tables"),
+    ("sparse/push", "one batch's post-dispatch gradient pushes (host-"
+     "side sparse optimizer update across all bound tables, inside the "
+     "sparse.push fault-injection/retry rim); labels: tables"),
 )
 
 _REGISTERED = tuple(n for n, _ in SPAN_NAMES)

@@ -36,6 +36,24 @@ def _lookup_table(ctx, ins, attrs):
     return {"Out": out}
 
 
+@register_op("lookup_table_sparse")
+def _lookup_table_sparse(ctx, ins, attrs):
+    """Host-resident sparse-table gather (paddle_tpu.sparse): the table
+    lives on the HOST, the ``SparseSession`` rim feeds the dense
+    ``[n_unique, dim]`` rows a batch touches plus the inverse index
+    mapping each id position to its unique slot — the device op is just
+    the dense gather.  ``Rows``'s gradient (the scatter-add VJP of this
+    take) is fetched as ``<rows>@GRAD`` and pushed back host-side, which
+    is the reference's SparseRemoteParameterUpdater pull/push cycle
+    (RemoteParameterUpdater.h:265, math/SparseRowMatrix.h:206).
+
+    ``Ids`` rides along unconsumed (the session derives Inverse from it
+    host-side); keeping it an input preserves the graph's data
+    dependency for pruning/validation."""
+    rows, inv = ins["Rows"][0], ins["Inverse"][0]
+    return {"Out": jnp.take(rows, inv.astype(jnp.int32), axis=0)}
+
+
 @register_op("nce")
 def _nce(ctx, ins, attrs):
     """nce_op: noise-contrastive estimation with uniform negative sampling.
@@ -125,6 +143,19 @@ def _lookup_table_shape(op, ins, attrs):
     return {"Out": VarInfo(s + (w.shape[-1],), w.dtype)}
 
 
+@register_shape_fn("lookup_table_sparse")
+def _lookup_table_sparse_shape(op, ins, attrs):
+    rows, inv = first(ins, "Rows"), first(ins, "Inverse")
+    if inv.dtype is not None and inv.dtype.kind == "f":
+        raise ShapeError(
+            f"lookup_table_sparse: Inverse must be integral, got "
+            f"{inv.dtype.name}")
+    dim = int(attrs.get("dim", rows.shape[-1] if rows.shape else -1))
+    if inv.shape is None:
+        return {"Out": VarInfo(None, rows.dtype)}
+    return {"Out": VarInfo(tuple(inv.shape) + (dim,), rows.dtype)}
+
+
 @register_shape_fn("nce")
 def _nce_shape(op, ins, attrs):
     x, w = first(ins, "Input"), first(ins, "Weight")
@@ -171,6 +202,19 @@ def _lookup_table_shard(op, ins, attrs):
         return {}
     lead = squeeze_spec_ids(ids)
     return {"Out": lead + (w.entry(-1),)}
+
+
+@register_shard_fn("lookup_table_sparse")
+def _lookup_table_sparse_shard(op, ins, attrs):
+    # The table is HOST-side; the planner sees only the dense gathered
+    # rows as a device tensor.  Out follows the inverse index's (batch)
+    # sharding with the emb dim riding the rows feed's column split
+    # (normally replicated — the rows feed is host-built per batch).
+    rows, inv = first_in(ins, "Rows"), first_in(ins, "Inverse")
+    if rows.spec is None and inv.spec is None:
+        return {}
+    lead = tuple(inv.spec) if inv.spec is not None else (None,)
+    return {"Out": lead + (rows.entry(-1),)}
 
 
 register_shard_fn("nce", "hierarchical_sigmoid", "hsigmoid")(

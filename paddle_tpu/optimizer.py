@@ -76,10 +76,19 @@ class Optimizer:
         # land in the LOSS's program even if a different default is active
         with program_guard(program, startup_program):
             params_grads = append_backward(loss, parameter_list, no_grad_set)
-            params_grads = append_gradient_clip_ops(params_grads)
-            params_grads = append_regularization_ops(
-                params_grads, self.regularization)
-            optimize_ops = self.apply_gradients(params_grads, program)
+            # host-resident sparse-table rows (paddle_tpu.sparse) get
+            # their grads from append_backward but NO device optimizer
+            # op, clip graph, or regularizer: the SparseSession applies
+            # the per-row sparse update host-side on push
+            host_pairs = [(p, g) for p, g in params_grads
+                          if getattr(p, "is_sparse_rows", False)]
+            dev_pairs = [(p, g) for p, g in params_grads
+                         if not getattr(p, "is_sparse_rows", False)]
+            dev_pairs = append_gradient_clip_ops(dev_pairs)
+            dev_pairs = append_regularization_ops(
+                dev_pairs, self.regularization)
+            optimize_ops = self.apply_gradients(dev_pairs, program)
+            params_grads = dev_pairs + host_pairs
         return optimize_ops, params_grads
 
     def apply_gradients(self, params_grads, program=None):

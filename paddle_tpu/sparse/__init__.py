@@ -1,0 +1,27 @@
+"""Sparse parameter server: host-resident giant-embedding tables.
+
+The reference's pserver sparse-row path (``SparseRowCpuMatrix`` +
+``SparseRemoteParameterUpdater``: pull only the rows a batch touches,
+push only their gradients, apply the sparse optimizer update server-side)
+reproduced for the one-big-jit executor:
+
+* :mod:`.table` — :class:`SparseTable`: vocab-sharded host row store
+  (numpy or mmap shards) with lazy per-row init and per-row SGD/Adagrad
+  slot state; spec-agnostic sharded checkpoint export.
+* :mod:`.session` — :class:`SparseSession`: the executor rim (per-batch
+  dedup → cache-first pull → feed injection → ``<rows>@GRAD`` fetch →
+  push), hot-rows cache, read-only inference mode, serving attachment.
+
+Declare a host-side table with ``layers.embedding(..., sparse=True)``;
+the trainer wires the rim through ``train(sparse_tables=session)``.
+
+This package is **lazy-import gated** like serving/tuning/elastic:
+``import paddle_tpu`` (and every training path that never opts in) never
+loads it — tests/test_repo_lint.py enforces the static half.
+"""
+from .session import (HotRowCache, SparseBinding, SparseSession,
+                      table_specs, tables_for_program)
+from .table import PAD_ID, SparseTable
+
+__all__ = ["SparseTable", "SparseSession", "SparseBinding", "HotRowCache",
+           "PAD_ID", "table_specs", "tables_for_program"]

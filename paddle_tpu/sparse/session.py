@@ -1,0 +1,514 @@
+"""SparseSession: the executor rim of the host-resident parameter server.
+
+The reference's ``SparseRemoteParameterUpdater`` sat between the trainer
+loop and the pservers: before each batch it **prefetched** the rows the
+batch touches, after the backward it pushed only those rows' gradients
+(RemoteParameterUpdater.h:265).  :class:`SparseSession` is that rim for
+the one-big-jit executor:
+
+* **pre-dispatch** — per-batch id dedup (``np.unique`` + inverse index,
+  padded up to a power-of-two bucket so compiled signatures stay
+  stable), a cache-first pull from each bound
+  :class:`~paddle_tpu.sparse.table.SparseTable`, and injection of the
+  dense ``[n_unique, dim]`` rows + inverse-index feeds the
+  ``lookup_table_sparse`` lowering gathers from;
+* **post-dispatch** — extraction of the ``<rows>@GRAD`` fetches and a
+  ``push`` applying the sparse optimizer update host-side (inside a
+  retry rim with the ``sparse.push`` fault-injection site: a dropped
+  push is retried-or-fatal, never silent);
+* a bounded **hot-rows cache** (LRU, invalidated on push) with hit/miss
+  accounting — the serving path pulls cache-first at request time;
+* a read-only **inference mode** (``is_test=True``): pulls only, no
+  grad fetches, no pushes.
+
+Ordering: :meth:`prepare_feed` enqueues each training batch's unique-id
+set FIFO; :meth:`complete` pops it.  The per-batch trainer path is fully
+synchronous (pull → step → push), which is what makes small-vocab
+sparse-vs-dense parity BIT-identical.  The chunked/pipelined paths pull
+up to ``steps_per_dispatch × prefetch_depth`` batches ahead of the
+pushes — bounded-staleness asynchronous updates, the reference's async
+pserver SGD semantics (documented, and pinned exact when a chunk's
+batches touch disjoint ids).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import weakref
+from contextlib import nullcontext as _nullcontext
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import faults as _faults
+from .. import observability as obs
+from ..observability.tracing import span
+from ..testing import faultinject as _fi
+from .table import PAD_ID, SparseTable
+
+__all__ = ["SparseBinding", "SparseSession", "HotRowCache",
+           "table_specs", "tables_for_program"]
+
+SPARSE_OP = "lookup_table_sparse"
+ROWS_SUFFIX = "@ROWS"
+RIDX_SUFFIX = "@RIDX"
+
+
+def table_specs(program) -> List[dict]:
+    """Declared sparse-table specs of a program: one dict per
+    ``lookup_table_sparse`` site — ``{name, vocab_size, dim, dtype}`` —
+    the discovery surface benchmarks and services build tables from."""
+    specs, seen = [], set()
+    for b in program.blocks:
+        for op in b.ops:
+            if op.type != SPARSE_OP:
+                continue
+            name = op.attrs["table_name"]
+            if name in seen:
+                continue
+            seen.add(name)
+            specs.append({"name": name,
+                          "vocab_size": int(op.attrs["vocab_size"]),
+                          "dim": int(op.attrs["dim"]),
+                          "dtype": op.attrs.get("dtype", "float32")})
+    return specs
+
+
+def tables_for_program(program, **table_kwargs) -> Dict[str, SparseTable]:
+    """Build one :class:`SparseTable` per declared spec (shared
+    ``table_kwargs``: optimizer, learning_rate, num_shards, ...)."""
+    return {s["name"]: SparseTable(
+        s["name"], s["vocab_size"], s["dim"], dtype=s["dtype"],
+        **table_kwargs) for s in table_specs(program)}
+
+
+class HotRowCache:
+    """Bounded LRU of (table, id) -> row, with hit/miss accounting.
+    Rows are stored as private copies; a push invalidates its ids so a
+    cached read can never serve a pre-update row."""
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._d: "collections.OrderedDict" = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        row = self._d.get(key)
+        if row is None:
+            self.misses += 1
+            return None
+        self._d.move_to_end(key)
+        self.hits += 1
+        return row
+
+    def put(self, key, row: np.ndarray):
+        if self.capacity <= 0:
+            return
+        self._d[key] = row
+        self._d.move_to_end(key)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+
+    def invalidate(self, keys):
+        for k in keys:
+            self._d.pop(k, None)
+
+    def __len__(self):
+        return len(self._d)
+
+
+class SparseBinding:
+    """One ``lookup_table_sparse`` site resolved against its table."""
+
+    __slots__ = ("table", "ids_name", "rows_name", "inv_name",
+                 "grad_name", "vocab_size", "dim")
+
+    def __init__(self, table: SparseTable, ids_name: str, rows_name: str,
+                 inv_name: str, vocab_size: int, dim: int):
+        self.table = table
+        self.ids_name = ids_name
+        self.rows_name = rows_name
+        self.inv_name = inv_name
+        self.grad_name = rows_name + "@GRAD"
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+
+
+def _next_pow2(n: int, floor: int = 8) -> int:
+    b = max(int(floor), 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+class SparseSession:
+    """Binds host tables to a program's ``lookup_table_sparse`` sites and
+    runs the pull/push rim around executor dispatches.
+
+    ``tables``: a :class:`SparseTable`, a sequence of them, or a
+    ``{name: table}`` dict — every sparse site in the bound program must
+    resolve to one.  ``cache_rows`` bounds the hot-rows cache (0 = off).
+    ``retry_policy`` (a :class:`paddle_tpu.faults.RetryPolicy`) makes a
+    transient push failure retry with backoff; without one it raises —
+    either way a dropped push is never silent.  ``bucket`` pads each
+    batch's unique-id count up to a power of two so chunked/pipelined
+    dispatch signatures stay stable (PAD slots pull zero rows and push
+    nothing).
+    """
+
+    def __init__(self, tables, *, cache_rows: int = 0,
+                 retry_policy=None, bucket: bool = True,
+                 bucket_floor: int = 8,
+                 observe: Optional[bool] = None):
+        if isinstance(tables, SparseTable):
+            tables = [tables]
+        if isinstance(tables, dict):
+            self.tables: Dict[str, SparseTable] = dict(tables)
+        else:
+            self.tables = {t.name: t for t in tables}
+        for name, t in self.tables.items():
+            if name != t.name:
+                raise ValueError(
+                    f"SparseSession: table dict key {name!r} != "
+                    f"table.name {t.name!r}")
+        self.retry_policy = retry_policy
+        self.bucket = bool(bucket)
+        self.bucket_floor = int(bucket_floor)
+        self.cache = HotRowCache(cache_rows)
+        self._observe = obs.enabled() if observe is None else bool(observe)
+        self._bindings: List[SparseBinding] = []
+        # bound-program memo: a WEAKREF, not id() — a dead program's
+        # reused allocation must never short-circuit a rebind
+        self._bound_ref = None
+        self._bound_version = None
+        self._push_gen = 0          # bumped per push; fences cache fills
+        self._lock = threading.Lock()
+        self._pending: "collections.deque" = collections.deque()
+        # lifetime counters (always maintained; mirrored into the
+        # observability registry only when observing)
+        self.stats = {"pulls": 0, "pulled_rows": 0, "pushes": 0,
+                      "pushed_rows": 0, "pull_ms": 0.0, "push_ms": 0.0,
+                      "batches": 0}
+
+    # -- binding ------------------------------------------------------------
+    def bind(self, program) -> "SparseSession":
+        """Discover the program's sparse sites and resolve each against
+        its table (idempotent per live program + version)."""
+        if self._bound_ref is not None \
+                and self._bound_ref() is program \
+                and self._bound_version == program.version:
+            return self
+        bindings = []
+        for b in program.blocks:
+            for op in b.ops:
+                if op.type != SPARSE_OP:
+                    continue
+                name = op.attrs["table_name"]
+                table = self.tables.get(name)
+                if table is None:
+                    raise KeyError(
+                        f"program declares sparse table {name!r} but the "
+                        f"session only has {sorted(self.tables)} — build "
+                        f"one (sparse.tables_for_program) and pass it in")
+                vocab = int(op.attrs["vocab_size"])
+                dim = int(op.attrs["dim"])
+                if (table.vocab_size, table.dim) != (vocab, dim):
+                    raise ValueError(
+                        f"sparse table {name!r}: program declares "
+                        f"vocab={vocab} dim={dim} but the table carries "
+                        f"vocab={table.vocab_size} dim={table.dim}")
+                bindings.append(SparseBinding(
+                    table, op.input("Ids")[0], op.input("Rows")[0],
+                    op.input("Inverse")[0], vocab, dim))
+        if not bindings:
+            raise ValueError(
+                "SparseSession.bind: program has no lookup_table_sparse "
+                "ops — build embeddings with layers.embedding(..., "
+                "sparse=True)")
+        self._bindings = bindings
+        self._bound_ref = weakref.ref(program)
+        self._bound_version = program.version
+        return self
+
+    @property
+    def bindings(self) -> List[SparseBinding]:
+        return list(self._bindings)
+
+    @property
+    def grad_fetch_list(self) -> List[str]:
+        """``<rows>@GRAD`` fetch names, in binding order — append these
+        to the training fetch list and hand the fetched arrays back to
+        :meth:`complete`."""
+        return [b.grad_name for b in self._bindings]
+
+    # -- id plumbing --------------------------------------------------------
+    def _coerce_ids(self, b: SparseBinding, raw) -> np.ndarray:
+        ids = np.asarray(raw)
+        if ids.dtype == object:
+            raise ValueError(
+                f"sparse feed {b.ids_name!r} (table {b.table.name!r}): "
+                f"ids arrived as a ragged/mixed object array — feed a "
+                f"rectangular int32/int64 array (canonical dtype int64)")
+        if ids.dtype.kind not in "iu":
+            raise ValueError(
+                f"sparse feed {b.ids_name!r} (table {b.table.name!r}): "
+                f"ids must be integral (canonical dtype int64), got "
+                f"{ids.dtype.name}")
+        ids = ids.astype(np.int64, copy=False)
+        if ids.ndim >= 2 and ids.shape[-1] == 1:
+            ids = ids[..., 0]          # the [..., 1] id convention
+        if ids.size:
+            lo, hi = int(ids.min()), int(ids.max())
+            if lo < 0 or hi >= b.vocab_size:
+                bad = lo if lo < 0 else hi
+                raise ValueError(
+                    f"sparse feed {b.ids_name!r} (table "
+                    f"{b.table.name!r}): id {bad} outside the declared "
+                    f"vocab [0, {b.vocab_size}) — fix the feature "
+                    f"hashing/vocab map before it reaches the gather")
+        return ids
+
+    def _pull_rows(self, b: SparseBinding, uid: np.ndarray) -> np.ndarray:
+        """Cache-first pull of the (bucketed) unique ids."""
+        table, cache = b.table, self.cache
+        t0 = time.perf_counter()
+        hits0, misses0 = cache.hits, cache.misses
+        if cache.capacity > 0:
+            out = np.zeros((len(uid), table.dim), table.dtype)
+            missing_pos: List[int] = []
+            with self._lock:
+                for j, i in enumerate(uid.tolist()):
+                    if i == PAD_ID:
+                        continue
+                    row = cache.get((table.name, i))
+                    if row is None:
+                        missing_pos.append(j)
+                    else:
+                        out[j] = row
+            if missing_pos:
+                # the table pull runs OUTSIDE the session lock (it can
+                # be slow); a push may land between it and the cache
+                # insert below.  _push_gen (bumped under the lock by
+                # every push) fences the insert: rows pulled before a
+                # concurrent push are NOT cached — caching them after
+                # the push's invalidate would pin a pre-update row,
+                # breaking the cache's never-stale invariant.
+                with self._lock:
+                    gen0 = self._push_gen
+                miss_ids = uid[missing_pos]
+                rows = table.pull(miss_ids)
+                out[missing_pos] = rows
+                with self._lock:
+                    if self._push_gen == gen0:
+                        for j, i in zip(range(len(miss_ids)),
+                                        miss_ids.tolist()):
+                            cache.put((table.name, i), rows[j].copy())
+        else:
+            out = table.pull(uid)
+        live = int((uid != PAD_ID).sum())
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self.stats["pulls"] += 1
+        self.stats["pulled_rows"] += live
+        self.stats["pull_ms"] += dt_ms
+        if self._observe:
+            obs.inc_counter("sparse/pulls")
+            obs.inc_counter("sparse/pulled_rows", live)
+            obs.observe_hist("sparse/pull_ms", dt_ms)
+            obs.set_gauge("sparse/live_rows", table.live_rows,
+                          label=table.name)
+            if cache.capacity > 0:
+                dh = cache.hits - hits0
+                dm = cache.misses - misses0
+                if dh:
+                    obs.inc_counter("sparse/cache_hits", dh)
+                if dm:
+                    obs.inc_counter("sparse/cache_misses", dm)
+        return out
+
+    # -- the rim ------------------------------------------------------------
+    def prepare_feed(self, feed: Dict[str, object],
+                     is_test: bool = False) -> Dict[str, object]:
+        """Dedup + pull + inject for one batch.  Returns a NEW feed dict
+        carrying the original entries plus each binding's rows and
+        inverse-index feeds.  Training batches (``is_test=False``)
+        enqueue their unique-id sets for the matching :meth:`complete`.
+        """
+        if not self._bindings:
+            raise RuntimeError("SparseSession: call bind(program) first")
+        out = dict(feed)
+        pend = []
+        with (span("sparse/pull", tables=len(self._bindings))
+              if self._observe else _nullcontext()):
+            for b in self._bindings:
+                if b.ids_name not in feed:
+                    raise KeyError(
+                        f"sparse feed {b.ids_name!r} (table "
+                        f"{b.table.name!r}) missing from the batch feed "
+                        f"(have: {sorted(feed)})")
+                ids = self._coerce_ids(b, feed[b.ids_name])
+                uniq, inv = np.unique(ids.reshape(-1),
+                                      return_inverse=True)
+                n = max(len(uniq), 1)
+                cap = _next_pow2(n, self.bucket_floor) if self.bucket \
+                    else n
+                uid = np.full(cap, PAD_ID, np.int64)
+                uid[:len(uniq)] = uniq
+                out[b.rows_name] = self._pull_rows(b, uid)
+                out[b.inv_name] = inv.reshape(ids.shape).astype(np.int32)
+                if not is_test:
+                    pend.append((b, uid))
+        if pend:
+            with self._lock:
+                self._pending.append(pend)
+        self.stats["batches"] += 1
+        return out
+
+    def complete(self, grad_arrays: Sequence) -> int:
+        """Push one batch's gradient rows (the fetched ``<rows>@GRAD``
+        arrays, in :attr:`grad_fetch_list` order) back into the tables.
+        Returns rows updated."""
+        with self._lock:
+            if not self._pending:
+                raise RuntimeError(
+                    "SparseSession.complete: no pending batch — "
+                    "prepare_feed/complete must alternate FIFO")
+            pend = self._pending.popleft()
+        if len(grad_arrays) != len(pend):
+            raise ValueError(
+                f"SparseSession.complete: got {len(grad_arrays)} grad "
+                f"arrays for {len(pend)} bound tables")
+        updated = 0
+        with (span("sparse/push", tables=len(pend))
+              if self._observe else _nullcontext()):
+            for (b, uid), g in zip(pend, grad_arrays):
+                updated += self._push(b, uid, np.asarray(g, b.table.dtype))
+        return updated
+
+    @property
+    def pending_batches(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def _push(self, b: SparseBinding, uid: np.ndarray,
+              grads: np.ndarray) -> int:
+        t0 = time.perf_counter()
+
+        def attempt():
+            if _fi.ENABLED:
+                action = _fi.check("sparse.push")
+                if action is not None:
+                    _fi.raise_for(action, "sparse.push")
+            return b.table.push(uid, grads)
+
+        def on_retry(i, e, d):
+            obs.inc_counter("fault/retries")
+            obs.emit_event("fault", event="retry", site="sparse.push",
+                           attempt=i + 1, delay_s=round(d, 4),
+                           error=f"{type(e).__name__}: {e}")
+
+        if self.retry_policy is not None:
+            n = _faults.retry_call(
+                attempt, self.retry_policy,
+                what=f"sparse push {b.table.name}", on_retry=on_retry)
+        else:
+            # no policy: a failed push raises — the grads for these rows
+            # exist nowhere else, so losing them silently would corrupt
+            # the table's training trajectory undetectably
+            n = attempt()
+        if self.cache.capacity > 0:
+            with self._lock:
+                self._push_gen += 1      # fence in-flight cache fills
+                self.cache.invalidate(
+                    (b.table.name, i) for i in uid.tolist()
+                    if i != PAD_ID)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self.stats["pushes"] += 1
+        self.stats["pushed_rows"] += n
+        self.stats["push_ms"] += dt_ms
+        if self._observe:
+            obs.inc_counter("sparse/pushes")
+            obs.inc_counter("sparse/pushed_rows", n)
+            obs.observe_hist("sparse/push_ms", dt_ms)
+        return n
+
+    # -- convenience --------------------------------------------------------
+    def run(self, exe, program, feed: Dict[str, object],
+            fetch_list: Sequence, scope=None, is_test: bool = False,
+            return_numpy: bool = True) -> List:
+        """One pull → dispatch → push round through ``exe.run`` — the
+        standalone form of the trainer wiring (benchmarks, scripts)."""
+        self.bind(program)
+        feed = self.prepare_feed(feed, is_test=is_test)
+        names = [getattr(v, "name", v) for v in fetch_list]
+        if is_test:
+            return exe.run(program, feed=feed, fetch_list=names,
+                           scope=scope, return_numpy=return_numpy,
+                           is_test=True)
+        out = exe.run(program, feed=feed,
+                      fetch_list=names + self.grad_fetch_list,
+                      scope=scope, return_numpy=return_numpy)
+        self.complete(out[len(names):])
+        return out[:len(names)]
+
+    # -- cache accounting ---------------------------------------------------
+    def cache_stats(self) -> dict:
+        c = self.cache
+        total = c.hits + c.misses
+        return {"capacity": c.capacity, "entries": len(c),
+                "hits": c.hits, "misses": c.misses,
+                "hit_rate": (c.hits / total) if total else None}
+
+    # -- checkpoint rider ---------------------------------------------------
+    def export_state_vars(self) -> Dict[str, np.ndarray]:
+        """All bound tables' state as synthetic scope vars — the callable
+        the trainer hands to ``Checkpointer(state_vars=...)``."""
+        out: Dict[str, np.ndarray] = {}
+        for t in self.tables.values():
+            out.update(t.export_state_vars())
+        return out
+
+    def restore_from_scope(self, scope) -> bool:
+        """Pop ``__sparse__/...`` vars a Checkpointer restore left in
+        ``scope`` and load them into the bound tables.  Returns False
+        when the scope carries no sparse state (fresh start)."""
+        keys = [k for k in list(scope.keys())
+                if k.startswith("__sparse__/")]
+        if not keys:
+            return False
+        state = {k: scope.get(k) for k in keys}
+        for t in self.tables.values():
+            t.restore_state_vars(state)
+        for k in keys:
+            scope.delete(k)
+        return True
+
+    # -- serving ------------------------------------------------------------
+    def serving_model(self, model, name: Optional[str] = None):
+        """Wrap a :class:`paddle_tpu.serving.Model` so each request batch
+        pulls its rows (cache-first) at request time — the train→serve
+        CTR wiring.  The wrapped model's visible inputs are the ids/dense
+        features only; the rows/inverse feeds are injected inside."""
+        from ..serving.model import Model  # lazy: serving stays unloaded
+
+        if not self._bindings:
+            raise RuntimeError(
+                "SparseSession.serving_model: call bind(program) first")
+        injected = {n for b in self._bindings
+                    for n in (b.rows_name, b.inv_name)}
+        inner = model
+
+        def fn(feeds):
+            prepared = self.prepare_feed(dict(feeds), is_test=True)
+            return inner(prepared)
+
+        specs = {k: v for k, v in inner.input_specs.items()
+                 if k not in injected} or None
+        example = None
+        if inner.example:
+            example = {k: v for k, v in inner.example.items()
+                       if k not in injected} or None
+        return Model(name or f"{inner.name}-sparse", fn,
+                     input_specs=specs, output_names=inner.output_names,
+                     example=example)

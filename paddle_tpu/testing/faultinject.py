@@ -77,6 +77,15 @@ Registered sites:
                           semantics) — enough consecutive drops and the
                           coordinator sees lease staleness, which is the
                           membership-change trigger being tested
+``sparse.push``           per gradient push into a host sparse table
+                          (``sparse.SparseSession``; hit-count indexed;
+                          fires BEFORE the update applies, inside the
+                          session's retry rim).  ``drop`` loses the push
+                          on the wire-analog: with a retry policy it is
+                          retried (exactly-once — nothing mutated before
+                          the site), without one it raises — a dropped
+                          push is never silent (the grads exist nowhere
+                          else)
 ========================  ==================================================
 
 Every firing increments the ``fault/injected`` counter and emits a
@@ -99,7 +108,7 @@ __all__ = [
 KNOWN_SITES = ("trainer.step", "reader.item", "executor.dispatch",
                "master.call", "ckpt.write", "serving.request",
                "serving.dispatch", "tuning.trial", "elastic.worker",
-               "master.heartbeat")
+               "master.heartbeat", "sparse.push")
 
 # THE zero-overhead gate: call sites guard every hook with
 # ``if faultinject.ENABLED:`` — one attribute load when off.

@@ -116,7 +116,8 @@ class Checkpointer:
     def __init__(self, checkpoint_dir: str, exe,
                  save_every_n_steps: Optional[int] = None,
                  master=None, max_to_keep: int = 3,
-                 handle_signals: bool = True, extra_state=None):
+                 handle_signals: bool = True, extra_state=None,
+                 state_vars=None):
         if save_every_n_steps is not None and save_every_n_steps < 1:
             raise ValueError(f"save_every_n_steps must be >= 1, got "
                              f"{save_every_n_steps}")
@@ -132,6 +133,13 @@ class Checkpointer:
         # (cursor/offset), read back on resume.  Called AT the boundary,
         # so it sees the exact committed position.
         self._extra_state = extra_state
+        # state_vars(): {name: np.ndarray} of ARRAY-valued rider state
+        # captured at every save and committed as synthetic scope vars
+        # (the TRAIN_STATE_VAR pattern, for state too big for JSON) —
+        # the sparse parameter server's table rows ride here.  The
+        # callable must return fresh copies: the async writer may still
+        # be serializing them after this method returns.
+        self._state_vars = state_vars
         self._old_handlers: dict = {}
         self._preempt_sig: Optional[int] = None
         self._save_requested = False
@@ -364,10 +372,17 @@ class Checkpointer:
             else None)
         scope = self._scope
         scope.set(TRAIN_STATE_VAR, ts.to_array())
+        rider_keys = []
+        if self._state_vars is not None:
+            for k, v in self._state_vars().items():
+                scope.set(k, v)
+                rider_keys.append(k)
         try:
             self.manager.save(self.emitted, scope, blocking=blocking)
         finally:
             scope.delete(TRAIN_STATE_VAR)
+            for k in rider_keys:
+                scope.delete(k)
         self.last_saved = self.emitted
         inc_counter("fault/checkpoint_saves")
         emit_event("fault", event="checkpoint_save", step=self.emitted,

@@ -79,7 +79,8 @@ class SGD:
               auto_shard=None,
               checkpoint_dir: Optional[str] = None, resume: bool = False,
               save_every_n_steps: Optional[int] = None, master=None,
-              handle_signals: bool = True, elastic=None):
+              handle_signals: bool = True, elastic=None,
+              sparse_tables=None):
         """reader yields batches (lists of rows); feeding maps data-layer
         names to row positions (v2 trainer.py feeding) or pass feed_list.
 
@@ -185,6 +186,23 @@ class SGD:
         ``checkpoint_dir`` and the per-batch dispatch path
         (``steps_per_dispatch == 1``, no ``pipeline``) — the elastic
         commit protocol needs every batch to be a dispatch boundary.
+
+        ``sparse_tables``: a duck-typed host sparse-table session
+        (normally a :class:`paddle_tpu.sparse.SparseSession` — the
+        trainer itself never imports the sparse package, so the
+        zero-cost-when-unused contract holds statically).  Per batch the
+        loop calls ``prepare_feed`` (id dedup → host pull → rows/inverse
+        feed injection) before the dispatch and ``complete`` with the
+        fetched ``<rows>@GRAD`` arrays after it (the host-side sparse
+        optimizer push).  The per-batch path is fully synchronous —
+        pull → step → push, the semantics the dense-parity test pins
+        bit-identical; the chunked (``steps_per_dispatch > 1``) and
+        ``pipeline`` paths pull up to a dispatch-chunk (plus prefetch
+        depth) ahead of the pushes — bounded-staleness ASYNC updates,
+        the reference's async-pserver SGD semantics.  With
+        ``checkpoint_dir`` the session's tables ride inside every
+        checkpoint (``Checkpointer(state_vars=...)``) and restore on
+        ``resume``.  Not combinable with ``elastic`` or ``warmup``.
         """
         event_handler = event_handler or (lambda e: None)
         if not checkpoint_dir:
@@ -209,6 +227,19 @@ class SGD:
                 "train(elastic=...) needs the per-batch dispatch path "
                 "(steps_per_dispatch=1, pipeline=False): the elastic "
                 "task-commit protocol saves at every batch boundary")
+        sess = sparse_tables
+        if sess is not None:
+            if elastic is not None:
+                raise ValueError(
+                    "train(sparse_tables=...) cannot combine with "
+                    "elastic=... yet (the resize merge has no sparse-"
+                    "row story; see ROADMAP)")
+            if warmup:
+                raise ValueError(
+                    "train(sparse_tables=..., warmup=True) is not "
+                    "supported: warmup compiles from a raw peeked batch "
+                    "without the session's injected rows feeds")
+            sess.bind(self.main_program)
         if auto_shard:
             self._enable_auto_shard(auto_shard)
         # validate is a PER-CALL override: restore the executor's own
@@ -241,13 +272,27 @@ class SGD:
                                     handle_signals=handle_signals,
                                     extra_state=(elastic.state
                                                  if elastic is not None
-                                                 else None))
+                                                 else None),
+                                    state_vars=(sess.export_state_vars
+                                                if sess is not None
+                                                else None))
                 ts = None
                 if resume:
                     ts = ckpt.restore(
                         global_scope(),
                         expect_seed=self.main_program.random_seed,
                         expect_optimizer=opt_fp)
+                if ts is not None and sess is not None:
+                    # table rows/slots rode the checkpoint as synthetic
+                    # __sparse__/ scope vars; pop them into the session's
+                    # tables so the host state resumes atomically with
+                    # the model
+                    if not sess.restore_from_scope(global_scope()):
+                        raise ValueError(
+                            "train(resume=True, sparse_tables=...): the "
+                            "restored checkpoint carries no sparse-table "
+                            "state — it was written by a run without "
+                            "sparse_tables")
                 if ts is not None:
                     # the step counter IS the per-step RNG derivation
                     # state: restoring it restores every random op's
@@ -278,6 +323,19 @@ class SGD:
                     start_pass, resume_skip = 0, 0
 
             fetch = [self.cost] + self.extra
+            n_fetch = len(fetch)
+            # sparse sessions fetch each table's dense <rows>@GRAD
+            # alongside the model fetches; `finish` pushes them back to
+            # the host tables and strips them before events fire
+            sfetch = fetch + (sess.grad_fetch_list if sess is not None
+                              else [])
+
+            def finish(out):
+                if sess is None:
+                    return out
+                sess.complete(out[n_fetch:])
+                return out[:n_fetch]
+
             # resolve the pipelined-loop knobs ONCE — including the
             # autotuned fills — so warmup AOT-compiles the exact scan
             # variant the loop will dispatch (_dispatch_k's contract;
@@ -388,10 +446,18 @@ class SGD:
                                    num_workers=workers) if workers > 0 \
                         else r
                     feed_iter = (feeder.feed(b) for b in src())
+                    if sess is not None:
+                        # pulls run on the staging thread up to
+                        # K*prefetch_depth batches ahead of the pushes:
+                        # bounded-staleness async updates (see docstring)
+                        feed_iter = (sess.prepare_feed(f)
+                                     for f in feed_iter)
                     for batch_id, out in enumerate(self.exe.run_pipelined(
-                            feed_iter, self.main_program, fetch_list=fetch,
+                            feed_iter, self.main_program,
+                            fetch_list=sfetch,
                             steps_per_dispatch=K, prefetch_depth=depth),
                             start=skip):
+                        out = finish(out)
                         event_handler(events.BeginIteration(pass_id, batch_id))
                         emit_end(pass_id, batch_id, out)
                     event_handler(events.EndPass(pass_id))
@@ -404,18 +470,20 @@ class SGD:
             def flush(pass_id, first_id, chunk):
                 if len(chunk) == 1:
                     event_handler(events.BeginIteration(pass_id, first_id))
-                    out = self.exe.run(self.main_program, feed=chunk[0],
-                                       fetch_list=fetch)
+                    out = finish(self.exe.run(
+                        self.main_program, feed=chunk[0],
+                        fetch_list=sfetch))
                     emit_end(pass_id, first_id, out)
                     return
                 from .core.executor import stack_feeds
                 stacked = stack_feeds(chunk)
                 outs = self.exe.run_steps(
                     len(chunk), self.main_program, feed=stacked,
-                    fetch_list=fetch, feeds_stacked=True)
+                    fetch_list=sfetch, feeds_stacked=True)
                 for i in range(len(chunk)):
                     event_handler(events.BeginIteration(pass_id, first_id + i))
-                    emit_end(pass_id, first_id + i, [o[i] for o in outs])
+                    emit_end(pass_id, first_id + i,
+                             finish([o[i] for o in outs]))
 
             for pass_id in range(start_pass, num_passes):
                 event_handler(events.BeginPass(pass_id))
@@ -425,15 +493,23 @@ class SGD:
                 if steps_per_dispatch <= 1:
                     for batch_id, batch in enumerate(r(), start=skip):
                         event_handler(events.BeginIteration(pass_id, batch_id))
-                        out = self.exe.run(self.main_program,
-                                           feed=feeder.feed(batch),
-                                           fetch_list=fetch)
+                        feed = feeder.feed(batch)
+                        if sess is not None:
+                            # synchronous rim: pull -> step -> push
+                            feed = sess.prepare_feed(feed)
+                        out = finish(self.exe.run(self.main_program,
+                                                  feed=feed,
+                                                  fetch_list=sfetch))
                         emit_end(pass_id, batch_id, out)
                     event_handler(events.EndPass(pass_id))
                     continue
                 chunk, first_id, sig = [], 0, None
                 for batch_id, batch in enumerate(r(), start=skip):
                     feed = feeder.feed(batch)
+                    if sess is not None:
+                        # chunk-granular staleness: all K pulls precede
+                        # the chunk's dispatch (async-pserver semantics)
+                        feed = sess.prepare_feed(feed)
                     fsig = tuple(sorted(
                         (k, np.shape(v), str(np.asarray(v).dtype))
                         for k, v in feed.items()))
@@ -461,14 +537,22 @@ class SGD:
             if ckpt is not None:
                 ckpt.close()
 
-    def test(self, reader: Callable, feeding=None, feed_list=None):
-        """Average cost (+extras) over a reader without updating params."""
+    def test(self, reader: Callable, feeding=None, feed_list=None,
+             sparse_tables=None):
+        """Average cost (+extras) over a reader without updating params.
+        ``sparse_tables``: the training session — evaluation pulls rows
+        read-only (no grad fetches, no pushes)."""
         feeder = self._feeder(feeding, feed_list)
         test_prog = self.main_program.prune(
             [self.cost] + self.extra).clone(for_test=True)
+        if sparse_tables is not None:
+            sparse_tables.bind(test_prog)
         totals, count = None, 0
         for batch in reader():
-            out = self.exe.run(test_prog, feed=feeder.feed(batch),
+            feed = feeder.feed(batch)
+            if sparse_tables is not None:
+                feed = sparse_tables.prepare_feed(feed, is_test=True)
+            out = self.exe.run(test_prog, feed=feed,
                                fetch_list=[self.cost] + self.extra,
                                is_test=True)
             vals = [np.asarray(o, np.float64) for o in out]
@@ -586,7 +670,10 @@ class SGD:
     def _feeder(self, feeding, feed_list, staging_slots: int = 0):
         if feed_list is None:
             gb = self.main_program.global_block()
-            data_vars = [v for v in gb.vars.values() if v.is_data]
+            # session_feed vars (sparse-table rows/inverse) are injected
+            # by the SparseSession rim, never by the reader
+            data_vars = [v for v in gb.vars.values()
+                         if v.is_data and not v.session_feed]
             if feeding is not None:
                 order = sorted(feeding, key=lambda k: feeding[k])
                 feed_list = [gb.var(n) for n in order]
@@ -613,7 +700,8 @@ def infer(output_layer, parameters=None, input=None, feeding=None,
             order = sorted(feeding, key=lambda k: feeding[k])
             feed_list = [gb.var(n) for n in order]
         else:
-            feed_list = [v for v in gb.vars.values() if v.is_data]
+            feed_list = [v for v in gb.vars.values()
+                         if v.is_data and not v.session_feed]
     # keep only feeds the pruned program actually reads
     needed = set()
     for op in infer_prog.global_block().ops:

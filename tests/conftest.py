@@ -22,6 +22,9 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+REFERENCE_ROOT = "/root/reference"
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
@@ -31,6 +34,51 @@ def pytest_configure(config):
         "timeout(seconds): per-test budget (advisory when pytest-timeout "
         "is absent; chaos subprocess tests ALSO pass hard timeouts to "
         "every subprocess call)")
+    config.addinivalue_line(
+        "markers",
+        "needs_reference: reads config/data files from the reference "
+        "checkout at /root/reference; SKIPPED (not failed) when that "
+        "mount is absent so pre-existing environment gaps cannot mask "
+        "real regressions")
+    config.addinivalue_line(
+        "markers",
+        "needs_multiprocess_collectives: real multi-process collectives "
+        "round; SKIPPED on the CPU backend (jax CPU cannot run "
+        "cross-process psum) so it runs — and fails loudly if broken — "
+        "the first session with a chip/GPU")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Convert known environment gaps into EXPLICIT skips with reasons.
+
+    Before this hook the reference-unmounted v1/cli suites and the
+    CPU-collectives round were permanent tier-1 FAILURES (27 at the
+    PR 13 seed), which meant every session had to eyeball the failure
+    list to tell 'pre-existing' from 'new regression'.  Skips keep the
+    signal: a mounted /root/reference (or a chip backend) re-enables
+    them automatically."""
+    ref_missing = not os.path.isdir(REFERENCE_ROOT)
+    # NOTE: this conftest pins JAX_PLATFORMS=cpu unconditionally (line
+    # 6), so the env var says nothing about the MACHINE — probe for
+    # accelerator device files instead, so a chip/GPU host still runs
+    # the collectives round (and surfaces a regression) while
+    # CPU-only containers skip it with a reason.
+    has_accelerator = any(
+        os.path.exists(p) for p in
+        ("/dev/accel0", "/dev/accel1", "/dev/vfio/0",
+         "/dev/nvidia0", "/dev/nvidiactl"))
+    skip_ref = pytest.mark.skip(
+        reason=f"{REFERENCE_ROOT} not mounted (reference-dependent "
+               f"v1/cli suite)")
+    skip_coll = pytest.mark.skip(
+        reason="no accelerator on this host and the CPU backend has no "
+               "multi-process collectives (runs on chip/GPU sessions)")
+    for item in items:
+        if ref_missing and item.get_closest_marker("needs_reference"):
+            item.add_marker(skip_ref)
+        if not has_accelerator and item.get_closest_marker(
+                "needs_multiprocess_collectives"):
+            item.add_marker(skip_coll)
 
 
 @pytest.fixture(autouse=True)

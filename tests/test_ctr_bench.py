@@ -1,0 +1,74 @@
+"""CTR sparse-parameter-server benchmark gate: the --smoke arm runs the
+REAL code path in-process (tier-1, seconds); the full A/B is @slow per
+the frozen fast-allowlist convention (it is also what commits
+benchmark/ctr_results.json)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from benchmark.ctr import (HBM_EMBEDDING_BUDGET_MB, SMOKE, run_all,
+                           _zipf_ids)
+
+RESULTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmark", "ctr_results.json")
+
+
+def test_zipf_ids_in_range_and_head_heavy():
+    rng = np.random.RandomState(0)
+    ids = _zipf_ids(rng, 1.2, 1000, 10_000)
+    assert ids.min() >= 0 and ids.max() < 1000
+    # zipf head: the most frequent id dwarfs the median frequency
+    _, counts = np.unique(ids, return_counts=True)
+    assert counts.max() > 10 * np.median(counts)
+
+
+def test_ctr_smoke_row_complete():
+    row = run_all(smoke=True, quiet=True)
+    assert row["smoke"] is True
+    cfg = row["config"]
+    # the smoke config shrinks everything EXCEPT the claim structure
+    assert set(SMOKE) <= set(cfg)
+    assert cfg["hbm_embedding_budget_mb"] == HBM_EMBEDDING_BUDGET_MB
+    sp = row["sparse"]
+    assert sp["examples_per_sec"] > 0
+    assert sp["lookup_latency_ms"]["p99"] >= sp["lookup_latency_ms"]["p50"]
+    assert sp["push_rows_per_sec"] > 0
+    assert sp["pushed_rows"] > 0
+    assert all(v > 0 for v in sp["live_rows"].values())
+    assert row["dense_control"]["examples_per_sec"] > 0
+    assert row["sparse_vs_dense_speedup"] is not None
+    cache = row["cache"]
+    assert 0 <= cache["hit_rate"] <= 1
+    assert cache["hits"] + cache["misses"] > 0
+    doc = row["doctor"]
+    assert doc and "error" not in doc, doc
+    assert doc["within_tolerance"] is True
+
+
+def test_committed_results_structure():
+    """The committed JSON carries real CPU rows + the pending-hardware
+    TPU stub (PR 1 convention) + the preserved round-4 legacy study,
+    and its config's dense table genuinely exceeds the declared HBM
+    embedding budget (the giant-embedding premise)."""
+    with open(RESULTS) as fh:
+        data = json.load(fh)
+    assert data["benchmark"] == "ctr_sparse_parameter_server"
+    cpu = data["cpu"]
+    assert cpu["config"]["dense_exceeds_budget"] is True
+    assert cpu["config"]["dense_tables_mb"] > \
+        cpu["config"]["hbm_embedding_budget_mb"]
+    assert cpu["sparse"]["examples_per_sec"] > 0
+    assert cpu["dense_control"]["examples_per_sec"] > 0
+    assert cpu["cache"]["hit_rate"] > 0
+    assert cpu["doctor"]["within_tolerance"] is True
+    assert data["tpu"]["status"] == "pending-hardware"
+    assert "legacy_r04_dense_optimizer_sweep" in data
+
+
+@pytest.mark.slow
+def test_ctr_full_ab_runs():
+    row = run_all(smoke=False, quiet=True)
+    assert row["doctor"].get("within_tolerance") is True
+    assert row["config"]["dense_exceeds_budget"] is True

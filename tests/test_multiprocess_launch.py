@@ -1,3 +1,4 @@
+import pytest
 """2-process jax.distributed test over localhost (reference pattern:
 send_recv_op_test.cc — distributed paths exercised in-process over
 localhost; SURVEY §4 pattern 3).
@@ -94,6 +95,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.needs_multiprocess_collectives
 def test_two_process_distributed_train_and_checkpoint(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)

@@ -891,6 +891,50 @@ def test_lint_gate_covers_elastic_module():
     assert {"elastic.worker", "master.heartbeat"} <= set(KNOWN_SITES)
 
 
+def test_sparse_package_only_imported_lazily():
+    """Zero-cost-when-unused for the sparse parameter server (ISSUE 14):
+    importing paddle_tpu — or running an Executor/Trainer without
+    sparse_tables — never loads paddle_tpu/sparse/.  The trainer wiring
+    is DUCK-TYPED (train(sparse_tables=session) calls methods on the
+    session object), so no module outside the package needs even a lazy
+    import; the one sanctioned lazy site is the reverse direction —
+    sparse/session.py pulling serving.Model for the serve attachment —
+    which lives inside the package and stays lazy for serving's own
+    gate."""
+    problems = [
+        f"{rel}:{lineno}: top-level import of the sparse package — "
+        f"must be lazy (inside a function) so `import paddle_tpu` and "
+        f"every non-sparse training path stay sparse-free"
+        for rel, lineno in _top_level_package_imports("sparse")]
+    assert not problems, "\n".join(problems)
+    # the serving attachment inside the package is itself lazy (the
+    # serving gate would reject a top-level form; assert the sanctioned
+    # lazy site exists so the attachment cannot silently disappear)
+    with open(os.path.join(ROOT, "sparse", "session.py")) as fh:
+        assert "from ..serving.model import Model" in fh.read()
+
+
+def test_lint_gate_covers_sparse_package():
+    """paddle_tpu/sparse/ is inside every lint's scan set, its sparse/*
+    metric names are frozen in METRIC_NAMES, its pull/push span pair is
+    frozen in SPAN_NAMES (the used==registered check then keeps the rim
+    instrumented), and the sparse.push injection site is registered in
+    the faultinject harness."""
+    rels = {rel for rel, _ in _iter_sources()}
+    assert "paddle_tpu/sparse/__init__.py" in rels
+    assert "paddle_tpu/sparse/table.py" in rels
+    assert "paddle_tpu/sparse/session.py" in rels
+    registered = {n for n, _ in _metric_names_table()}
+    assert {n for n in registered if n.startswith("sparse/")} >= {
+        "sparse/pulls", "sparse/pulled_rows", "sparse/pushes",
+        "sparse/pushed_rows", "sparse/pull_ms", "sparse/push_ms",
+        "sparse/cache_hits", "sparse/cache_misses", "sparse/live_rows"}
+    spans = set(_span_names_table())
+    assert {"sparse/pull", "sparse/push"} <= spans
+    from paddle_tpu.testing.faultinject import KNOWN_SITES
+    assert "sparse.push" in KNOWN_SITES
+
+
 def test_shard_fn_registry_matches_ast_scan():
     """Same agreement gate for the sharding-propagation rules: every
     live register_shard_fn name is a string literal the duplicate lint

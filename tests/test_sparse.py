@@ -1,0 +1,504 @@
+"""Unit tests for the sparse parameter server (paddle_tpu/sparse/):
+table store (lazy init, shard invariance, optimizer slot math,
+checkpoint round-trip across shard-count changes, mmap storage),
+session rim (dedup/inverse/bucketing, hot-cache invalidation-on-push,
+fault injection at sparse.push), and the DataFeeder id-hardening
+satellite."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.faults import InjectedFault, RetryPolicy
+from paddle_tpu.sparse import (PAD_ID, SparseSession, SparseTable,
+                               table_specs, tables_for_program)
+from paddle_tpu.testing import faultinject
+
+
+# ---------------------------------------------------------------------------
+# SparseTable
+# ---------------------------------------------------------------------------
+def test_lazy_init_deterministic_across_shard_counts():
+    ids = np.array([3, 99, 7, 42, 3], np.int64)
+    t1 = SparseTable("t", 100, 4, num_shards=1, seed=11)
+    t4 = SparseTable("t", 100, 4, num_shards=4, seed=11)
+    r1, r4 = t1.pull(ids), t4.pull(ids)
+    assert np.array_equal(r1, r4)
+    # duplicate id pulls identical rows; re-pull is stable
+    assert np.array_equal(r1[0], r1[4])
+    assert np.array_equal(t1.pull(ids), r1)
+    # only unique ids materialized
+    assert t1.live_rows == 4
+    assert t1.rows_initialized == 4
+    # a different seed draws different rows
+    t_other = SparseTable("t", 100, 4, seed=12)
+    assert not np.array_equal(t_other.pull(ids), r1)
+
+
+def test_pad_id_pulls_zero_and_push_skips():
+    t = SparseTable("t", 10, 3, learning_rate=1.0)
+    ids = np.array([1, PAD_ID, 2], np.int64)
+    rows = t.pull(ids)
+    assert np.array_equal(rows[1], np.zeros(3, np.float32))
+    before = t.pull(np.array([1, 2], np.int64))
+    n = t.push(ids, np.ones((3, 3), np.float32))
+    assert n == 2                      # pad slot skipped
+    after = t.pull(np.array([1, 2], np.int64))
+    assert np.allclose(after, before - 1.0)
+
+
+def test_sgd_and_adagrad_slot_math():
+    g = np.array([[0.5, -2.0]], np.float32)
+    t = SparseTable("t", 4, 2, optimizer="sgd", learning_rate=0.1,
+                    initializer=("constant", 1.0))
+    t.push(np.array([2], np.int64), g)
+    want = (np.float64(1.0) - np.float64(0.1) * g.astype(np.float64)
+            ).astype(np.float32)
+    assert np.array_equal(t.pull(np.array([2], np.int64)), want)
+
+    ta = SparseTable("t", 4, 2, optimizer="adagrad", learning_rate=0.1,
+                     epsilon=1e-6, initializer=("constant", 1.0))
+    ta.push(np.array([2], np.int64), g)
+    m = (g.astype(np.float64) ** 2).astype(np.float32)
+    assert np.array_equal(ta.pull_slot("moment", np.array([2], np.int64)),
+                          m)
+    want = np.float32(1.0) - np.float32(0.1) * g / \
+        (np.sqrt(m) + np.float32(1e-6))
+    assert np.array_equal(ta.pull(np.array([2], np.int64)), want)
+    # untouched row has zero slot state
+    assert np.array_equal(ta.pull_slot("moment", np.array([1], np.int64)),
+                          np.zeros((1, 2), np.float32))
+
+
+def test_push_validation():
+    t = SparseTable("t", 10, 2)
+    with pytest.raises(ValueError, match="duplicates"):
+        t.push(np.array([1, 1], np.int64), np.zeros((2, 2), np.float32))
+    with pytest.raises(ValueError, match="shape"):
+        t.push(np.array([1], np.int64), np.zeros((2, 2), np.float32))
+    with pytest.raises(ValueError, match="out-of-vocab"):
+        t.push(np.array([10], np.int64), np.zeros((1, 2), np.float32))
+    with pytest.raises(ValueError, match="negative"):
+        t.pull(np.array([-2], np.int64))
+    with pytest.raises(ValueError, match="integral"):
+        t.pull(np.array([1.5]))
+
+
+def test_export_restore_across_shard_count_change():
+    t = SparseTable("t", 50, 4, optimizer="adagrad", learning_rate=0.1,
+                    num_shards=4, seed=3)
+    ids = np.array([0, 7, 13, 49], np.int64)
+    t.pull(ids)
+    t.push(ids, np.random.RandomState(0).randn(4, 4).astype(np.float32))
+    state = t.export_state_vars()
+    # restore under a DIFFERENT shard count: same rows, same slots
+    t2 = SparseTable("t", 50, 4, optimizer="adagrad", learning_rate=0.1,
+                     num_shards=2, seed=3)
+    t2.restore_state_vars(state)
+    assert np.array_equal(t.pull(ids), t2.pull(ids))
+    assert np.array_equal(t.pull_slot("moment", ids),
+                          t2.pull_slot("moment", ids))
+    assert t2.live_rows == t.live_rows
+    # lazy init of a NEW id continues identically after restore
+    new = np.array([21], np.int64)
+    assert np.array_equal(t.pull(new), t2.pull(new))
+    # export is deterministic (sorted ids): byte-identical re-export
+    s1, s2 = t.export_state_vars(), t.export_state_vars()
+    assert sorted(s1) == sorted(s2)
+    for k in s1:
+        assert np.array_equal(s1[k], s2[k])
+
+
+def test_restore_mismatch_rejected():
+    t = SparseTable("t", 50, 4)
+    state = t.export_state_vars()
+    with pytest.raises(ValueError, match="dim"):
+        SparseTable("t", 50, 8).restore_state_vars(state)
+    with pytest.raises(ValueError, match="optimizer"):
+        SparseTable("t", 50, 4,
+                    optimizer="adagrad").restore_state_vars(state)
+    with pytest.raises(ValueError, match="no.*state|carries no"):
+        SparseTable("other", 50, 4).restore_state_vars(state)
+
+
+def test_standalone_save_load(tmp_path):
+    t = SparseTable("t", 30, 4, optimizer="adagrad", num_shards=3, seed=9)
+    ids = np.array([1, 2, 28], np.int64)
+    t.push(ids, np.ones((3, 4), np.float32))
+    d = str(tmp_path / "table")
+    t.save(d)
+    t2 = SparseTable.load(d, num_shards=2)
+    assert t2.optimizer == "adagrad" and t2.num_shards == 2
+    assert np.array_equal(t.pull(ids), t2.pull(ids))
+    assert np.array_equal(t.pull_slot("moment", ids),
+                          t2.pull_slot("moment", ids))
+
+
+def test_mmap_storage_parity(tmp_path):
+    mem = SparseTable("t", 40, 4, optimizer="adagrad", seed=2)
+    mm = SparseTable("t", 40, 4, optimizer="adagrad", seed=2,
+                     num_shards=2, storage="mmap",
+                     storage_dir=str(tmp_path))
+    rng = np.random.RandomState(1)
+    for _ in range(5):
+        ids = np.unique(rng.randint(0, 40, 12).astype(np.int64))
+        g = rng.randn(len(ids), 4).astype(np.float32)
+        mem.push(ids, g)
+        mm.push(ids, g)
+    allids = np.arange(40, dtype=np.int64)
+    assert np.array_equal(mem.pull(allids), mm.pull(allids))
+    assert np.array_equal(mem.pull_slot("moment", allids),
+                          mm.pull_slot("moment", allids))
+    assert mm.host_bytes() == mem.host_bytes()
+    assert os.listdir(str(tmp_path / "t"))   # spool files exist
+
+
+def test_dense_initializer_and_budget_accounting():
+    w = np.random.RandomState(0).rand(20, 4).astype(np.float32)
+    t = SparseTable("t", 20, 4, initializer=("dense", w))
+    ids = np.array([0, 19, 5], np.int64)
+    assert np.array_equal(t.pull(ids), w[ids])
+    assert t.dense_bytes() == 20 * 4 * 4
+    assert t.host_bytes() == 3 * 4 * 4     # rows only (sgd: no slots)
+
+
+# ---------------------------------------------------------------------------
+# SparseSession rim
+# ---------------------------------------------------------------------------
+def _sparse_program(vocab=32, dim=4, name="tbl"):
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="float32")
+    emb = layers.embedding(ids, size=[vocab, dim], sparse=True, name=name)
+    fc = layers.fc(emb, size=1)
+    loss = layers.mean(layers.square(fc - label))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_table_specs_and_builder():
+    _sparse_program(vocab=64, dim=8)
+    specs = table_specs(pt.default_main_program())
+    assert specs == [{"name": "tbl", "vocab_size": 64, "dim": 8,
+                      "dtype": "float32"}]
+    tables = tables_for_program(pt.default_main_program(),
+                                optimizer="adagrad", num_shards=2)
+    assert set(tables) == {"tbl"}
+    assert tables["tbl"].optimizer == "adagrad"
+
+
+def test_prepare_feed_dedup_inverse_and_bucket():
+    _sparse_program(vocab=32, dim=4)
+    t = SparseTable("tbl", 32, 4, seed=1)
+    sess = SparseSession(t, bucket_floor=8)
+    sess.bind(pt.default_main_program())
+    ids = np.array([[5], [9], [5], [30], [9], [9]], np.int64)
+    feed = sess.prepare_feed({"ids": ids, "label": np.zeros((6, 1),
+                                                           np.float32)})
+    rows, inv = feed["tbl@ROWS"], feed["tbl@RIDX"]
+    assert rows.shape == (8, 4)            # 3 unique -> bucket 8
+    assert inv.shape == (6,) and inv.dtype == np.int32
+    # the device gather reconstructs the per-position rows exactly
+    gathered = rows[inv]
+    direct = t.pull(ids.reshape(-1))
+    assert np.array_equal(gathered, direct)
+    assert sess.pending_batches == 1
+    # bucketing keeps the compiled signature stable across batches with
+    # different unique counts (up to the bucket)
+    feed2 = sess.prepare_feed(
+        {"ids": np.array([[1]] * 6, np.int64),
+         "label": np.zeros((6, 1), np.float32)})
+    assert feed2["tbl@ROWS"].shape == (8, 4)
+    # inference mode enqueues nothing
+    sess.prepare_feed({"ids": ids, "label": np.zeros((6, 1), np.float32)},
+                      is_test=True)
+    assert sess.pending_batches == 2
+
+
+def test_session_actionable_errors():
+    _sparse_program(vocab=16, dim=4)
+    t = SparseTable("tbl", 16, 4)
+    sess = SparseSession(t)
+    with pytest.raises(RuntimeError, match="bind"):
+        sess.prepare_feed({"ids": np.zeros((1, 1), np.int64)})
+    sess.bind(pt.default_main_program())
+    with pytest.raises(KeyError, match="ids"):
+        sess.prepare_feed({"label": np.zeros((1, 1), np.float32)})
+    with pytest.raises(ValueError, match="outside the declared vocab"):
+        sess.prepare_feed({"ids": np.array([[16]], np.int64)})
+    with pytest.raises(ValueError, match="outside the declared vocab"):
+        sess.prepare_feed({"ids": np.array([[-1]], np.int64)})
+    with pytest.raises(ValueError, match="integral"):
+        sess.prepare_feed({"ids": np.array([[1.5]])})
+    with pytest.raises(ValueError, match="object array"):
+        sess.prepare_feed({"ids": np.array([[1], [2, 3]], dtype=object)})
+    # int32 coerces fine (canonical int64)
+    feed = sess.prepare_feed({"ids": np.array([[3]], np.int32)})
+    assert feed["tbl@ROWS"].shape[1] == 4
+    # mismatched table declaration
+    bad = SparseSession(SparseTable("tbl", 16, 8))
+    with pytest.raises(ValueError, match="dim"):
+        bad.bind(pt.default_main_program())
+    with pytest.raises(KeyError, match="sparse table"):
+        SparseSession(SparseTable("other", 16, 4)).bind(
+            pt.default_main_program())
+
+
+def test_unknown_table_and_no_sparse_ops():
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    layers.embedding(ids, size=[8, 2])     # dense only
+    with pytest.raises(ValueError, match="no lookup_table_sparse"):
+        SparseSession(SparseTable("x", 8, 2)).bind(
+            pt.default_main_program())
+
+
+def test_hot_cache_invalidation_on_push():
+    _sparse_program(vocab=16, dim=4)
+    t = SparseTable("tbl", 16, 4, learning_rate=1.0, seed=4)
+    sess = SparseSession(t, cache_rows=32)
+    sess.bind(pt.default_main_program())
+    ids = np.array([[2], [3]], np.int64)
+    f1 = sess.prepare_feed({"ids": ids})            # cold: misses
+    assert sess.cache_stats()["misses"] >= 2
+    f2 = sess.prepare_feed({"ids": ids})            # warm: hits
+    assert sess.cache_stats()["hits"] >= 2
+    assert np.array_equal(f1["tbl@ROWS"], f2["tbl@ROWS"])
+    # push invalidates -> next pull returns UPDATED rows, not stale cache
+    g = np.zeros_like(f1["tbl@ROWS"])
+    g[:2] = 1.0
+    sess.complete([g])                              # batch 1's pending
+    f3 = sess.prepare_feed({"ids": ids}, is_test=True)
+    fresh = t.pull(np.array([2, 3], np.int64))
+    assert np.array_equal(f3["tbl@ROWS"][:2], fresh)
+    assert not np.array_equal(f3["tbl@ROWS"], f2["tbl@ROWS"])
+    # drain the remaining pending batch (f2)
+    sess.complete([np.zeros_like(g)])
+    assert sess.pending_batches == 0
+
+
+def test_complete_fifo_contract():
+    _sparse_program(vocab=16, dim=4)
+    sess = SparseSession(SparseTable("tbl", 16, 4))
+    sess.bind(pt.default_main_program())
+    with pytest.raises(RuntimeError, match="no pending"):
+        sess.complete([np.zeros((8, 4), np.float32)])
+    sess.prepare_feed({"ids": np.array([[1]], np.int64)})
+    with pytest.raises(ValueError, match="grad arrays"):
+        sess.complete([])
+
+
+def test_faultinject_push_drop_without_policy_raises():
+    _sparse_program(vocab=16, dim=4)
+    t = SparseTable("tbl", 16, 4, learning_rate=1.0,
+                    initializer=("constant", 0.0))
+    sess = SparseSession(t)
+    sess.bind(pt.default_main_program())
+    sess.prepare_feed({"ids": np.array([[1]], np.int64)})
+    faultinject.configure("sparse.push@*=drop")
+    try:
+        with pytest.raises(ConnectionError):
+            sess.complete([np.ones((8, 4), np.float32)])
+    finally:
+        faultinject.clear()
+    # the drop was NOT silent and NOT applied: row still at init
+    assert np.array_equal(t.pull(np.array([1], np.int64)),
+                          np.zeros((1, 4), np.float32))
+    assert sess.stats["pushes"] == 0
+
+
+def test_faultinject_push_drop_with_policy_retries_exactly_once():
+    _sparse_program(vocab=16, dim=4)
+    t = SparseTable("tbl", 16, 4, learning_rate=1.0,
+                    initializer=("constant", 0.0))
+    sess = SparseSession(t, retry_policy=RetryPolicy(
+        max_attempts=3, backoff_base_s=0.0, backoff_max_s=0.0))
+    sess.bind(pt.default_main_program())
+    sess.prepare_feed({"ids": np.array([[1], [2]], np.int64)})
+    faultinject.configure("sparse.push@1=drop")     # first attempt only
+    try:
+        g = np.zeros((8, 4), np.float32)
+        g[:2] = 1.0
+        n = sess.complete([g])
+        fired = faultinject.fired("sparse.push")
+    finally:
+        faultinject.clear()
+    assert n == 2
+    assert fired == 1
+    # applied EXACTLY once (the site fires before any mutation)
+    assert np.array_equal(
+        t.pull(np.array([1, 2], np.int64)),
+        np.full((2, 4), -1.0, np.float32))
+
+
+def test_faultinject_push_fatal_action_raises():
+    _sparse_program(vocab=16, dim=4)
+    sess = SparseSession(SparseTable("tbl", 16, 4),
+                         retry_policy=RetryPolicy(max_attempts=2,
+                                                  backoff_base_s=0.0))
+    sess.bind(pt.default_main_program())
+    sess.prepare_feed({"ids": np.array([[1]], np.int64)})
+    faultinject.configure("sparse.push@*=error")    # fatal: no retry
+    try:
+        with pytest.raises(InjectedFault):
+            sess.complete([np.zeros((8, 4), np.float32)])
+    finally:
+        faultinject.clear()
+
+
+def test_program_json_roundtrip_keeps_sparse_wiring():
+    _sparse_program(vocab=32, dim=4)
+    prog = pt.core.program.Program.from_dict(
+        pt.default_main_program().to_dict())
+    assert table_specs(prog) == table_specs(pt.default_main_program())
+    gb = prog.global_block()
+    assert gb.var("tbl@ROWS").session_feed
+    assert gb.var("tbl@RIDX").session_feed
+    sess = SparseSession(SparseTable("tbl", 32, 4))
+    sess.bind(prog)
+    assert sess.grad_fetch_list == ["tbl@ROWS@GRAD"]
+
+
+def test_session_metrics_written_when_observing():
+    from paddle_tpu.observability import registry
+    _sparse_program(vocab=16, dim=4)
+    t = SparseTable("tbl", 16, 4, learning_rate=1.0)
+    reg = registry()
+
+    def val(name):
+        return reg.snapshot()[name]["value"]
+
+    # observe=False: zero registry writes (python stats still counted)
+    off = SparseSession(t, observe=False)
+    off.bind(pt.default_main_program())
+    before = val("sparse/pulls")
+    off.prepare_feed({"ids": np.array([[1]], np.int64)})
+    off.complete([np.zeros((8, 4), np.float32)])
+    assert val("sparse/pulls") == before
+    assert off.stats["pulls"] == 1
+    # observe=True: counters move
+    on = SparseSession(t, observe=True, cache_rows=8)
+    on.bind(pt.default_main_program())
+    p0, u0 = val("sparse/pulls"), val("sparse/pushes")
+    on.prepare_feed({"ids": np.array([[1]], np.int64)})
+    on.complete([np.zeros((8, 4), np.float32)])
+    assert val("sparse/pulls") == p0 + 1
+    assert val("sparse/pushes") == u0 + 1
+
+
+# ---------------------------------------------------------------------------
+# DataFeeder id hardening (satellite)
+# ---------------------------------------------------------------------------
+def test_feeder_id_bounds_actionable():
+    from paddle_tpu.data_feeder import DataFeeder
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    feeder = DataFeeder([ids], id_bounds={"ids": 100})
+    # in-range int32 rows coerce to the declared int64
+    out = feeder.feed([(np.array([5], np.int32),),
+                       (np.array([99], np.int32),)])
+    assert out["ids"].dtype == np.int64
+    with pytest.raises(ValueError, match=r"outside.*\[0, 100\)"):
+        feeder.feed([(np.array([100], np.int64),)])
+    with pytest.raises(ValueError, match="outside"):
+        feeder.feed([(np.array([-3], np.int64),)])
+    with pytest.raises(ValueError, match="float"):
+        feeder.feed([(np.array([1.5]),)])
+    with pytest.raises(ValueError, match="ragged"):
+        feeder.feed([([1, 2],), ([1],)])
+
+
+def test_infer_id_bounds_covers_both_lookup_paths():
+    from paddle_tpu.data_feeder import infer_id_bounds
+    ids_d = layers.data("ids_dense", shape=[1], dtype="int64")
+    ids_s = layers.data("ids_sparse", shape=[1], dtype="int64")
+    layers.embedding(ids_d, size=[123, 4])
+    layers.embedding(ids_s, size=[77, 4], sparse=True, name="tb2")
+    bounds = infer_id_bounds(pt.default_main_program())
+    assert bounds == {"ids_dense": 123, "ids_sparse": 77}
+
+
+# ---------------------------------------------------------------------------
+# Review-fix regressions
+# ---------------------------------------------------------------------------
+def test_cache_fill_fenced_against_concurrent_push():
+    """A row pulled from the table BEFORE a concurrent push must not be
+    inserted into the cache AFTER that push's invalidate (it would pin a
+    pre-update row forever).  Deterministic interleaving: the push lands
+    while the cache-miss pull is in flight."""
+    _sparse_program(vocab=16, dim=4)
+    t = SparseTable("tbl", 16, 4, learning_rate=1.0,
+                    initializer=("constant", 0.0))
+    sess = SparseSession(t, cache_rows=32)
+    sess.bind(pt.default_main_program())
+
+    real_pull = t.pull
+    fired = []
+
+    def racing_pull(ids):
+        rows = real_pull(ids)
+        if not fired:
+            fired.append(True)
+            # concurrent trainer push lands mid-pull (after the table
+            # read, before the session's cache insert)
+            sess._pending.append([(sess.bindings[0],
+                                   np.array([2], np.int64))])
+            sess.complete([np.ones((1, 4), np.float32)])
+        return rows
+
+    t.pull = racing_pull
+    try:
+        sess.prepare_feed({"ids": np.array([[2]], np.int64)},
+                          is_test=True)
+    finally:
+        t.pull = real_pull
+    # the stale pre-push row must NOT be cached: the next pull sees the
+    # pushed update
+    f = sess.prepare_feed({"ids": np.array([[2]], np.int64)},
+                          is_test=True)
+    assert np.array_equal(f["tbl@ROWS"][0],
+                          np.full(4, -1.0, np.float32))
+
+
+def test_bind_memo_does_not_survive_dead_program():
+    import gc
+    _sparse_program(vocab=16, dim=4)
+    sess = SparseSession(SparseTable("tbl", 16, 4))
+    sess.bind(pt.default_main_program())
+    pt.core.reset_default_programs()
+    gc.collect()
+    _sparse_program(vocab=16, dim=4)     # fresh program, fresh id()
+    sess.bind(pt.default_main_program())
+    assert sess._bound_ref() is pt.default_main_program()
+    assert sess.grad_fetch_list == ["tbl@ROWS@GRAD"]
+
+
+def test_explicit_parameter_list_controls_wrt_exactly():
+    """calc_gradient/append_backward with an explicit parameter_list
+    must return exactly one grad per named input — sparse rows join
+    only when named (and carry the optimizer-skip tag when they do)."""
+    from paddle_tpu.backward import append_backward
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    x = layers.data("x", shape=[4], dtype="float32")
+    emb = layers.embedding(ids, size=[16, 4], sparse=True, name="tbl")
+    fc = layers.fc(layers.concat([emb, x], axis=1), size=1,
+                   param_attr=pt.ParamAttr(name="w"))
+    loss = layers.mean(layers.square(fc))
+    pairs = append_backward(loss, parameter_list=["w"])
+    assert [p.name for p, _ in pairs] == ["w"]
+    pairs = append_backward(loss, parameter_list=["w", "tbl@ROWS"])
+    assert [p.name for p, _ in pairs] == ["w", "tbl@ROWS"]
+    by_name = {p.name: p for p, _ in pairs}
+    assert getattr(by_name["tbl@ROWS"], "is_sparse_rows", False)
+    assert not getattr(by_name["w"], "is_sparse_rows", False)
+
+
+def test_feeder_id_bounds_covers_sequence_feeds():
+    from paddle_tpu.data_feeder import DataFeeder
+    words = layers.data("words", shape=[], dtype="int64", lod_level=1)
+    feeder = DataFeeder([words], id_bounds={"words": 50})
+    out = feeder.feed([([1, 2, 3],), ([49],)])     # in-range: fine
+    assert out["words"].dtype == np.int64
+    with pytest.raises(ValueError, match=r"outside.*\[0, 50\)"):
+        feeder.feed([([1, 50],), ([2],)])
+    with pytest.raises(ValueError, match="outside"):
+        feeder.feed([([-1],), ([2],)])

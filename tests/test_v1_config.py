@@ -129,6 +129,7 @@ GSERVER = "/root/reference/paddle/gserver/tests"
     ("img_pool_a.conf", (4, 8 * 16 * 16)),
     ("img_pool_b.conf", (4, 8 * 16 * 16)),
 ])
+@pytest.mark.needs_reference
 def test_gserver_layer_configs_forward(conf, feed_shape, rng):
     """gserver layer-equivalence test configs evaluated VERBATIM: mixed
     projections (dotmul/fullmatrix/table/slice), conv/pool layer and

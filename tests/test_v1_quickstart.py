@@ -42,6 +42,7 @@ def _seq_feeds(rng):
             "label": rng.randint(0, 2, (8, 1))}
 
 
+@pytest.mark.needs_reference
 def test_quickstart_lr(qs_cwd, rng):
     cfg = load_v1_config(os.path.join(QS, "trainer_config.lr.py"),
                          dict_file=qs_cwd)
@@ -54,6 +55,7 @@ def test_quickstart_lr(qs_cwd, rng):
                                   "trainer_config.lstm.py",
                                   "trainer_config.bidi-lstm.py",
                                   "trainer_config.db-lstm.py"])
+@pytest.mark.needs_reference
 def test_quickstart_sequence_configs(qs_cwd, rng, conf):
     cfg = load_v1_config(os.path.join(QS, conf), dict_file=qs_cwd)
     _train(cfg, _seq_feeds(rng))

@@ -32,6 +32,7 @@ def _train_steps(cfg, feeds, n=3):
     return vals
 
 
+@pytest.mark.needs_reference
 def test_reference_sequence_rnn_conf_trains(rng):
     """gserver/tests/sequence_rnn.conf verbatim: recurrent_group with a
     name-linked memory trains and the loss falls."""
@@ -47,6 +48,7 @@ def test_reference_sequence_rnn_conf_trains(rng):
     assert vals[-1] < vals[0]
 
 
+@pytest.mark.needs_reference
 def test_reference_sequence_layer_group_conf_trains(rng):
     """gserver/tests/sequence_layer_group.conf verbatim: the `with
     mixed_layer(...) as x: x += full_matrix_projection(...)` form plus
@@ -67,6 +69,7 @@ def test_reference_sequence_layer_group_conf_trains(rng):
     assert vals[-1] < vals[0]
 
 
+@pytest.mark.needs_reference
 def test_reference_rnn_crf_config_trains_and_decodes(rng):
     """v1_api_demo/sequence_tagging/rnn_crf.py verbatim: mixed_layer with
     full_matrix/table projections, reversed recurrent_layer, CRF loglik
@@ -101,6 +104,7 @@ def test_reference_rnn_crf_config_trains_and_decodes(rng):
     assert "chunk" in kinds and "sum" in kinds
 
 
+@pytest.mark.needs_reference
 def test_reference_rnn_gen_conf_generates(rng):
     """trainer/tests/sample_trainer_rnn_gen.conf verbatim: beam_search DSL
     (StaticInput + GeneratedInput, trans_full_matrix_projection weight
@@ -191,6 +195,7 @@ def test_v1_lr_decay_schedule(rng):
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
+@pytest.mark.needs_reference
 def test_reference_nested_rnn_conf_trains(rng):
     """gserver/tests/sequence_nest_rnn.conf verbatim: recurrent_group over
     SubsequenceInput with the inner group's memory booted from the outer
@@ -207,6 +212,7 @@ def test_reference_nested_rnn_conf_trains(rng):
     assert vals[-1] < vals[0]
 
 
+@pytest.mark.needs_reference
 def test_nested_rnn_equals_flat_rnn(rng):
     """The reference's RecurrentGradientMachine equivalence check
     (test_RecurrentGradientMachine.cpp): sequence_nest_rnn.conf on
@@ -346,6 +352,7 @@ def test_seq2seq_attention_decoder_config(tmp_path, rng):
     assert vals[-1] < vals[0] * 0.95
 
 
+@pytest.mark.needs_reference
 def test_data_feeder_nested_sequences(rng):
     """DataFeeder pads nested rows (list of subsequences) to [B,S,T] with
     @LEN/@LEN2 companions, and the nested reference config trains from
@@ -407,6 +414,7 @@ def test_thin_v1_layer_wrappers(rng):
                                   "sequence_recurrent.py",
                                   "sequence_recurrent_group.py",
                                   "sequence_rnn_multi_input.conf"])
+@pytest.mark.needs_reference
 def test_more_gserver_sequence_configs_train(conf, rng):
     """Additional gserver sequence configs VERBATIM: lstmemory forms, the
     recurrent layer vs group equivalence pair, and a multi-input
