@@ -20,7 +20,16 @@ only the rows a batch touches).  Measured rows, all REAL and in-container
   (read-only serving-style traffic);
 * ``doctor`` — the PR 10 measured-vs-modeled step budget attached to
   the sparse arm, so the host-bound-vs-compute-bound claim is measured,
-  not asserted.
+  not asserted;
+* ``vectorization_ab`` — ISSUE 15: paired alternating scalar-vs-
+  vectorized A/B of the host hot path (``SparseTable(impl=...)``), per
+  the PR 9 measurement discipline (median of per-pair ratios, noise
+  gate, raw windows committed).  Three arms: ``steady`` (the PR 14 CTR
+  training workload end to end, gate at the 1.5x acceptance bar),
+  ``cold_init`` (fresh-table pulls, the init-dominated regime the
+  batched Philox kernel targets), and ``overlap`` (vectorized sync rim
+  vs pull-ahead prefetch + bounded async push — on this ~1-effective-
+  core container an honest refusal is an expected outcome).
 
 Writes benchmark/ctr_results.json.  The round-4 dense-optimizer-moment
 sweep this file used to hold (a REAL TPU v5lite measurement from before
@@ -67,6 +76,10 @@ FULL = {
     "cache_rows": 65_536,
     "cache_batches": 60,
     "zipf_a": 1.2,
+    "ab_pairs": 5,
+    "ab_window_steps": 8,
+    "cold_rows": 200_000,
+    "cold_chunk": 8192,
 }
 SMOKE = {
     "batch": 64,
@@ -81,6 +94,10 @@ SMOKE = {
     "cache_rows": 1024,
     "cache_batches": 8,
     "zipf_a": 1.2,
+    "ab_pairs": 2,
+    "ab_window_steps": 3,
+    "cold_rows": 2_000,
+    "cold_chunk": 512,
 }
 
 
@@ -141,10 +158,11 @@ def _pctl(xs, q):
     return float(np.percentile(np.asarray(xs, np.float64), q))
 
 
-def _sparse_tables(cfg, storage="memory", storage_dir=None):
+def _sparse_tables(cfg, storage="memory", storage_dir=None,
+                   impl="vectorized"):
     from paddle_tpu.sparse import SparseTable
     kw = dict(optimizer="adagrad", learning_rate=0.05,
-              storage=storage, storage_dir=storage_dir)
+              storage=storage, storage_dir=storage_dir, impl=impl)
     return {
         "ctr_big": SparseTable("ctr_big", cfg["vocab_big"],
                                cfg["emb_dim"], num_shards=8, seed=1,
@@ -250,6 +268,129 @@ def run_dense_control(cfg, quiet=False):
     return row
 
 
+def _train_window(sess, exe, prog, loss_name, feeds, scope):
+    """One timed window: pull (possibly prefetched) -> dispatch -> push
+    for every feed, then the flush barrier — host+device work complete
+    when it returns."""
+    it = sess.prefetch_feeds(iter(feeds))
+    try:
+        for feed in it:
+            out = exe.run(prog, feed=feed,
+                          fetch_list=[loss_name] + sess.grad_fetch_list,
+                          scope=scope)
+            float(np.asarray(out[0]).reshape(-1)[0])
+            sess.complete(out[1:])
+    finally:
+        it.close()
+    sess.flush()
+
+
+def _impl_arm(cfg, impl, session_kw=None):
+    """A self-contained training arm (own program, scope, executor,
+    tables) whose window cursor walks a shared feed schedule."""
+    import paddle_tpu as pt
+    from paddle_tpu.sparse import SparseSession
+
+    loss = _build_model(cfg, sparse=True)
+    prog = pt.default_main_program()
+    startup = pt.default_startup_program()
+    scope = pt.core.scope.Scope()
+    exe = pt.Executor()
+    exe.run(startup, feed={}, fetch_list=[], scope=scope)
+    sess = SparseSession(_sparse_tables(cfg, impl=impl),
+                         bucket_floor=cfg["batch"],
+                         **(session_kw or {}))
+    sess.bind(prog)
+    return {"sess": sess, "exe": exe, "prog": prog, "scope": scope,
+            "loss_name": loss.name, "cursor": 0}
+
+
+def run_vectorization_ab(cfg, quiet=False):
+    """ISSUE 15 leg 4: paired alternating scalar-vs-vectorized A/B on
+    the PR 14 CTR workload (PR 9 discipline: median of per-pair ratios
+    + noise gate + raw windows committed).  Steady arm gates at the
+    1.5x acceptance bar; both arms of every pair consume the SAME feed
+    windows, so drift cancels pair-wise."""
+    from paddle_tpu.tuning.search import paired_ab
+
+    W = cfg["ab_window_steps"]
+    pairs = cfg["ab_pairs"]
+    # paired_ab runs max(2, pairs) measured pairs + 1 warmup pair; the
+    # schedule must cover every window or a short slice would time a
+    # no-op loop and fabricate a ratio — _next_window asserts it
+    n_windows = (max(2, pairs) + 1) * W
+    feeds = list(_feed_stream(cfg, n_windows, seed=11))
+
+    def _next_window(arm):
+        lo = arm["cursor"]
+        arm["cursor"] += W
+        window = feeds[lo:lo + W]
+        assert len(window) == W, \
+            f"feed schedule exhausted at {lo} (have {len(feeds)})"
+        return window
+
+    # -- steady arm: end-to-end training throughput ----------------------
+    arms = {"reference": _impl_arm(cfg, "reference"),
+            "vectorized": _impl_arm(cfg, "vectorized")}
+
+    def measure_steady(config):
+        arm = arms[config["impl"]]
+        _train_window(arm["sess"], arm["exe"], arm["prog"],
+                      arm["loss_name"], _next_window(arm), arm["scope"])
+
+    steady = paired_ab(measure_steady, {"impl": "reference"},
+                       {"impl": "vectorized"}, pairs=pairs, warmup=1,
+                       min_speedup=1.5)
+    steady["examples_per_window"] = cfg["batch"] * W
+    # byte-identity of the two arms' final table state: the A/B compares
+    # THE SAME training run, not two different ones
+    sv = arms["vectorized"]["sess"].export_state_vars()
+    sr = arms["reference"]["sess"].export_state_vars()
+    steady["arms_bit_identical"] = sorted(sv) == sorted(sr) and all(
+        sv[k].tobytes() == sr[k].tobytes() for k in sv)
+
+    # -- cold-init arm: fresh tables, pure pull (init-dominated) ---------
+    rng = np.random.RandomState(5)
+    cold_ids = np.unique(rng.randint(
+        0, cfg["vocab_big"], int(cfg["cold_rows"] * 1.2)
+    ).astype(np.int64))[:cfg["cold_rows"]]
+
+    def measure_cold(config):
+        t = _sparse_tables(cfg, impl=config["impl"])["ctr_big"]
+        for lo in range(0, len(cold_ids), cfg["cold_chunk"]):
+            t.pull(cold_ids[lo:lo + cfg["cold_chunk"]])
+
+    cold = paired_ab(measure_cold, {"impl": "reference"},
+                     {"impl": "vectorized"}, pairs=pairs, warmup=1)
+    cold["rows_per_window"] = int(len(cold_ids))
+
+    # -- overlap arm: vectorized sync rim vs prefetch + async push -------
+    over_arm = {
+        "sync": _impl_arm(cfg, "vectorized"),
+        "overlap": _impl_arm(cfg, "vectorized",
+                             {"prefetch_depth": 2, "async_push": 2,
+                              "push_flush_batch": 2}),
+    }
+
+    def measure_overlap(config):
+        arm = over_arm[config["mode"]]
+        _train_window(arm["sess"], arm["exe"], arm["prog"],
+                      arm["loss_name"], _next_window(arm), arm["scope"])
+
+    overlap = paired_ab(measure_overlap, {"mode": "sync"},
+                        {"mode": "overlap"}, pairs=pairs, warmup=1)
+    prefetch_stats = over_arm["overlap"]["sess"].stats
+    overlap["prefetch_hits"] = prefetch_stats["prefetch_hits"]
+    overlap["prefetch_misses"] = prefetch_stats["prefetch_misses"]
+
+    row = {"steady": steady, "cold_init": cold, "overlap": overlap}
+    if not quiet:
+        print(json.dumps({"arm": "vectorization_ab", **{
+            k: {"speedup": v["speedup"], "accepted": v["accepted"]}
+            for k, v in row.items()}}), flush=True)
+    return row
+
+
 def run_cache_arm(cfg, quiet=False):
     """Hot-rows cache hit rate under zipfian read-only traffic (the
     serving path: pull-only, cache-first)."""
@@ -327,6 +468,7 @@ def run_all(cfg=None, smoke=False, quiet=False):
         * cfg["emb_dim"] * 4 / 2**20
     sparse_row, sess, exe, loss = run_sparse_arm(cfg, quiet=quiet)
     dense_row = run_dense_control(cfg, quiet=quiet)
+    vect_ab = run_vectorization_ab(cfg, quiet=quiet)
     cache_row = run_cache_arm(cfg, quiet=quiet)
     try:
         doctor_row = run_doctor_pass(cfg, quiet=quiet)
@@ -345,6 +487,7 @@ def run_all(cfg=None, smoke=False, quiet=False):
         "sparse": sparse_row,
         "dense_control": dense_row,
         "sparse_vs_dense_speedup": speedup,
+        "vectorization_ab": vect_ab,
         "cache": cache_row,
         **doctor_row,
         "smoke": bool(smoke),

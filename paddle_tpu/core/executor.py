@@ -40,7 +40,7 @@ from .. import faults as _faults
 from .. import observability as obs
 from ..testing import faultinject as _fi
 from .program import Block, Operator, Program, Variable, grad_var_name
-from .registry import get_op_impl, register_tunable
+from .registry import get_op_impl, register_tunable, resolve_tuned
 from .scope import Scope, global_scope
 
 logger = logging.getLogger("paddle_tpu")
@@ -667,10 +667,7 @@ class Executor:
         """Tunable config for a call site: the persisted winner under the
         autotune opt-in, else ``default`` UNCHANGED (the same object).
         The tuning package loads lazily and only on the opted-in path."""
-        if not self._autotuning():
-            return default
-        from ..tuning.store import tuned
-        return tuned(name, default)
+        return resolve_tuned(name, default, self.autotune)
 
     def _effective_compiler_options(self) -> Dict[str, object]:
         """compiler_options with device-side tuned winners folded in.

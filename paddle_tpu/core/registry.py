@@ -220,6 +220,30 @@ def get_tunable(name: str) -> dict:
             f"{sorted(_TUNABLES)}") from None
 
 
+def resolve_tuned(name: str, default: Dict[str, object],
+                  autotune: Optional[bool] = None) -> Dict[str, object]:
+    """Call-site replay of a persisted tunable winner — the shared form
+    of the per-module resolution copies (reader prefetch, serving
+    batcher, flash-attention blocks, executor dispatch, sparse
+    session).  Returns ``default`` UNCHANGED (the SAME object — the
+    byte-identical-when-untuned contract pinned by tier-1) unless
+    autotuning is on, in which case the persisted winner for ``name``
+    replaces it.  ``autotune=None`` consults the global ``autotune``
+    flag; an explicit bool overrides it (the per-instance opt-ins).
+    The tuning package loads lazily and ONLY on the opted-in path
+    (repo-lint lazy-import gate)."""
+    if autotune is None:
+        try:
+            from .. import flags
+            autotune = bool(flags.get_flag("autotune"))
+        except KeyError:
+            autotune = False
+    if not autotune:
+        return default
+    from ..tuning.store import tuned
+    return tuned(name, default)
+
+
 def has_tunable(name: str) -> bool:
     return name in _TUNABLES
 

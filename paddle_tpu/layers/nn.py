@@ -1297,15 +1297,9 @@ def flash_attention(q, k, v, causal=False, block_q=None, block_k=None,
     happens HERE, at graph-build time, so the chosen blocks are op attrs
     and every compile-cache fingerprint sees them."""
     if block_q is None or block_k is None:
-        cfg = {"block_q": 1024, "block_k": 1024}
-        try:
-            from .. import flags as _flags
-            _autotune = bool(_flags.get_flag("autotune"))
-        except KeyError:
-            _autotune = False
-        if _autotune:
-            from ..tuning.store import tuned
-            cfg = tuned("pallas/flash_attention", cfg)
+        from ..core.registry import resolve_tuned
+        cfg = resolve_tuned("pallas/flash_attention",
+                            {"block_q": 1024, "block_k": 1024})
         block_q = cfg["block_q"] if block_q is None else block_q
         block_k = cfg["block_k"] if block_k is None else block_k
     helper = LayerHelper("flash_attention", name=name)

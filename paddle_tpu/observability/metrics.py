@@ -221,6 +221,21 @@ METRIC_NAMES = (
      "hot-rows cache misses on the pull path (row fetched from shard)"),
     ("sparse/live_rows", "gauge",
      "lazily-materialized rows resident per table (labels: table name)"),
+    ("sparse/rows_initialized", "counter",
+     "rows lazily initialized by the batched Philox draw (cold-row "
+     "materializations inside pulls/pushes)"),
+    ("sparse/init_rows_per_sec", "gauge",
+     "lazy-init throughput of the most recent cold-row batch (labels: "
+     "table name) — the vectorized-vs-scalar init signal"),
+    ("sparse/prefetch_hits", "counter",
+     "pull-ahead prefetch hits: the consumer found the next batch "
+     "already prepared (overlap won)"),
+    ("sparse/prefetch_misses", "counter",
+     "pull-ahead prefetch misses: the consumer blocked on the worker "
+     "(pulls slower than dispatch, or depth too small)"),
+    ("sparse/push_flush_ms", "histogram",
+     "host wall time of one async-push worker drain (up to "
+     "push_flush_batch queued gradient pushes applied FIFO)"),
 )
 
 _MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
@@ -246,6 +261,7 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
     "elastic/resize_ms": _MS_BUCKETS,
     "sparse/pull_ms": _MS_BUCKETS,
     "sparse/push_ms": _MS_BUCKETS,
+    "sparse/push_flush_ms": _MS_BUCKETS,
 }
 _DEFAULT_BUCKETS = _MS_BUCKETS
 

@@ -96,6 +96,10 @@ SPAN_NAMES = (
     ("sparse/push", "one batch's post-dispatch gradient pushes (host-"
      "side sparse optimizer update across all bound tables, inside the "
      "sparse.push fault-injection/retry rim); labels: tables"),
+    ("sparse/prefetch", "root of one pull-ahead prefetch run over a "
+     "feed stream (SparseSession.prefetch_feeds): the worker thread's "
+     "per-batch sparse/pull spans cross-thread-parent to it; labels: "
+     "depth"),
 )
 
 _REGISTERED = tuple(n for n, _ in SPAN_NAMES)

@@ -207,15 +207,9 @@ def _tuned_defaults(buffer_size: Optional[int], num_workers: Optional[int]):
     explicit argument always wins."""
     if buffer_size is not None and num_workers is not None:
         return buffer_size, num_workers
-    cfg = {"buffer_size": 8, "num_workers": 1}
-    try:
-        from .. import flags as _flags
-        autotune = bool(_flags.get_flag("autotune"))
-    except KeyError:
-        autotune = False
-    if autotune:
-        from ..tuning.store import tuned
-        cfg = tuned("reader/prefetch", cfg)
+    from ..core.registry import resolve_tuned
+    cfg = resolve_tuned("reader/prefetch",
+                        {"buffer_size": 8, "num_workers": 1})
     return (cfg["buffer_size"] if buffer_size is None else buffer_size,
             cfg["num_workers"] if num_workers is None else num_workers)
 

@@ -294,16 +294,10 @@ class Server:
         # `autotune` flag), the persisted serving/batcher winner for
         # this host.  Explicit arguments always win.
         if max_batch is None or max_wait_ms is None:
-            cfg = {"max_batch": 32, "max_wait_ms": 5.0}
-            if autotune is None:
-                try:
-                    from .. import flags as _flags
-                    autotune = bool(_flags.get_flag("autotune"))
-                except KeyError:
-                    autotune = False
-            if autotune:
-                from ..tuning.store import tuned
-                cfg = tuned("serving/batcher", cfg)
+            from ..core.registry import resolve_tuned
+            cfg = resolve_tuned("serving/batcher",
+                                {"max_batch": 32, "max_wait_ms": 5.0},
+                                autotune)
             if max_batch is None:
                 max_batch = cfg["max_batch"]
             if max_wait_ms is None:

@@ -23,16 +23,38 @@ the one-big-jit executor:
 
 Ordering: :meth:`prepare_feed` enqueues each training batch's unique-id
 set FIFO; :meth:`complete` pops it.  The per-batch trainer path is fully
-synchronous (pull → step → push), which is what makes small-vocab
-sparse-vs-dense parity BIT-identical.  The chunked/pipelined paths pull
-up to ``steps_per_dispatch × prefetch_depth`` batches ahead of the
-pushes — bounded-staleness asynchronous updates, the reference's async
-pserver SGD semantics (documented, and pinned exact when a chunk's
-batches touch disjoint ids).
+synchronous by default (pull → step → push), which is what makes
+small-vocab sparse-vs-dense parity BIT-identical.  The chunked/pipelined
+paths pull up to ``steps_per_dispatch × prefetch_depth`` batches ahead
+of the pushes — bounded-staleness asynchronous updates, the reference's
+async pserver SGD semantics (documented, and pinned exact when a
+chunk's batches touch disjoint ids).
+
+Two opt-in overlap legs extend that rim (the reference's dedicated
+row-prefetch thread, done as host-side pipeline stages):
+
+* **pull-ahead prefetch** (``prefetch_depth > 0``): a worker thread
+  runs :meth:`prepare_feed` up to ``depth`` batches ahead of the
+  consumer, so batch N+1's row pulls overlap batch N's dispatch
+  (:meth:`prefetch_feeds`; the trainer wires it on the per-batch,
+  chunked and pipelined paths).  Pulls may then run ahead of pushes by
+  the same bound — the chunked paths' staleness semantics, pinned
+  bit-identical when concurrent batches touch disjoint ids;
+* **bounded async push** (``async_push > 0``): :meth:`complete`
+  enqueues the batch's gradient push onto a worker (queue bounded at
+  ``async_push`` batches, drained ``push_flush_batch`` at a time) and
+  :meth:`flush` is the hard barrier — called automatically before
+  every checkpoint export (:meth:`export_state_vars`) and every
+  read-only :meth:`prepare_feed` (``test()``/serving pulls), so a
+  committed checkpoint always contains every acknowledged push and a
+  read never sees a table missing acked updates.  A failed async push
+  is re-raised at the next ``complete``/``flush``/export — never
+  silent, same contract as the synchronous rim.
 """
 from __future__ import annotations
 
 import collections
+import queue as _queue_mod
 import threading
 import time
 import weakref
@@ -43,7 +65,8 @@ import numpy as np
 
 from .. import faults as _faults
 from .. import observability as obs
-from ..observability.tracing import span
+from ..core.registry import register_tunable
+from ..observability.tracing import span, start_span
 from ..testing import faultinject as _fi
 from .table import PAD_ID, SparseTable
 
@@ -53,6 +76,61 @@ __all__ = ["SparseBinding", "SparseSession", "HotRowCache",
 SPARSE_OP = "lookup_table_sparse"
 ROWS_SUFFIX = "@ROWS"
 RIDX_SUFFIX = "@RIDX"
+
+#: thread-name prefix of the session's workers (prefetch, async push);
+#: the tests' leak fixture enforces they die with their owner
+THREAD_NAME_PREFIX = "pt-sparse"
+
+# how long an idle async-push worker lingers for more work before
+# exiting (it restarts on the next enqueue; bounded linger keeps
+# sessions leak-free without an explicit close())
+_PUSH_LINGER_S = 0.5
+
+# Autotuner knob declarations (paddle_tpu.tuning), next to the host hot
+# path they control.  All three are HOST-side: searchable in this
+# container (benchmark/ctr.py measures them on the real CTR workload),
+# no pending-hardware stub.
+register_tunable(
+    "sparse/hot_rows", side="host",
+    space={"cache_rows": (0, 1024, 16384, 65536, 262144)},
+    default={"cache_rows": 0},
+    description="hot-rows LRU capacity of the sparse session's cache-"
+                "first pull path (0 = off; rows).  Decision rule: "
+                "enable non-zero capacity when the paired A/B on the "
+                "serving-style pull loop clears the 1.10x gate — the "
+                "hit rate must pay for the per-row cache bookkeeping.")
+register_tunable(
+    "sparse/prefetch", side="host",
+    space={"depth": (0, 1, 2, 4)},
+    default={"depth": 0},
+    description="pull-ahead prefetch depth: batches prepared ahead of "
+                "the dispatch loop on the session's worker thread (0 = "
+                "fully synchronous rim, the bit-parity default).  "
+                "Decision rule: enable when the paired A/B on the "
+                "training loop clears the 1.10x gate AND the workload "
+                "tolerates pulls running up to depth+1 batches ahead "
+                "of pushes (bounded-staleness async updates).")
+register_tunable(
+    "sparse/push_flush", side="host",
+    space={"batch": (1, 2, 4, 8)},
+    default={"batch": 1},
+    description="async-push worker drain size: queued gradient pushes "
+                "applied per worker wakeup (only reached with "
+                "async_push > 0; order always FIFO, semantics "
+                "unchanged).  Decision rule: raise above 1 when the "
+                "paired A/B on the async-push loop clears 1.10x — the "
+                "win is amortized wakeup/lock traffic, so it only "
+                "moves on push-bound workloads.")
+
+
+def _tuned_knob(name: str, default: Dict[str, object], key: str):
+    """Resolve one omitted session knob: the shipped default — or,
+    under the ``autotune`` flag, the persisted winner
+    (:func:`~paddle_tpu.core.registry.resolve_tuned`; the untuned path
+    never loads the tuning package).  Explicit ctor arguments never
+    reach this."""
+    from ..core.registry import resolve_tuned
+    return resolve_tuned(name, default)[key]
 
 
 def table_specs(program) -> List[dict]:
@@ -156,11 +234,21 @@ class SparseSession:
     batch's unique-id count up to a power of two so chunked/pipelined
     dispatch signatures stay stable (PAD slots pull zero rows and push
     nothing).
+
+    ``prefetch_depth``, ``async_push`` and ``push_flush_batch`` are the
+    overlap knobs (module docstring); ``cache_rows``,
+    ``prefetch_depth`` and ``push_flush_batch`` left at ``None``
+    resolve to the shipped defaults (0 / 0 / 1) or, under the
+    ``autotune`` flag, to the persisted ``sparse/hot_rows`` /
+    ``sparse/prefetch`` / ``sparse/push_flush`` winners.
     """
 
-    def __init__(self, tables, *, cache_rows: int = 0,
+    def __init__(self, tables, *, cache_rows: Optional[int] = None,
                  retry_policy=None, bucket: bool = True,
                  bucket_floor: int = 8,
+                 prefetch_depth: Optional[int] = None,
+                 async_push: int = 0,
+                 push_flush_batch: Optional[int] = None,
                  observe: Optional[bool] = None):
         if isinstance(tables, SparseTable):
             tables = [tables]
@@ -176,7 +264,19 @@ class SparseSession:
         self.retry_policy = retry_policy
         self.bucket = bool(bucket)
         self.bucket_floor = int(bucket_floor)
+        if cache_rows is None:
+            cache_rows = _tuned_knob("sparse/hot_rows",
+                                     {"cache_rows": 0}, "cache_rows")
+        if prefetch_depth is None:
+            prefetch_depth = _tuned_knob("sparse/prefetch", {"depth": 0},
+                                         "depth")
+        if push_flush_batch is None:
+            push_flush_batch = _tuned_knob("sparse/push_flush",
+                                           {"batch": 1}, "batch")
         self.cache = HotRowCache(cache_rows)
+        self.prefetch_depth = int(prefetch_depth)
+        self.async_push = int(async_push)
+        self.push_flush_batch = max(1, int(push_flush_batch))
         self._observe = obs.enabled() if observe is None else bool(observe)
         self._bindings: List[SparseBinding] = []
         # bound-program memo: a WEAKREF, not id() — a dead program's
@@ -186,11 +286,22 @@ class SparseSession:
         self._push_gen = 0          # bumped per push; fences cache fills
         self._lock = threading.Lock()
         self._pending: "collections.deque" = collections.deque()
+        # async-push worker state (guarded by _push_cv; the worker is
+        # spawned on demand and exits after a bounded idle linger, so
+        # sessions never leak threads without an explicit close)
+        self._push_cv = threading.Condition()
+        self._push_q: "collections.deque" = collections.deque()
+        self._push_inflight = 0
+        self._push_worker = None
+        self._push_err = None
+        self._push_linger_s = _PUSH_LINGER_S
         # lifetime counters (always maintained; mirrored into the
         # observability registry only when observing)
         self.stats = {"pulls": 0, "pulled_rows": 0, "pushes": 0,
                       "pushed_rows": 0, "pull_ms": 0.0, "push_ms": 0.0,
-                      "batches": 0}
+                      "batches": 0, "prefetch_hits": 0,
+                      "prefetch_misses": 0, "push_flushes": 0,
+                      "push_flush_ms": 0.0}
 
     # -- binding ------------------------------------------------------------
     def bind(self, program) -> "SparseSession":
@@ -275,6 +386,7 @@ class SparseSession:
         table, cache = b.table, self.cache
         t0 = time.perf_counter()
         hits0, misses0 = cache.hits, cache.misses
+        init0, last_init0 = table.rows_initialized, table.last_init
         if cache.capacity > 0:
             out = np.zeros((len(uid), table.dim), table.dtype)
             missing_pos: List[int] = []
@@ -318,6 +430,18 @@ class SparseSession:
             obs.observe_hist("sparse/pull_ms", dt_ms)
             obs.set_gauge("sparse/live_rows", table.live_rows,
                           label=table.name)
+            # counter: the total-preserving delta (a concurrent push
+            # worker's inits may land in this window, but every row is
+            # counted exactly once across all observers); rate gauge:
+            # the table's atomically-rebound last-init tuple, so one
+            # batch's rows are never divided by another's seconds
+            d_init = table.rows_initialized - init0
+            if d_init:
+                obs.inc_counter("sparse/rows_initialized", d_init)
+            li = table.last_init
+            if li is not None and li is not last_init0 and li[1] > 0:
+                obs.set_gauge("sparse/init_rows_per_sec", li[0] / li[1],
+                              label=table.name)
             if cache.capacity > 0:
                 dh = cache.hits - hits0
                 dm = cache.misses - misses0
@@ -329,17 +453,29 @@ class SparseSession:
 
     # -- the rim ------------------------------------------------------------
     def prepare_feed(self, feed: Dict[str, object],
-                     is_test: bool = False) -> Dict[str, object]:
+                     is_test: bool = False,
+                     trace_parent=None) -> Dict[str, object]:
         """Dedup + pull + inject for one batch.  Returns a NEW feed dict
         carrying the original entries plus each binding's rows and
         inverse-index feeds.  Training batches (``is_test=False``)
         enqueue their unique-id sets for the matching :meth:`complete`.
+        Read-only batches (``is_test=True``) first :meth:`flush` any
+        queued async pushes — the hard barrier that keeps ``test()``
+        and serving reads from seeing a table missing acked updates.
+        ``trace_parent``: explicit span parent for cross-thread callers
+        (the prefetch worker parents its pulls to the prefetch root).
         """
         if not self._bindings:
             raise RuntimeError("SparseSession: call bind(program) first")
+        if self.async_push > 0:
+            if is_test:
+                self.flush()
+            else:
+                self._raise_push_err()
         out = dict(feed)
         pend = []
-        with (span("sparse/pull", tables=len(self._bindings))
+        with (span("sparse/pull", parent=trace_parent,
+                   tables=len(self._bindings))
               if self._observe else _nullcontext()):
             for b in self._bindings:
                 if b.ids_name not in feed:
@@ -365,10 +501,14 @@ class SparseSession:
         self.stats["batches"] += 1
         return out
 
-    def complete(self, grad_arrays: Sequence) -> int:
+    def complete(self, grad_arrays: Sequence):
         """Push one batch's gradient rows (the fetched ``<rows>@GRAD``
         arrays, in :attr:`grad_fetch_list` order) back into the tables.
-        Returns rows updated."""
+        Synchronous mode returns rows updated; with ``async_push > 0``
+        the push is ACKNOWLEDGED by enqueueing it (bounded at
+        ``async_push`` batches; blocks when full) and applied FIFO on
+        the worker — :meth:`flush` is the completion barrier, and a
+        worker failure re-raises here or there, never silently."""
         with self._lock:
             if not self._pending:
                 raise RuntimeError(
@@ -379,12 +519,92 @@ class SparseSession:
             raise ValueError(
                 f"SparseSession.complete: got {len(grad_arrays)} grad "
                 f"arrays for {len(pend)} bound tables")
+        if self.async_push > 0:
+            with self._push_cv:
+                self._raise_push_err_locked()
+                while len(self._push_q) >= self.async_push \
+                        and self._push_err is None:
+                    self._push_cv.wait()
+                self._raise_push_err_locked()
+                self._push_q.append((pend, list(grad_arrays)))
+                if self._push_worker is None:
+                    t = threading.Thread(
+                        target=self._push_worker_main,
+                        name=f"{THREAD_NAME_PREFIX}-push", daemon=True)
+                    self._push_worker = t
+                    t.start()
+                self._push_cv.notify_all()
+            return None
         updated = 0
         with (span("sparse/push", tables=len(pend))
               if self._observe else _nullcontext()):
             for (b, uid), g in zip(pend, grad_arrays):
                 updated += self._push(b, uid, np.asarray(g, b.table.dtype))
         return updated
+
+    # -- async push worker --------------------------------------------------
+    def _raise_push_err_locked(self):
+        if self._push_err is not None:
+            e, self._push_err = self._push_err, None
+            raise e
+
+    def _raise_push_err(self):
+        with self._push_cv:
+            self._raise_push_err_locked()
+
+    def _push_worker_main(self):
+        while True:
+            with self._push_cv:
+                if not self._push_q:
+                    self._push_cv.wait(timeout=self._push_linger_s)
+                    if not self._push_q:
+                        self._push_worker = None
+                        self._push_cv.notify_all()
+                        return
+                take = min(len(self._push_q), self.push_flush_batch)
+                group = [self._push_q.popleft() for _ in range(take)]
+                self._push_inflight += len(group)
+                self._push_cv.notify_all()   # unblock bounded producers
+            t0 = time.perf_counter()
+            try:
+                for pend, grads in group:
+                    with (span("sparse/push", tables=len(pend))
+                          if self._observe else _nullcontext()):
+                        for (b, uid), g in zip(pend, grads):
+                            self._push(b, uid,
+                                       np.asarray(g, b.table.dtype))
+            except BaseException as e:       # noqa: BLE001 — re-raised
+                # at the next complete/flush/export rim; queued pushes
+                # after a failure are DROPPED with the error carrying
+                # the loss (the run must abort: grads exist nowhere
+                # else, continuing would train on a corrupt table)
+                with self._push_cv:
+                    self._push_err = e
+                    self._push_q.clear()
+                    self._push_inflight = 0
+                    self._push_worker = None
+                    self._push_cv.notify_all()
+                return
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            self.stats["push_flushes"] += 1
+            self.stats["push_flush_ms"] += dt_ms
+            if self._observe:
+                obs.observe_hist("sparse/push_flush_ms", dt_ms)
+            with self._push_cv:
+                self._push_inflight -= len(group)
+                self._push_cv.notify_all()
+
+    def flush(self):
+        """Barrier: block until every acknowledged (enqueued) async push
+        has been APPLIED to the tables, re-raising a worker failure.
+        No-op in synchronous mode."""
+        if self.async_push <= 0:
+            return
+        with self._push_cv:
+            while (self._push_q or self._push_inflight) \
+                    and self._push_err is None:
+                self._push_cv.wait()
+            self._raise_push_err_locked()
 
     @property
     def pending_batches(self) -> int:
@@ -433,6 +653,111 @@ class SparseSession:
             obs.observe_hist("sparse/push_ms", dt_ms)
         return n
 
+    # -- pull-ahead prefetch ------------------------------------------------
+    def prefetch_feeds(self, feed_iter, *, depth: Optional[int] = None,
+                       is_test: bool = False):
+        """Pull-ahead rim over a stream of raw feed dicts: yields
+        prepared feeds (each the result of :meth:`prepare_feed`) while a
+        worker thread prepares up to ``depth`` batches ahead — batch
+        N+1's row pulls overlap batch N's dispatch.  ``depth`` defaults
+        to the session's ``prefetch_depth``; ``depth <= 0`` prepares
+        inline (no thread, bit-identical to the synchronous rim).
+
+        Closing the returned generator stops and joins the worker; a
+        worker failure (bad feed, table error) re-raises at the
+        consumer.  FIFO is preserved end to end, so the pending-batch
+        queue stays aligned with :meth:`complete`."""
+        depth = self.prefetch_depth if depth is None else int(depth)
+        if depth <= 0:
+            def _inline():
+                for f in feed_iter:
+                    yield self.prepare_feed(f, is_test=is_test)
+            return _inline()
+        return self._prefetch_gen(feed_iter, depth, is_test)
+
+    def _prefetch_gen(self, feed_iter, depth: int, is_test: bool):
+        # A dedicated producer/consumer rather than a rewire onto
+        # reader.pipeline.prefetch: this rim needs the hit/miss
+        # accounting (the frozen sparse/prefetch_* metrics), the
+        # sparse/pull-parents-to-sparse/prefetch span shape, and the
+        # close-time pending-ledger retraction below — hooks the shared
+        # reader engine deliberately does not expose.
+        q = _queue_mod.Queue(maxsize=depth)
+        stop = threading.Event()
+        prepared_n = [0]                     # batches the worker prepared
+        delivered_n = 0                      # batches the consumer got
+        root = (start_span("sparse/prefetch", depth=depth)
+                if self._observe else None)
+
+        def _offer(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue_mod.Full:
+                    continue
+            return False
+
+        def _work():
+            try:
+                for f in feed_iter:
+                    if stop.is_set():
+                        return
+                    prepared = self.prepare_feed(f, is_test=is_test,
+                                                 trace_parent=root)
+                    prepared_n[0] += 1
+                    if not _offer(("ok", prepared)):
+                        return
+                _offer(("done", None))
+            except BaseException as e:       # noqa: BLE001 — re-raised
+                _offer(("err", e))           # at the consumer
+
+        t = threading.Thread(target=_work,
+                             name=f"{THREAD_NAME_PREFIX}-prefetch",
+                             daemon=True)
+        t.start()
+        try:
+            while True:
+                try:
+                    kind, val = q.get_nowait()
+                    hit = True
+                except _queue_mod.Empty:
+                    hit = False
+                    kind, val = q.get()
+                if kind == "done":
+                    break
+                if kind == "err":
+                    raise val
+                self.stats["prefetch_hits" if hit
+                           else "prefetch_misses"] += 1
+                if self._observe:
+                    if hit:
+                        obs.inc_counter("sparse/prefetch_hits")
+                    else:
+                        obs.inc_counter("sparse/prefetch_misses")
+                delivered_n += 1
+                yield val
+        finally:
+            stop.set()
+            while True:                      # unblock a worker mid-put
+                try:
+                    q.get_nowait()
+                except _queue_mod.Empty:
+                    break
+            t.join(timeout=10.0)
+            if not is_test and not t.is_alive():
+                # retract the pending-push entries of batches prepared
+                # ahead but never DELIVERED to the consumer: leaving
+                # them would misalign a reused session's next
+                # complete() with the wrong unique-id set (delivered
+                # batches keep theirs — same state as a synchronous
+                # abort after prepare_feed)
+                with self._lock:
+                    for _ in range(prepared_n[0] - delivered_n):
+                        self._pending.pop()
+            if root is not None:
+                root.end()
+
     # -- convenience --------------------------------------------------------
     def run(self, exe, program, feed: Dict[str, object],
             fetch_list: Sequence, scope=None, is_test: bool = False,
@@ -463,7 +788,11 @@ class SparseSession:
     # -- checkpoint rider ---------------------------------------------------
     def export_state_vars(self) -> Dict[str, np.ndarray]:
         """All bound tables' state as synthetic scope vars — the callable
-        the trainer hands to ``Checkpointer(state_vars=...)``."""
+        the trainer hands to ``Checkpointer(state_vars=...)``.  Flushes
+        queued async pushes FIRST: every push acknowledged before a
+        checkpoint commit is in the committed state (the hard barrier
+        the chaos suite pins through SIGTERM/SIGKILL)."""
+        self.flush()
         out: Dict[str, np.ndarray] = {}
         for t in self.tables.values():
             out.update(t.export_state_vars())

@@ -19,6 +19,15 @@ rows a workload has actually seen.  Lazy draws are deterministic per
 same ids always materialize the same rows regardless of touch order,
 shard count, or restart.
 
+The host hot path is **vectorized** (round 15): a whole batch's missing
+rows draw in ONE batched Philox call (``sparse/philox.py``) and the
+id→arena-position map is a searchsorted structure (:class:`_IdMap`)
+instead of per-id dict lookups.  The scalar originals are kept as the
+``impl="reference"`` oracle — per-id ``Generator(Philox(key))`` draws
+and a dict index — and randomized tests pin the two impls BIT-identical
+(rows, optimizer slots, checkpoint bytes); ``benchmark/ctr.py``
+alternates them as the committed paired A/B.
+
 Sharding is by ``id % num_shards``.  Checkpoint export
 (:meth:`export_state_vars`) is **spec-agnostic**: each shard serializes
 its live ``(ids, rows, slots)`` triple, and restore re-inserts rows by
@@ -32,9 +41,12 @@ import json
 import logging
 import os
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from .philox import philox_uniform_rows
 
 logger = logging.getLogger("paddle_tpu")
 
@@ -70,15 +82,90 @@ def _require_int_ids(ids) -> np.ndarray:
     return a.astype(np.int64, copy=False).reshape(-1)
 
 
+class _IdMap:
+    """Vectorized id -> arena-position map: a sorted base pair plus a
+    small sorted tail of recent inserts (merged into the base when it
+    outgrows ``max(1024, base/8)``, so cold-start insert cost stays
+    amortized-constant per id instead of O(live) per batch).  Replaces
+    the per-id dict lookups of the reference path with one
+    ``np.searchsorted`` per level; the dict index is kept as the
+    ``impl='reference'`` oracle (tests/test_sparse_vectorized.py pins
+    position-for-position agreement)."""
+
+    __slots__ = ("_bids", "_bpos", "_tids", "_tpos")
+
+    def __init__(self):
+        self._bids = np.empty(0, np.int64)
+        self._bpos = np.empty(0, np.int64)
+        self._tids = np.empty(0, np.int64)
+        self._tpos = np.empty(0, np.int64)
+
+    def __len__(self):
+        return self._bids.size + self._tids.size
+
+    def clear(self):
+        self.__init__()
+
+    def lookup(self, ids: np.ndarray) -> np.ndarray:
+        """Arena positions for ``ids`` (int64 array), -1 where absent."""
+        out = np.full(ids.size, -1, np.int64)
+        for lids, lpos in ((self._bids, self._bpos),
+                           (self._tids, self._tpos)):
+            if not lids.size:
+                continue
+            j = np.minimum(np.searchsorted(lids, ids), lids.size - 1)
+            hit = lids[j] == ids
+            if hit.any():
+                out[hit] = lpos[j[hit]]
+        return out
+
+    def insert(self, ids: np.ndarray, pos: np.ndarray):
+        """Add ids (disjoint from every live id) with their positions."""
+        if not ids.size:
+            return
+        if ids.size > 1 and not np.all(ids[1:] > ids[:-1]):
+            order = np.argsort(ids, kind="stable")
+            ids, pos = ids[order], pos[order]
+        if self._tids.size:
+            j = np.searchsorted(self._tids, ids)
+            self._tids = np.insert(self._tids, j, ids)
+            self._tpos = np.insert(self._tpos, j, pos)
+        else:
+            self._tids = np.asarray(ids, np.int64).copy()
+            self._tpos = np.asarray(pos, np.int64).copy()
+        if self._tids.size > max(1024, self._bids.size >> 3):
+            self._fold_tail()
+
+    def _fold_tail(self):
+        """Merge the sorted tail into the sorted base (arrays REBOUND,
+        never mutated in place, so previously handed-out views stay
+        stable)."""
+        if not self._tids.size:
+            return
+        j = np.searchsorted(self._bids, self._tids)
+        self._bids = np.insert(self._bids, j, self._tids)
+        self._bpos = np.insert(self._bpos, j, self._tpos)
+        self._tids = np.empty(0, np.int64)
+        self._tpos = np.empty(0, np.int64)
+
+    def sorted_items(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(ids, positions) with ids ascending — the checkpoint-export
+        order (folds the tail into the base first)."""
+        self._fold_tail()
+        return self._bids, self._bpos
+
+
 class _MemoryShard:
     """One vocab shard: an id -> arena-row index plus growable arenas for
     the rows and each optimizer slot.  Not thread-safe on its own — the
-    owning table serializes access."""
+    owning table serializes access.  ``index`` is an :class:`_IdMap`
+    (vectorized impl) or a plain dict (the reference oracle impl)."""
 
-    def __init__(self, dim: int, slot_names: Tuple[str, ...], dtype):
+    def __init__(self, dim: int, slot_names: Tuple[str, ...], dtype,
+                 use_dict_index: bool = False):
         self.dim = int(dim)
         self.dtype = np.dtype(dtype)
-        self.index: Dict[int, int] = {}
+        self.index = {} if use_dict_index else _IdMap()
         self.n = 0
         self._cap = 0
         self.rows = np.empty((0, self.dim), self.dtype)
@@ -122,8 +209,13 @@ class _MemoryShard:
                 arr[sl] = slots[s]
             else:
                 arr[sl] = 0
-        for j, i in enumerate(ids.tolist()):
-            self.index[int(i)] = self.n + j
+        if isinstance(self.index, dict):
+            for j, i in enumerate(ids.tolist()):
+                self.index[int(i)] = self.n + j
+        else:
+            self.index.insert(np.asarray(ids, np.int64),
+                              np.arange(self.n, self.n + k,
+                                        dtype=np.int64))
         self.n += k
 
     def clear(self):
@@ -137,12 +229,14 @@ class _MmapShard(_MemoryShard):
     capacity (amortized, like the in-memory arena)."""
 
     def __init__(self, dim: int, slot_names: Tuple[str, ...], dtype,
-                 spool_dir: str, shard_id: int):
+                 spool_dir: str, shard_id: int,
+                 use_dict_index: bool = False):
         self._spool_dir = spool_dir
         self._shard_id = int(shard_id)
         self._gen = 0
         os.makedirs(spool_dir, exist_ok=True)
-        super().__init__(dim, slot_names, dtype)
+        super().__init__(dim, slot_names, dtype,
+                         use_dict_index=use_dict_index)
 
     def _path(self, tag: str) -> str:
         return os.path.join(self._spool_dir,
@@ -188,6 +282,13 @@ class SparseTable:
       parity path), or a callable ``f(id) -> row``.
     * ``storage`` — ``"memory"`` (numpy arenas) or ``"mmap"``
       (memmap spool files under ``storage_dir``) for beyond-RAM vocabs.
+    * ``impl`` — ``"vectorized"`` (batched Philox lazy init +
+      searchsorted id map, the default) or ``"reference"`` (the scalar
+      per-row/dict-index oracle: per-id Philox Generators, dict
+      lookups).  Both produce BIT-identical rows, slots, and checkpoint
+      bytes (tests/test_sparse_vectorized.py); the reference impl is
+      kept for the oracle tests and the scalar arm of the
+      benchmark/ctr.py paired A/B.
     """
 
     def __init__(self, name: str, vocab_size: int, dim: int, *,
@@ -197,7 +298,8 @@ class SparseTable:
                  initializer=None, init_scale: float = 0.05,
                  seed: int = 0,
                  storage: str = "memory",
-                 storage_dir: Optional[str] = None):
+                 storage_dir: Optional[str] = None,
+                 impl: str = "vectorized"):
         if not name:
             raise ValueError("SparseTable: name must be non-empty")
         if vocab_size < 1 or dim < 1:
@@ -224,9 +326,16 @@ class SparseTable:
         self._init = self._normalize_init(initializer, init_scale)
         self._lock = threading.RLock()
         self.slot_names = _OPTIMIZER_SLOTS[optimizer]
+        if impl not in ("vectorized", "reference"):
+            raise ValueError(
+                f"SparseTable {name!r}: impl must be 'vectorized' or "
+                f"'reference', got {impl!r}")
+        self.impl = impl
+        use_dict = impl == "reference"
         if storage == "memory":
             self._shards: List[_MemoryShard] = [
-                _MemoryShard(self.dim, self.slot_names, self.dtype)
+                _MemoryShard(self.dim, self.slot_names, self.dtype,
+                             use_dict_index=use_dict)
                 for _ in range(self.num_shards)]
         elif storage == "mmap":
             if not storage_dir:
@@ -235,16 +344,22 @@ class SparseTable:
                     f"storage_dir")
             self._shards = [
                 _MmapShard(self.dim, self.slot_names, self.dtype,
-                           os.path.join(storage_dir, self.name), k)
+                           os.path.join(storage_dir, self.name), k,
+                           use_dict_index=use_dict)
                 for k in range(self.num_shards)]
         else:
             raise ValueError(
                 f"SparseTable {name!r}: storage must be 'memory' or "
                 f"'mmap', got {storage!r}")
         self.storage = storage
-        # counters (plain ints: always maintained; the session mirrors
-        # them into the observability registry when observing)
+        # counters (plain ints/floats: always maintained; the session
+        # mirrors them into the observability registry when observing).
+        # last_init is an atomically-rebound (rows, seconds) tuple of
+        # the most recent lazy-init batch — the race-free source for
+        # the init-rate gauge under concurrent session workers.
         self.rows_initialized = 0
+        self.init_seconds = 0.0
+        self.last_init = None
 
     # -- init ---------------------------------------------------------------
     @staticmethod
@@ -268,7 +383,9 @@ class SparseTable:
             f"(uniform/constant/dense/callable)")
 
     def _init_rows(self, ids: np.ndarray) -> np.ndarray:
-        """Deterministic per-(seed, id) lazy row values for new ids."""
+        """Deterministic per-(seed, id) lazy row values for new ids —
+        one batched Philox draw over all of them (bit-identical to the
+        per-id :meth:`_reference_init_rows` oracle)."""
         kind = self._init[0]
         k = len(ids)
         if kind == "constant":
@@ -281,12 +398,25 @@ class SparseTable:
                     f"{dense.shape} != (vocab={self.vocab_size}, "
                     f"dim={self.dim})")
             return dense[ids].astype(self.dtype, copy=True)
-        out = np.empty((k, self.dim), self.dtype)
         if kind == "callable":
+            out = np.empty((k, self.dim), self.dtype)
             fn = self._init[1]
             for j, i in enumerate(ids.tolist()):
                 out[j] = np.asarray(fn(int(i)), self.dtype)
             return out
+        _, low, high = self._init
+        return philox_uniform_rows(self.seed, ids, self.dim, low,
+                                   high).astype(self.dtype)
+
+    def _reference_init_rows(self, ids: np.ndarray) -> np.ndarray:
+        """Original scalar lazy init, kept as the oracle for the
+        batched-Philox bit-identity tests and the scalar arm of the
+        benchmark/ctr.py A/B (the `_pad_rows_reference` convention)."""
+        kind = self._init[0]
+        k = len(ids)
+        if kind in ("constant", "dense", "callable"):
+            return self._init_rows(ids)       # identical in both impls
+        out = np.empty((k, self.dim), self.dtype)
         _, low, high = self._init
         for j, i in enumerate(ids.tolist()):
             # counter-based generator keyed by (seed, id): touch-order-
@@ -323,14 +453,73 @@ class SparseTable:
                 yield k, sel, live[sel]
 
     def _ensure_rows(self, shard: _MemoryShard, sids: np.ndarray):
-        """Lazily materialize rows for any of ``sids`` not yet present."""
+        """Reference-impl lazy materialization: per-shard missing scan
+        against the dict index + the scalar per-id init oracle."""
         missing = np.array([i for i in sids.tolist()
                             if int(i) not in shard.index], np.int64)
         if missing.size == 0:
             return
         missing = np.unique(missing)
-        shard.insert(missing, self._init_rows(missing))
+        t0 = time.perf_counter()
+        shard.insert(missing, self._reference_init_rows(missing))
+        dt = time.perf_counter() - t0
+        self.init_seconds += dt
         self.rows_initialized += int(missing.size)
+        self.last_init = (int(missing.size), dt)
+
+    def _lookup_ensure(self, live: np.ndarray):
+        """Vectorized per-batch resolution: ONE shard partition + ONE
+        id-map lookup for the whole batch, with every missing row
+        materialized by ONE batched Philox call (per-call kernel
+        overhead paid once per batch, not once per shard — and the
+        insert offsets patch the positions in place, so present+new
+        rows gather without a second lookup).  Slicing one batched draw
+        per shard is bit-identical to per-shard draws (rows are
+        independent per id).  Returns ``[(shard_idx, sel, positions)]``
+        with ``sel`` indexing into ``live``."""
+        parts = []
+        missing = []                 # (part_idx, sorted-unique miss ids)
+        for k, sel, sids in self._by_shard(live):
+            pos = self._shards[k].index.lookup(sids)
+            parts.append((k, sel, sids, pos))
+            if (pos < 0).any():
+                missing.append((len(parts) - 1,
+                                np.unique(sids[pos < 0])))
+        if missing:
+            t0 = time.perf_counter()
+            rows = self._init_rows(np.concatenate(
+                [m for _, m in missing]))
+            off = 0
+            for pi, miss in missing:
+                k, _sel, sids, pos = parts[pi]
+                shard = self._shards[k]
+                n0 = shard.n         # miss[j] lands at arena n0 + j
+                shard.insert(miss, rows[off:off + len(miss)])
+                off += len(miss)
+                neg = pos < 0
+                pos[neg] = n0 + np.searchsorted(miss, sids[neg])
+            dt = time.perf_counter() - t0
+            self.init_seconds += dt
+            self.rows_initialized += off
+            self.last_init = (off, dt)
+        return [(k, sel, pos) for k, sel, _sids, pos in parts]
+
+    def _reference_parts(self, live: np.ndarray):
+        """Reference-impl form of :meth:`_lookup_ensure`: per-shard
+        scalar ensure + per-id dict gathers (the oracle's cost shape)."""
+        out = []
+        for k, sel, sids in self._by_shard(live):
+            shard = self._shards[k]
+            self._ensure_rows(shard, sids)
+            out.append((k, sel, np.fromiter(
+                (shard.index[int(i)] for i in sids.tolist()),
+                np.int64, len(sids))))
+        return out
+
+    def _parts(self, live: np.ndarray):
+        if self.impl == "reference":
+            return self._reference_parts(live)
+        return self._lookup_ensure(live)
 
     # -- pull/push ----------------------------------------------------------
     def pull(self, ids) -> np.ndarray:
@@ -343,13 +532,8 @@ class SparseTable:
             self._validate(ids, "pull ids")
             live_sel = np.nonzero(ids != PAD_ID)[0]
             live = ids[live_sel]
-            for k, sel, sids in self._by_shard(live):
-                shard = self._shards[k]
-                self._ensure_rows(shard, sids)
-                rows_idx = np.fromiter(
-                    (shard.index[int(i)] for i in sids.tolist()),
-                    np.int64, len(sids))
-                out[live_sel[sel]] = shard.rows[rows_idx]
+            for k, sel, rows_idx in self._parts(live):
+                out[live_sel[sel]] = self._shards[k].rows[rows_idx]
         return out
 
     def pull_slot(self, slot: str, ids) -> np.ndarray:
@@ -363,10 +547,16 @@ class SparseTable:
             for k, sel, sids in self._by_shard(live):
                 shard = self._shards[k]
                 arr = shard.slots[slot]
-                for j, i in zip(sel.tolist(), sids.tolist()):
-                    pos = shard.index.get(int(i))
-                    if pos is not None:
-                        out[live_sel[j]] = arr[pos]
+                if self.impl == "reference":
+                    for j, i in zip(sel.tolist(), sids.tolist()):
+                        pos = shard.index.get(int(i))
+                        if pos is not None:
+                            out[live_sel[j]] = arr[pos]
+                else:
+                    pos = shard.index.lookup(sids)
+                    have = pos >= 0
+                    if have.any():
+                        out[live_sel[sel[have]]] = arr[pos[have]]
         return out
 
     def push(self, ids, grad_rows, *, learning_rate: Optional[float] = None
@@ -399,12 +589,8 @@ class SparseTable:
                     f"duplicate rows would double-apply")
             live_sel = np.nonzero(ids != PAD_ID)[0]
             live = ids[live_sel]
-            for k, sel, sids in self._by_shard(live):
+            for k, sel, rows_idx in self._parts(live):
                 shard = self._shards[k]
-                self._ensure_rows(shard, sids)
-                rows_idx = np.fromiter(
-                    (shard.index[int(i)] for i in sids.tolist()),
-                    np.int64, len(sids))
                 g = grads[live_sel[sel]]
                 p = shard.rows[rows_idx]
                 # Mirrors the device optimizer-op lowerings
@@ -433,7 +619,7 @@ class SparseTable:
                     shard.rows[rows_idx] = \
                         p - lr * g / (np.sqrt(m) + self.dtype.type(
                             self.epsilon))
-                updated += len(sids)
+                updated += len(rows_idx)
         return updated
 
     # -- inspection ---------------------------------------------------------
@@ -478,9 +664,17 @@ class SparseTable:
                 json.dumps(self._meta(), sort_keys=True).encode("utf-8"),
                 dtype=np.uint8).copy()
             for k, shard in enumerate(self._shards):
-                ids = np.array(sorted(shard.index), np.int64)
-                pos = np.fromiter((shard.index[int(i)] for i in ids),
-                                  np.int64, len(ids))
+                if self.impl == "reference":
+                    ids = np.array(sorted(shard.index), np.int64)
+                    pos = np.fromiter((shard.index[int(i)] for i in ids),
+                                      np.int64, len(ids))
+                else:
+                    ids, pos = shard.index.sorted_items()
+                    # same aliasing guarantee as the reference branch:
+                    # the exported array must never be a live view of
+                    # the id map (a consumer mutating it would corrupt
+                    # the index)
+                    ids = ids.copy()
                 out[f"{prefix}/shard{k}/ids"] = ids
                 out[f"{prefix}/shard{k}/rows"] = \
                     shard.rows[pos].copy() if len(ids) else \
@@ -568,7 +762,8 @@ class SparseTable:
     @classmethod
     def load(cls, dirname: str, *, num_shards: Optional[int] = None,
              storage: str = "memory",
-             storage_dir: Optional[str] = None) -> "SparseTable":
+             storage_dir: Optional[str] = None,
+             impl: str = "vectorized") -> "SparseTable":
         with open(os.path.join(dirname, "meta.json")) as fh:
             meta = json.load(fh)
         table = cls(meta["name"], meta["vocab_size"], meta["dim"],
@@ -576,7 +771,7 @@ class SparseTable:
                     learning_rate=meta["learning_rate"],
                     epsilon=meta["epsilon"], seed=meta["seed"],
                     num_shards=num_shards or meta["num_shards_at_save"],
-                    storage=storage, storage_dir=storage_dir)
+                    storage=storage, storage_dir=storage_dir, impl=impl)
         prefix = f"{_STATE_PREFIX}/{meta['name']}"
         state: Dict[str, np.ndarray] = {
             f"{prefix}/meta": np.frombuffer(

@@ -194,13 +194,21 @@ class SGD:
         loop calls ``prepare_feed`` (id dedup → host pull → rows/inverse
         feed injection) before the dispatch and ``complete`` with the
         fetched ``<rows>@GRAD`` arrays after it (the host-side sparse
-        optimizer push).  The per-batch path is fully synchronous —
-        pull → step → push, the semantics the dense-parity test pins
-        bit-identical; the chunked (``steps_per_dispatch > 1``) and
-        ``pipeline`` paths pull up to a dispatch-chunk (plus prefetch
-        depth) ahead of the pushes — bounded-staleness ASYNC updates,
-        the reference's async-pserver SGD semantics.  With
-        ``checkpoint_dir`` the session's tables ride inside every
+        optimizer push).  The per-batch path is fully synchronous by
+        default — pull → step → push, the semantics the dense-parity
+        test pins bit-identical; the chunked (``steps_per_dispatch >
+        1``) and ``pipeline`` paths pull up to a dispatch-chunk (plus
+        prefetch depth) ahead of the pushes — bounded-staleness ASYNC
+        updates, the reference's async-pserver SGD semantics.  A
+        session with ``prefetch_depth > 0`` additionally overlaps: all
+        three paths route raw feeds through ``prefetch_feeds`` so batch
+        N+1's host pulls run on the session's worker while batch N
+        dispatches (``BeginIteration`` then fires after its batch's
+        feed was prepared — preparation is ahead of the loop by
+        design), and a session with ``async_push > 0`` applies pushes
+        on a session worker with ``flush()`` barriers at every
+        checkpoint export, every ``test()`` pull, and train() end.
+        With ``checkpoint_dir`` the session's tables ride inside every
         checkpoint (``Checkpointer(state_vars=...)``) and restore on
         ``resume``.  Not combinable with ``elastic`` or ``warmup``.
         """
@@ -447,20 +455,38 @@ class SGD:
                         else r
                     feed_iter = (feeder.feed(b) for b in src())
                     if sess is not None:
-                        # pulls run on the staging thread up to
-                        # K*prefetch_depth batches ahead of the pushes:
-                        # bounded-staleness async updates (see docstring)
-                        feed_iter = (sess.prepare_feed(f)
-                                     for f in feed_iter)
-                    for batch_id, out in enumerate(self.exe.run_pipelined(
-                            feed_iter, self.main_program,
-                            fetch_list=sfetch,
-                            steps_per_dispatch=K, prefetch_depth=depth),
-                            start=skip):
-                        out = finish(out)
-                        event_handler(events.BeginIteration(pass_id, batch_id))
-                        emit_end(pass_id, batch_id, out)
+                        # pulls run ahead of the pushes (the staging
+                        # thread — plus the session's own pull-ahead
+                        # worker when prefetch_depth > 0): bounded-
+                        # staleness async updates (see docstring)
+                        if getattr(sess, "prefetch_depth", 0) > 0:
+                            feed_iter = sess.prefetch_feeds(feed_iter)
+                        else:
+                            feed_iter = (sess.prepare_feed(f)
+                                         for f in feed_iter)
+                    gen = self.exe.run_pipelined(
+                        feed_iter, self.main_program, fetch_list=sfetch,
+                        steps_per_dispatch=K, prefetch_depth=depth)
+                    try:
+                        for batch_id, out in enumerate(gen, start=skip):
+                            out = finish(out)
+                            event_handler(events.BeginIteration(pass_id,
+                                                                batch_id))
+                            emit_end(pass_id, batch_id, out)
+                    finally:
+                        # a mid-pass failure must deterministically stop
+                        # the whole feed chain, not wait for GC: close
+                        # the pipelined generator FIRST (its contract
+                        # stops and joins the staging worker that may be
+                        # executing feed_iter right now — closing
+                        # feed_iter before that join would race a
+                        # running generator), then the feed source (the
+                        # session's pull-ahead worker, when prefetching)
+                        gen.close()
+                        feed_iter.close()
                     event_handler(events.EndPass(pass_id))
+                if sess is not None and hasattr(sess, "flush"):
+                    sess.flush()     # async-push barrier at train end
                 if ckpt is not None:
                     ckpt.final_save(num_passes)
                 return
@@ -490,7 +516,27 @@ class SGD:
                 if ckpt is not None:
                     ckpt.resync()
                 r, skip = pass_reader(pass_id)
+                sess_prefetch = sess is not None and \
+                    getattr(sess, "prefetch_depth", 0) > 0
                 if steps_per_dispatch <= 1:
+                    if sess_prefetch:
+                        # pull-ahead rim: batch N+1's host pulls run on
+                        # the session worker while batch N dispatches
+                        feeds = sess.prefetch_feeds(
+                            feeder.feed(b) for b in r())
+                        try:
+                            for batch_id, feed in enumerate(feeds,
+                                                            start=skip):
+                                event_handler(events.BeginIteration(
+                                    pass_id, batch_id))
+                                out = finish(self.exe.run(
+                                    self.main_program, feed=feed,
+                                    fetch_list=sfetch))
+                                emit_end(pass_id, batch_id, out)
+                        finally:
+                            feeds.close()
+                        event_handler(events.EndPass(pass_id))
+                        continue
                     for batch_id, batch in enumerate(r(), start=skip):
                         event_handler(events.BeginIteration(pass_id, batch_id))
                         feed = feeder.feed(batch)
@@ -503,28 +549,39 @@ class SGD:
                         emit_end(pass_id, batch_id, out)
                     event_handler(events.EndPass(pass_id))
                     continue
+                if sess is None:
+                    feed_src = (feeder.feed(b) for b in r())
+                elif sess_prefetch:
+                    # pull-ahead rim over the chunked path
+                    feed_src = sess.prefetch_feeds(
+                        feeder.feed(b) for b in r())
+                else:
+                    # chunk-granular staleness: all K pulls precede
+                    # the chunk's dispatch (async-pserver semantics)
+                    feed_src = (sess.prepare_feed(feeder.feed(b))
+                                for b in r())
                 chunk, first_id, sig = [], 0, None
-                for batch_id, batch in enumerate(r(), start=skip):
-                    feed = feeder.feed(batch)
-                    if sess is not None:
-                        # chunk-granular staleness: all K pulls precede
-                        # the chunk's dispatch (async-pserver semantics)
-                        feed = sess.prepare_feed(feed)
-                    fsig = tuple(sorted(
-                        (k, np.shape(v), str(np.asarray(v).dtype))
-                        for k, v in feed.items()))
-                    if chunk and fsig != sig:
+                try:
+                    for batch_id, feed in enumerate(feed_src, start=skip):
+                        fsig = tuple(sorted(
+                            (k, np.shape(v), str(np.asarray(v).dtype))
+                            for k, v in feed.items()))
+                        if chunk and fsig != sig:
+                            flush(pass_id, first_id, chunk)
+                            chunk = []
+                        if not chunk:
+                            first_id, sig = batch_id, fsig
+                        chunk.append(feed)
+                        if len(chunk) == steps_per_dispatch:
+                            flush(pass_id, first_id, chunk)
+                            chunk = []
+                    if chunk:
                         flush(pass_id, first_id, chunk)
-                        chunk = []
-                    if not chunk:
-                        first_id, sig = batch_id, fsig
-                    chunk.append(feed)
-                    if len(chunk) == steps_per_dispatch:
-                        flush(pass_id, first_id, chunk)
-                        chunk = []
-                if chunk:
-                    flush(pass_id, first_id, chunk)
+                finally:
+                    feed_src.close()
                 event_handler(events.EndPass(pass_id))
+            if sess is not None and hasattr(sess, "flush"):
+                sess.flush()         # async-push barrier at train end
             if ckpt is not None:
                 ckpt.final_save(num_passes)
             if elastic is not None:

@@ -161,6 +161,105 @@ def _target_serving_batcher(smoke: bool) -> Callable[[dict], None]:
     return measure
 
 
+def _sparse_fixture(smoke: bool, **session_kw):
+    """Shared sparse-target fixture: a one-table sparse program, a zipf
+    feed list, and a session factory (fresh session per config so knob
+    changes take effect; the TABLE persists so only the first config
+    pays cold-row init)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from ..sparse import SparseSession, SparseTable
+
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    pt.unique_name.reset()
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="float32")
+    emb = layers.embedding(ids, size=[20_000, 16], sparse=True,
+                           name="tune_tbl")
+    fc = layers.fc(emb, size=16, act="relu")
+    loss = layers.mean(layers.square(layers.fc(fc, size=1) - label))
+    pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    program = pt.default_main_program()
+    table = SparseTable("tune_tbl", 20_000, 16, num_shards=4, seed=1,
+                        learning_rate=0.05)
+
+    rng = np.random.RandomState(0)
+    B = 64 if smoke else 256
+    n = 6 if smoke else 32
+    draws = rng.zipf(1.3, size=(n, B, 1)).astype(np.int64)
+    feeds = [{"ids": (draws[i] - 1) % 20_000,
+              "label": rng.rand(B, 1).astype(np.float32)}
+             for i in range(n)]
+
+    def make_session(**kw):
+        merged = dict(session_kw)
+        merged.update(kw)
+        s = SparseSession(table, bucket_floor=B, **merged)
+        s.bind(program)
+        return s
+    return program, table, feeds, make_session
+
+
+def _target_sparse_hot_rows(smoke: bool) -> Callable[[dict], None]:
+    """Hot-rows LRU capacity on serving-style read-only zipf traffic —
+    the cache-first pull loop the capacity knob bounds."""
+    _, _, feeds, make_session = _sparse_fixture(smoke)
+
+    def measure(cfg: dict):
+        sess = make_session(cache_rows=cfg["cache_rows"])
+        for f in feeds:
+            sess.prepare_feed(f, is_test=True)
+    return measure
+
+
+def _target_sparse_prefetch(smoke: bool) -> Callable[[dict], None]:
+    """Pull-ahead depth on a REAL training loop (pull -> dispatch ->
+    push): the overlap only pays when the host has parallelism to
+    spare, which is exactly what the paired gate decides."""
+    import paddle_tpu as pt
+
+    program, _, feeds, make_session = _sparse_fixture(smoke)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    loss_name = [op.output("Out")[0] for b in program.blocks
+                 for op in b.ops if op.type == "mean"][-1]
+
+    def measure(cfg: dict):
+        sess = make_session(prefetch_depth=cfg["depth"])
+        fetch = [loss_name] + sess.grad_fetch_list
+        feed_it = sess.prefetch_feeds(iter(feeds))
+        try:
+            for feed in feed_it:
+                out = exe.run(program, feed=feed, fetch_list=fetch)
+                sess.complete(out[1:])
+        finally:
+            feed_it.close()
+        sess.flush()
+    return measure
+
+
+def _target_sparse_push_flush(smoke: bool) -> Callable[[dict], None]:
+    """Async-push drain size on a push-only loop (prepare + complete,
+    no dispatch): isolates the worker wakeup/lock amortization the
+    knob exists for."""
+    _, table, feeds, make_session = _sparse_fixture(smoke)
+    rng = np.random.RandomState(1)
+    grads = {}
+
+    def measure(cfg: dict):
+        sess = make_session(async_push=8,
+                            push_flush_batch=cfg["batch"])
+        for f in feeds:
+            prepared = sess.prepare_feed(f)
+            shape = prepared["tune_tbl@ROWS"].shape
+            if shape not in grads:
+                grads[shape] = rng.randn(*shape).astype(np.float32)
+            sess.complete([grads[shape]])
+        sess.flush()
+    return measure
+
+
 # ---------------------------------------------------------------------------
 # Device-side targets (reached only with the accelerator present;
 # search.tune returns the pending-hardware stub on CPU)
@@ -287,6 +386,9 @@ TARGETS: Dict[str, Callable[[bool], Callable[[dict], None]]] = {
     "executor/run_pipelined": _target_run_pipelined,
     "reader/prefetch": _target_reader_prefetch,
     "serving/batcher": _target_serving_batcher,
+    "sparse/hot_rows": _target_sparse_hot_rows,
+    "sparse/prefetch": _target_sparse_prefetch,
+    "sparse/push_flush": _target_sparse_push_flush,
     "pallas/flash_attention": _target_flash_blocks,
     "pallas/conv1x1_blocks": _target_conv1x1_blocks,
     "xla/scoped_vmem_limit_kib": _target_scoped_vmem,
@@ -294,9 +396,13 @@ TARGETS: Dict[str, Callable[[bool], Callable[[dict], None]]] = {
 
 
 #: target name -> module whose import registers the tunable (lazily
-#: imported subsystems: serving, the flag-gated Pallas conv kernels)
+#: imported subsystems: serving, the sparse parameter server, the
+#: flag-gated Pallas conv kernels)
 _REGISTERING_MODULE = {
     "serving/batcher": "paddle_tpu.serving.server",
+    "sparse/hot_rows": "paddle_tpu.sparse.session",
+    "sparse/prefetch": "paddle_tpu.sparse.session",
+    "sparse/push_flush": "paddle_tpu.sparse.session",
     "pallas/conv1x1_blocks": "paddle_tpu.ops.pallas_conv",
 }
 

@@ -45,6 +45,20 @@ def test_ctr_smoke_row_complete():
     doc = row["doctor"]
     assert doc and "error" not in doc, doc
     assert doc["within_tolerance"] is True
+    # ISSUE 15: the scalar-vs-vectorized paired A/B rides the row with
+    # raw windows (evidence committed whether accepted or refused) and
+    # the steady arms must finish BYTE-identical — the A/B compares the
+    # same training run, not two different ones
+    ab = row["vectorization_ab"]
+    for arm in ("steady", "cold_init", "overlap"):
+        r = ab[arm]
+        assert len(r["pair_ratios"]) >= 2
+        assert len(r["default_windows"]) == len(r["candidate_windows"])
+        assert r["accepted"] in (True, False)
+        if not r["accepted"]:
+            assert r["refusal_reason"]
+    assert ab["steady"]["arms_bit_identical"] is True
+    assert ab["steady"]["min_speedup"] == 1.5    # the acceptance bar
 
 
 def test_committed_results_structure():
@@ -65,6 +79,16 @@ def test_committed_results_structure():
     assert cpu["doctor"]["within_tolerance"] is True
     assert data["tpu"]["status"] == "pending-hardware"
     assert "legacy_r04_dense_optimizer_sweep" in data
+    # round-15 acceptance: the committed steady A/B either clears the
+    # 1.5x bar or records an explicit noise-gate refusal WITH raw
+    # windows; the committed doctor budget must reconcile
+    ab = data["cpu"]["vectorization_ab"]
+    steady = ab["steady"]
+    assert steady["accepted"] or steady["refusal_reason"]
+    assert steady["default_windows"] and steady["candidate_windows"]
+    assert steady["arms_bit_identical"] is True
+    assert ab["cold_init"]["pair_ratios"]
+    assert data["cpu"]["doctor"]["budget_gap_frac"] <= 0.15
 
 
 @pytest.mark.slow

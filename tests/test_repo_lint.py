@@ -318,8 +318,11 @@ def test_tuning_package_only_imported_lazily():
         f"never opt in never load the autotuner"
         for rel, lineno in _top_level_package_imports("tuning")]
     assert not problems, "\n".join(problems)
-    # and the sanctioned lazy replay site exists (executor._tuned)
-    with open(os.path.join(ROOT, "core", "executor.py")) as fh:
+    # and the ONE sanctioned lazy replay site exists: the shared
+    # core.registry.resolve_tuned helper every call site (executor,
+    # reader, serving, flash-attention layer, sparse session) now
+    # routes through (round-15 dedup of the per-module copies)
+    with open(os.path.join(ROOT, "core", "registry.py")) as fh:
         assert "from ..tuning.store import tuned" in fh.read()
 
 
@@ -388,6 +391,7 @@ def test_tunable_registry_matches_ast_scan():
     # surface the lazily-imported declarations so live is maximal
     importlib.import_module("paddle_tpu.serving.server")
     importlib.import_module("paddle_tpu.ops.pallas_conv")
+    importlib.import_module("paddle_tpu.sparse.session")
 
     ast_names = {n for n, _, _ in _registered_names("register_tunable")}
     live = set(registered_tunables())
@@ -397,11 +401,18 @@ def test_tunable_registry_matches_ast_scan():
         f"(dynamic name construction defeats the duplicate gate): "
         f"{sorted(missing)}")
     assert live >= {"executor/run_pipelined", "reader/prefetch",
-                    "serving/batcher", "pallas/flash_attention",
+                    "serving/batcher", "sparse/hot_rows",
+                    "sparse/prefetch", "sparse/push_flush",
+                    "pallas/flash_attention",
                     "pallas/conv1x1_blocks", "xla/scoped_vmem_limit_kib",
                     "pallas/fused_optimizer_update",
                     "pallas/lod_gather_scatter"}, \
         f"expected initial tunable coverage missing: {sorted(live)}"
+    # the sparse session knobs are HOST-side (measurable in-container,
+    # ISSUE 15): they must never ship as pending-hardware stubs
+    from paddle_tpu.core.registry import get_tunable as _gt
+    for n in ("sparse/hot_rows", "sparse/prefetch", "sparse/push_flush"):
+        assert _gt(n)["side"] == "host" and not _gt(n)["pending_hardware"]
     # device-side entries must carry their pre-registered decision rule
     from paddle_tpu.core.registry import get_tunable
     for n in live:

@@ -1,0 +1,158 @@
+"""Chaos rounds for the sparse overlap legs (ISSUE 15 satellite): REAL
+training processes with pull-ahead prefetch + bounded async push killed
+under load, then relaunched.
+
+The claim pinned here: the flush barrier in the checkpoint-export path
+means a COMMITTED checkpoint contains every acknowledged push — so a
+SIGKILL'd or SIGTERM'd run, relaunched with the identical command,
+converges to a final table state byte-identical to the uninterrupted
+run (batches touch disjoint ids, so the replayed overlap after resume
+is deterministic; a single lost acked push would surface as a stale
+row in the final-state hash).
+
+Subprocess-driven (fresh jax import apiece) => ``@pytest.mark.slow``
+per the PR 6 convention; every subprocess call carries a hard
+``timeout=``.  The fast in-process subset (flush-barrier visibility,
+export atomicity, error propagation) is tier-1 in
+tests/test_sparse_vectorized.py.
+"""
+import hashlib
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.faults import EXIT_PREEMPTED
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUN_TIMEOUT = 180
+
+# 24 disjoint-id batches over a 96-row vocab; Adagrad slots + the per-id
+# Philox lazy init both ride the checkpoint.  After training, the table
+# is saved standalone and DONE printed — the save directory's bytes are
+# the comparison artifact.
+TRAIN_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.sparse import SparseSession, SparseTable
+
+ckpt_dir, table_out = sys.argv[1], sys.argv[2]
+pt.default_main_program().random_seed = 42
+pt.default_startup_program().random_seed = 42
+ids = layers.data("ids", shape=[1], dtype="int64")
+label = layers.data("label", shape=[1], dtype="float32")
+emb = layers.embedding(ids, size=[96, 6], sparse=True, name="tbl")
+fc = layers.fc(emb, size=1)
+loss = layers.mean(layers.square(fc - label))
+opt = pt.optimizer.Adagrad(learning_rate=0.1)
+tr = pt.trainer.SGD(cost=loss, update_equation=opt)
+
+table = SparseTable("tbl", 96, 6, optimizer="adagrad",
+                    learning_rate=0.1, num_shards=3, seed=5)
+sess = SparseSession(table, prefetch_depth=2, async_push=2,
+                     push_flush_batch=2)
+
+def reader():
+    rng = np.random.RandomState(7)
+    for b in range(24):
+        lo = (b * 4) % 96
+        yield [(np.array([lo + j], np.int64),
+                rng.rand(1).astype("float32")) for j in range(4)]
+
+tr.train(reader, num_passes=1, sparse_tables=sess,
+         checkpoint_dir=ckpt_dir, resume=True, save_every_n_steps=4)
+table.save(table_out)
+print("DONE", flush=True)
+"""
+
+
+def _dir_digest(dirname):
+    h = hashlib.sha256()
+    for name in sorted(os.listdir(dirname)):
+        with open(os.path.join(dirname, name), "rb") as fh:
+            h.update(name.encode())
+            h.update(fh.read())
+    return h.hexdigest()
+
+
+def _run(ckpt, out, env_extra=None, timeout=RUN_TIMEOUT):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-c", TRAIN_SCRIPT.format(repo=REPO),
+         str(ckpt), str(out)],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+@pytest.mark.timeout(600)
+def test_sigkill_under_async_push_resumes_bit_identical(tmp_path):
+    # uninterrupted baseline
+    p = _run(tmp_path / "ck_ref", tmp_path / "tbl_ref")
+    assert p.returncode == 0 and "DONE" in p.stdout, p.stderr[-2000:]
+    want = _dir_digest(tmp_path / "tbl_ref")
+
+    # SIGKILL at global batch 14 (mid-pass, after periodic saves, with
+    # pushes possibly still queued on the async worker)
+    p = _run(tmp_path / "ck", tmp_path / "tbl",
+             env_extra={"PADDLE_TPU_FAULT_SPEC": "trainer.step@14=kill"})
+    assert p.returncode == -signal.SIGKILL, (p.returncode,
+                                             p.stderr[-2000:])
+    # identical relaunch resumes from the committed state and finishes
+    p = _run(tmp_path / "ck", tmp_path / "tbl")
+    assert p.returncode == 0 and "DONE" in p.stdout, p.stderr[-2000:]
+    assert _dir_digest(tmp_path / "tbl") == want
+
+
+@pytest.mark.timeout(600)
+def test_sigterm_under_async_push_emergency_commit_then_resume(tmp_path):
+    p = _run(tmp_path / "ck_ref", tmp_path / "tbl_ref")
+    assert p.returncode == 0, p.stderr[-2000:]
+    want = _dir_digest(tmp_path / "tbl_ref")
+
+    # parent-timed SIGTERM mid-run: graceful drain -> emergency
+    # checkpoint (export flush barrier inside) -> exit 75
+    env = dict(os.environ)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", TRAIN_SCRIPT.format(repo=REPO),
+         str(tmp_path / "ck"), str(tmp_path / "tbl")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env)
+    try:
+        deadline = time.monotonic() + RUN_TIMEOUT
+        # wait until at least one periodic checkpoint exists, then kill
+        ck = tmp_path / "ck"
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            if ck.is_dir() and any(ck.iterdir()):
+                break
+            time.sleep(0.05)
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=RUN_TIMEOUT)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+    if proc.returncode == 0:
+        # the run beat the signal: still a valid (if weaker) round —
+        # the artifact must already match
+        assert _dir_digest(tmp_path / "tbl") == want
+        return
+    assert proc.returncode == EXIT_PREEMPTED, (proc.returncode,
+                                               err[-2000:])
+    p = _run(tmp_path / "ck", tmp_path / "tbl")
+    assert p.returncode == 0 and "DONE" in p.stdout, p.stderr[-2000:]
+    assert _dir_digest(tmp_path / "tbl") == want

@@ -170,6 +170,80 @@ def test_async_paths_with_overlapping_ids_train():
         assert got[-1] < got[0]
 
 
+def test_prefetch_and_async_push_match_sync_on_disjoint_ids():
+    """ISSUE 15 leg 3: a session with pull-ahead prefetch + bounded
+    async push must train BIT-identically to the synchronous rim when
+    concurrent batches touch disjoint ids (the same pinned regime as
+    chunk-granular staleness) — on the per-batch, chunked AND pipelined
+    trainer paths."""
+    pools = [np.arange(0, 12), np.arange(12, 24), np.arange(24, 36),
+             np.arange(36, 48)]
+    batches = _batches(n_batches=4, id_pool=pools)
+
+    def run(sess_kw, **train_kw):
+        loss, opt = _build(True, "adagrad")
+        table = SparseTable("tbl", VOCAB, DIM, optimizer="adagrad",
+                            learning_rate=0.1, num_shards=2, seed=5)
+        sess = SparseSession(table, **sess_kw)
+        tr = pt.trainer.SGD(loss, update_equation=opt)
+        got, handler = _collect()
+        tr.train(lambda: iter(batches), num_passes=1,
+                 event_handler=handler, sparse_tables=sess, **train_kw)
+        return got, table, sess
+
+    ref, t_ref, _ = run({})
+    over_kw = {"prefetch_depth": 2, "async_push": 2,
+               "push_flush_batch": 2}
+    runs = [run(over_kw),
+            run(over_kw, steps_per_dispatch=4),
+            run(over_kw, pipeline={"steps_per_dispatch": 2,
+                                   "prefetch_depth": 1,
+                                   "num_workers": 0})]
+    allids = np.arange(VOCAB, dtype=np.int64)
+    for got, table, sess in runs:
+        assert got == ref
+        assert np.array_equal(t_ref.pull(allids), table.pull(allids))
+        assert np.array_equal(t_ref.pull_slot("moment", allids),
+                              table.pull_slot("moment", allids))
+        # trainer flushed at train end: every push applied, none pending
+        assert sess.stats["pushes"] == len(batches)
+        assert sess.pending_batches == 0
+        assert sess.stats["prefetch_hits"] \
+            + sess.stats["prefetch_misses"] == len(batches)
+
+
+def test_checkpoint_resume_with_async_push_and_prefetch(tmp_path):
+    """Kill/resume with the overlap legs ON: export's flush barrier
+    commits every acked push, so the resumed run continues
+    bit-identically (disjoint ids keep the schedule deterministic)."""
+    ck = str(tmp_path / "ck")
+    pools = [np.arange(i * 8, (i + 1) * 8) for i in range(6)]
+    batches = _batches(n_batches=6, id_pool=pools)
+    over_kw = {"prefetch_depth": 2, "async_push": 2}
+
+    def run(num_passes, resume, shards, ckdir):
+        loss, opt = _build(True, "adagrad")
+        table = SparseTable("tbl", VOCAB, DIM, optimizer="adagrad",
+                            learning_rate=0.1, num_shards=shards,
+                            seed=5)
+        sess = SparseSession(table, **over_kw)
+        tr = pt.trainer.SGD(loss, update_equation=opt)
+        got, handler = _collect()
+        kw = dict(checkpoint_dir=ckdir, resume=resume) if ckdir else {}
+        tr.train(lambda: iter(batches), num_passes=num_passes,
+                 event_handler=handler, sparse_tables=sess, **kw)
+        return got, table
+
+    g_full, t_full = run(4, False, 2, None)
+    g1, _ = run(2, False, 2, ck)
+    g2, t_resumed = run(4, True, 5, ck)
+    assert g_full[len(g1):] == g2
+    allids = np.arange(VOCAB, dtype=np.int64)
+    assert np.array_equal(t_full.pull(allids), t_resumed.pull(allids))
+    assert np.array_equal(t_full.pull_slot("moment", allids),
+                          t_resumed.pull_slot("moment", allids))
+
+
 def test_checkpoint_resume_bit_identical_across_shard_change(tmp_path):
     """Kill/resume through the Checkpointer: the table rides inside the
     checkpoint; the resumed run (restoring into a table with a DIFFERENT

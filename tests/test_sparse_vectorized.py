@@ -1,0 +1,378 @@
+"""Vectorized sparse host path vs the scalar oracles (ISSUE 15).
+
+The acceptance pins, all tier-1-fast:
+
+* the batched Philox lazy-init draw is BIT-identical to the per-id
+  ``np.random.Generator(np.random.Philox(key))`` oracle, per element,
+  across seeds (including keys wider than 64 bits), dims (including
+  non-multiples of the 4-lane block) and id sets;
+* ``impl='vectorized'`` tables are BIT-identical to the
+  ``impl='reference'`` dict-index/scalar-loop oracle through randomized
+  interleaved pull/push streams — rows, Adagrad slots, ``pull_slot``,
+  and checkpoint EXPORT BYTES — on memory and mmap storage, and the
+  spec-agnostic checkpoint round-trip crosses both impls and shard
+  counts;
+* the pull-ahead prefetch and bounded-async-push session legs preserve
+  bit-identity when concurrent batches touch disjoint ids (the pinned
+  regime, same as the chunked-staleness contract), enforce the flush
+  barrier on every read path and checkpoint export, propagate worker
+  failures loudly, and never leak threads (conftest fixture).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.sparse import PAD_ID, SparseSession, SparseTable
+from paddle_tpu.sparse.philox import philox_uniform_rows
+from paddle_tpu.sparse.table import _IdMap
+from paddle_tpu.testing import faultinject
+
+
+# ---------------------------------------------------------------------------
+# Leg 1: batched Philox vs the per-id Generator oracle
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("seed", [0, 11, 2**31 - 1, 2**33 + 5])
+def test_philox_batch_bit_identical_to_per_id_oracle(seed, rng):
+    for dim in (1, 3, 4, 7, 16, 33):
+        ids = rng.randint(0, 2**31 - 1, 23).astype(np.int64)
+        batch = philox_uniform_rows(seed, ids, dim, -0.05, 0.05)
+        for j, i in enumerate(ids):
+            g = np.random.Generator(np.random.Philox(
+                key=(seed << 32) ^ (int(i) & 0xFFFFFFFF)))
+            assert np.array_equal(g.uniform(-0.05, 0.05, dim), batch[j]), \
+                f"seed={seed} dim={dim} id={int(i)}"
+
+
+def test_philox_nonuniform_bounds_and_chunking(rng):
+    ids = rng.randint(0, 10**9, 5).astype(np.int64)
+    b = philox_uniform_rows(7, ids, 6, 2.0, 5.0)
+    assert (b >= 2.0).all() and (b < 5.0).all()
+    g = np.random.Generator(np.random.Philox(key=(7 << 32) ^ int(ids[3])))
+    assert np.array_equal(b[3], g.uniform(2.0, 5.0, 6))
+    # the chunked path (> _CHUNK ids) agrees with the oracle spot-checked
+    import paddle_tpu.sparse.philox as ph
+    many = rng.randint(0, 2**31 - 1, ph._CHUNK + 17).astype(np.int64)
+    big = philox_uniform_rows(3, many, 4, 0.0, 1.0)
+    for probe in (0, ph._CHUNK - 1, ph._CHUNK, ph._CHUNK + 16):
+        g = np.random.Generator(np.random.Philox(
+            key=(3 << 32) ^ (int(many[probe]) & 0xFFFFFFFF)))
+        assert np.array_equal(big[probe], g.uniform(0.0, 1.0, 4))
+
+
+def test_table_init_rows_matches_reference_oracle(rng):
+    t = SparseTable("t", 10**6, 9, seed=42)
+    ids = np.unique(rng.randint(0, 10**6, 300).astype(np.int64))
+    assert np.array_equal(t._init_rows(ids), t._reference_init_rows(ids))
+    # non-uniform initializers are the SAME code in both impls
+    for init in (("constant", 0.5), None):
+        t2 = SparseTable("t", 100, 4, seed=1, initializer=init)
+        assert np.array_equal(t2._init_rows(ids % 100),
+                              t2._reference_init_rows(ids % 100))
+
+
+# ---------------------------------------------------------------------------
+# Leg 2: the vectorized id map vs the dict oracle
+# ---------------------------------------------------------------------------
+def test_idmap_agrees_with_dict_through_randomized_inserts(rng):
+    m, d = _IdMap(), {}
+    next_pos = 0
+    for _ in range(40):
+        new = np.unique(rng.randint(0, 5000, rng.randint(1, 400))
+                        .astype(np.int64))
+        new = new[[int(i) not in d for i in new]]
+        pos = np.arange(next_pos, next_pos + len(new), dtype=np.int64)
+        for i, p in zip(new.tolist(), pos.tolist()):
+            d[int(i)] = p
+        m.insert(new, pos)
+        next_pos += len(new)
+        probe = rng.randint(0, 5000, 300).astype(np.int64)
+        got = m.lookup(probe)
+        want = np.array([d.get(int(i), -1) for i in probe], np.int64)
+        assert np.array_equal(got, want)
+        assert len(m) == len(d)
+    ids, pos = m.sorted_items()
+    assert np.array_equal(ids, np.array(sorted(d), np.int64))
+    assert np.array_equal(pos, np.array([d[int(i)] for i in ids],
+                                        np.int64))
+
+
+def test_idmap_unsorted_insert_defensively_sorted():
+    m = _IdMap()
+    m.insert(np.array([5, 1, 9], np.int64), np.array([0, 1, 2], np.int64))
+    assert np.array_equal(m.lookup(np.array([1, 5, 9, 7], np.int64)),
+                          np.array([1, 0, 2, -1], np.int64))
+
+
+# ---------------------------------------------------------------------------
+# Legs 1+2 end to end: whole-table bit-identity vs the reference impl
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("opt", ["sgd", "adagrad"])
+@pytest.mark.parametrize("storage", ["memory", "mmap"])
+def test_table_bit_identity_randomized_stream(opt, storage, rng, tmp_path):
+    kw = dict(optimizer=opt, num_shards=3, seed=9, learning_rate=0.05)
+    if storage == "mmap":
+        kw.update(storage="mmap")
+    vec = SparseTable("t", 800, 7, impl="vectorized",
+                      storage_dir=str(tmp_path / "v"), **kw)
+    ref = SparseTable("t", 800, 7, impl="reference",
+                      storage_dir=str(tmp_path / "r"), **kw)
+    for step in range(25):
+        ids = np.unique(rng.randint(0, 800, 60).astype(np.int64))
+        if step % 3 == 0:        # pad slots ride through pulls
+            ids = np.concatenate([[PAD_ID], ids])
+        assert np.array_equal(vec.pull(ids), ref.pull(ids))
+        g = rng.randn(len(ids), 7).astype(np.float32)
+        assert vec.push(ids, g) == ref.push(ids, g)
+    allids = np.arange(800, dtype=np.int64)
+    assert np.array_equal(vec.pull(allids), ref.pull(allids))
+    if opt == "adagrad":
+        assert np.array_equal(vec.pull_slot("moment", allids),
+                              ref.pull_slot("moment", allids))
+    sv, sr = vec.export_state_vars(), ref.export_state_vars()
+    assert sorted(sv) == sorted(sr)
+    for k in sv:
+        assert sv[k].tobytes() == sr[k].tobytes(), k
+    assert vec.rows_initialized == ref.rows_initialized
+    assert vec.init_seconds > 0 and ref.init_seconds > 0
+
+
+def test_checkpoint_roundtrip_crosses_impls_and_shard_counts(rng,
+                                                             tmp_path):
+    src = SparseTable("t", 300, 5, optimizer="adagrad", num_shards=4,
+                      seed=2, impl="reference")
+    ids = np.unique(rng.randint(0, 300, 80).astype(np.int64))
+    src.push(ids, rng.randn(len(ids), 5).astype(np.float32))
+    state = src.export_state_vars()
+    # reference export restores into a vectorized table under a
+    # DIFFERENT shard count, and back again
+    vec = SparseTable("t", 300, 5, optimizer="adagrad", num_shards=2,
+                      seed=2)
+    vec.restore_state_vars(state)
+    allids = np.arange(300, dtype=np.int64)
+    assert np.array_equal(src.pull(allids), vec.pull(allids))
+    back = SparseTable("t", 300, 5, optimizer="adagrad", num_shards=7,
+                       seed=2, impl="reference")
+    back.restore_state_vars(vec.export_state_vars())
+    assert np.array_equal(src.pull(allids), back.pull(allids))
+    assert np.array_equal(src.pull_slot("moment", allids),
+                          back.pull_slot("moment", allids))
+    # standalone save/load honors the impl choice
+    d = str(tmp_path / "tbl")
+    vec.save(d)
+    loaded = SparseTable.load(d, impl="reference")
+    assert loaded.impl == "reference"
+    assert np.array_equal(loaded.pull(allids), src.pull(allids))
+    with pytest.raises(ValueError, match="impl"):
+        SparseTable("t", 10, 2, impl="nope")
+
+
+# ---------------------------------------------------------------------------
+# Leg 3: prefetch + async push session semantics
+# ---------------------------------------------------------------------------
+def _sparse_program(vocab=96, dim=4):
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="float32")
+    emb = layers.embedding(ids, size=[vocab, dim], sparse=True,
+                           name="tbl")
+    fc = layers.fc(emb, size=1)
+    loss = layers.mean(layers.square(fc - label))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _disjoint_feeds(n=8, per=6, vocab=96):
+    return [{"ids": np.arange(i * per, (i + 1) * per,
+                              dtype=np.int64).reshape(per, 1) % vocab,
+             "label": np.full((per, 1), 0.1 * (i + 1), np.float32)}
+            for i in range(n)]
+
+
+def _drive(sess, feeds, grad_of):
+    """prepare (possibly prefetched) -> complete each batch, flush."""
+    it = sess.prefetch_feeds(iter(feeds))
+    try:
+        for feed in it:
+            sess.complete([grad_of(feed)])
+    finally:
+        it.close()
+    sess.flush()
+
+
+def _grad_of(feed):
+    g = np.zeros((8, 4), np.float32)
+    g[:2] = feed["label"][0, 0]
+    return g
+
+
+def test_prefetch_async_disjoint_bit_identity_and_accounting():
+    _sparse_program()
+    feeds = _disjoint_feeds()
+    allids = np.arange(96, dtype=np.int64)
+
+    t_sync = SparseTable("tbl", 96, 4, seed=3, learning_rate=0.1)
+    sync = SparseSession(t_sync)
+    sync.bind(pt.default_main_program())
+    _drive(sync, feeds, _grad_of)
+    assert sync.stats["prefetch_hits"] + sync.stats["prefetch_misses"] \
+        == 0                                  # depth 0: inline rim
+
+    t_async = SparseTable("tbl", 96, 4, seed=3, learning_rate=0.1)
+    over = SparseSession(t_async, prefetch_depth=2, async_push=3,
+                         push_flush_batch=2)
+    over.bind(pt.default_main_program())
+    _drive(over, feeds, _grad_of)
+    assert np.array_equal(t_sync.pull(allids), t_async.pull(allids))
+    assert over.stats["prefetch_hits"] + over.stats["prefetch_misses"] \
+        == len(feeds)
+    assert over.stats["pushes"] == len(feeds)
+    assert over.stats["push_flushes"] >= 1
+    assert over.pending_batches == 0
+    # async complete acks with None; sync returns the rows count
+    sync.prepare_feed(feeds[0])
+    assert sync.complete([_grad_of(feeds[0])]) > 0
+    over.prepare_feed(feeds[0])
+    assert over.complete([_grad_of(feeds[0])]) is None
+    over.flush()
+
+
+def test_read_paths_and_export_flush_acked_pushes():
+    """The hard barrier: a push ACKNOWLEDGED by complete() is visible to
+    every subsequent read-only prepare_feed and checkpoint export, even
+    while the worker is still lingering."""
+    _sparse_program()
+    t = SparseTable("tbl", 96, 4, learning_rate=1.0,
+                    initializer=("constant", 0.0))
+    sess = SparseSession(t, async_push=4)
+    sess.bind(pt.default_main_program())
+    feed = {"ids": np.array([[1], [2]], np.int64),
+            "label": np.zeros((2, 1), np.float32)}
+    sess.prepare_feed(feed)
+    g = np.zeros((8, 4), np.float32)
+    g[:2] = 1.0
+    sess.complete([g])                        # acked, maybe not applied
+    out = sess.prepare_feed(feed, is_test=True)   # read barrier
+    assert np.array_equal(out["tbl@ROWS"][:2],
+                          np.full((2, 4), -1.0, np.float32))
+    sess.prepare_feed(feed)
+    sess.complete([g])
+    state = sess.export_state_vars()          # checkpoint barrier
+    restored = SparseTable("tbl", 96, 4, learning_rate=1.0,
+                           initializer=("constant", 0.0))
+    restored.restore_state_vars(state)
+    assert np.array_equal(restored.pull(np.array([1, 2], np.int64)),
+                          np.full((2, 4), -2.0, np.float32))
+
+
+def test_async_push_failure_reraised_never_silent():
+    _sparse_program()
+    t = SparseTable("tbl", 96, 4, initializer=("constant", 0.0))
+    sess = SparseSession(t, async_push=2)
+    sess.bind(pt.default_main_program())
+    feed = {"ids": np.array([[5]], np.int64),
+            "label": np.zeros((1, 1), np.float32)}
+    sess.prepare_feed(feed)
+    faultinject.configure("sparse.push@*=drop")
+    try:
+        sess.complete([np.ones((8, 4), np.float32)])   # ack
+        with pytest.raises(ConnectionError):
+            sess.flush()
+    finally:
+        faultinject.clear()
+    # error raised exactly once; the rim is usable again afterwards
+    sess.flush()
+    sess.prepare_feed(feed)
+    sess.complete([np.zeros((8, 4), np.float32)])
+    sess.flush()
+    assert sess.stats["pushes"] == 1
+
+
+def test_prefetch_worker_error_propagates_at_consumer():
+    _sparse_program()
+    sess = SparseSession(SparseTable("tbl", 96, 4), prefetch_depth=2)
+    sess.bind(pt.default_main_program())
+    feeds = _disjoint_feeds(n=3)
+    feeds[1] = {"ids": np.array([[96]], np.int64),   # out of vocab
+                "label": np.zeros((1, 1), np.float32)}
+    it = sess.prefetch_feeds(iter(feeds))
+    next(it)
+    with pytest.raises(ValueError, match="outside the declared vocab"):
+        for _ in it:
+            pass
+
+
+def test_prefetch_close_midstream_joins_worker_and_retracts_pends():
+    """Closing the generator mid-stream joins the worker (conftest
+    fixture asserts no leaks) AND retracts the pending-push ledger
+    entries of batches prepared ahead but never delivered — only the
+    one delivered batch keeps its entry, so a REUSED session's next
+    prepare/complete pair stays aligned with the right unique-id set
+    (the silent-misalignment regression)."""
+    _sparse_program()
+    t = SparseTable("tbl", 96, 4, learning_rate=1.0,
+                    initializer=("constant", 0.0))
+    sess = SparseSession(t, prefetch_depth=2)
+    sess.bind(pt.default_main_program())
+    feeds = _disjoint_feeds(n=8)
+    it = sess.prefetch_feeds(iter(feeds))
+    first = next(it)
+    it.close()
+    # exactly the delivered batch remains pending
+    assert sess.pending_batches == 1
+    sess.complete([_grad_of(first)])
+    # session reuse: a fresh batch's push lands on ITS OWN ids, not a
+    # stale prepared-ahead uid set
+    probe = {"ids": np.array([[90]], np.int64),
+             "label": np.zeros((1, 1), np.float32)}
+    sess.prepare_feed(probe)
+    assert sess.pending_batches == 1
+    g = np.zeros((8, 4), np.float32)
+    g[0] = 1.0                 # unique slot 0 == id 90's row
+    sess.complete([g])
+    assert np.array_equal(t.pull(np.array([90], np.int64)),
+                          np.full((1, 4), -1.0, np.float32))
+
+
+def test_prefetch_spans_cross_thread_parented(tmp_path):
+    """PR 10 convention: the worker's sparse/pull spans parent to the
+    sparse/prefetch root started on the consumer thread."""
+    from paddle_tpu import flags
+    from paddle_tpu.observability import export as obs_export
+
+    log = str(tmp_path / "t.jsonl")
+    _sparse_program()
+    sess = SparseSession(SparseTable("tbl", 96, 4), prefetch_depth=2,
+                         observe=True)
+    sess.bind(pt.default_main_program())
+    prev_obs, prev_log = flags.get_flag("observe"), \
+        flags.get_flag("metrics_log")
+    flags.set_flag("observe", True)
+    flags.set_flag("metrics_log", log)
+    try:
+        for feed in sess.prefetch_feeds(iter(_disjoint_feeds(n=3)),
+                                        is_test=True):
+            pass
+    finally:
+        flags.set_flag("observe", prev_obs)
+        flags.set_flag("metrics_log", prev_log or "")
+        obs_export._reset_writer()
+    events, _ = obs_export.iter_log_events([log])
+    spans = [e for e in events if e.get("kind") == "span"]
+    roots = [e for e in spans if e["name"] == "sparse/prefetch"]
+    pulls = [e for e in spans if e["name"] == "sparse/pull"]
+    assert len(roots) == 1 and len(pulls) == 3
+    for p in pulls:
+        assert p["parent"] == roots[0]["span"]
+        assert p["trace"] == roots[0]["trace"]
+
+
+def test_knob_resolution_defaults_and_explicit_win():
+    _sparse_program()
+    t = SparseTable("tbl", 96, 4)
+    s = SparseSession(t)
+    assert (s.cache.capacity, s.prefetch_depth, s.push_flush_batch,
+            s.async_push) == (0, 0, 1, 0)
+    s2 = SparseSession(t, cache_rows=32, prefetch_depth=4,
+                       push_flush_batch=2, async_push=8)
+    assert (s2.cache.capacity, s2.prefetch_depth, s2.push_flush_batch,
+            s2.async_push) == (32, 4, 2, 8)
