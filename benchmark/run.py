@@ -215,17 +215,27 @@ def run_ctr(smoke=False):
     return [run_all(smoke=smoke)]
 
 
+def run_decode(smoke=False):
+    """Delegate to benchmark/decode.py (continuous-batching KV-cache
+    decode slot pool vs static-batch control: decode tokens/s paired
+    A/B, TTFT/inter-token percentiles, slot occupancy, doctor budget)."""
+    from benchmark.decode import run_all
+    return [run_all(smoke=smoke)]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None,
                     help="model config, 'input_pipeline' for the "
                          "naive-vs-pipelined input A/B, 'compile_cache' "
                          "for the cold-vs-warm startup A/B, 'autotune' "
-                         "for the tuned-vs-default autotuner A/B, or "
-                         "'ctr' for the sparse-parameter-server CTR A/B")
+                         "for the tuned-vs-default autotuner A/B, "
+                         "'ctr' for the sparse-parameter-server CTR A/B, "
+                         "or 'decode' for the continuous-batching "
+                         "incremental-decode A/B")
     ap.add_argument("--smoke", action="store_true",
-                    help="input_pipeline/compile_cache/autotune/ctr "
-                         "only: seconds-fast path check")
+                    help="input_pipeline/compile_cache/autotune/ctr/"
+                         "decode only: seconds-fast path check")
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--iters", type=int, default=None,
                     help="steps per timed window (default: 60 for the "
@@ -252,6 +262,9 @@ def main():
         return
     if args.model == "ctr":
         run_ctr(smoke=args.smoke)
+        return
+    if args.model == "decode":
+        run_decode(smoke=args.smoke)
         return
     if args.all:
         for name, batch in HEADLINE:

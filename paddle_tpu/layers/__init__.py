@@ -15,3 +15,5 @@ from . import detection      # noqa: F401
 from .detection import (prior_box, box_coder, iou_similarity,  # noqa: F401
                         ssd_loss, detection_output)  # noqa: F401
 from .generation import BeamSearchDecoder  # noqa: F401
+from .generation import attention_with_cache  # noqa: F401
+from .generation import get_beam_hook, register_beam_hook  # noqa: F401

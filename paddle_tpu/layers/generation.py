@@ -34,7 +34,34 @@ import itertools
 from ..core import unique_name
 from ..layer_helper import LayerHelper
 
-__all__ = ["BeamSearchDecoder"]
+__all__ = ["BeamSearchDecoder", "attention_with_cache"]
+
+
+def attention_with_cache(q, k, v, cache_k, cache_v, cache_len, write_mask,
+                         scale=0.0, name=None):
+    """Causal attention over fixed-shape KV-cache slabs — the incremental
+    decode building block (ops/generation_ops.py lowering).
+
+    ``q``/``k``/``v``: [B, Tq, D] projections for this dispatch.
+    ``cache_k``/``cache_v``: [B, Tmax, D] PERSISTABLE slab vars; this op
+    appends this dispatch's K/V at each row's ``cache_len`` offset and
+    threads the updated slabs back to the SAME vars, so the executor
+    carries them as donated state across dispatches.  ``cache_len``: [B]
+    int32 valid-token counts (feed — the host scheduler owns lengths).
+    ``write_mask``: [B] float32; rows <= 0 leave their slab untouched.
+    Returns the [B, Tq, D] attention output (same var dtype as ``q``).
+    """
+    helper = LayerHelper("attention_with_cache", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype, q.shape)
+    helper.append_op(
+        type="attention_with_cache",
+        inputs={"Q": [q], "K": [k], "V": [v],
+                "CacheK": [cache_k], "CacheV": [cache_v],
+                "Len": [cache_len], "WriteMask": [write_mask]},
+        outputs={"Out": [out],
+                 "CacheKOut": [cache_k], "CacheVOut": [cache_v]},
+        attrs={"scale": float(scale)})
+    return out
 
 
 # ---------------------------------------------------------------------------

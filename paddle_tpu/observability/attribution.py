@@ -37,7 +37,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 __all__ = [
-    "BUDGET_TOLERANCE", "step_budget", "serving_budget",
+    "BUDGET_TOLERANCE", "step_budget", "serving_budget", "decode_budget",
     "executable_facts", "calibration_row", "save_calibration",
     "save_op_class_calibration", "load_op_class_ratios",
     "doctor_report", "render_doctor",
@@ -263,6 +263,55 @@ def serving_budget(events) -> Optional[dict]:
 
 
 # ---------------------------------------------------------------------------
+# token-step budget (incremental decode path)
+# ---------------------------------------------------------------------------
+def decode_budget(events) -> Optional[dict]:
+    """Decode slot-pool budget over ``serving/decode_step`` spans: the
+    batched token-step dispatch vs the scheduler gap around it, slot
+    occupancy, and token throughput.  None when the log has no decode
+    steps."""
+    steps = [e for e in events if e.get("kind") == "span"
+             and e.get("name") == "serving/decode_step"]
+    if not steps:
+        return None
+    durs = sorted(float(e.get("dur_ms", 0.0)) for e in steps)
+    n = len(durs)
+    actives = [int((e.get("labels") or {}).get("active", 0))
+               for e in steps]
+    disps = [float((e.get("labels") or {}).get("dispatch_ms"))
+             for e in steps
+             if (e.get("labels") or {}).get("dispatch_ms") is not None]
+    tokens = sum(actives)
+    ts = [float(e["ts"]) for e in steps
+          if isinstance(e.get("ts"), (int, float))]
+    wall_s = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    mean = lambda xs: sum(xs) / len(xs) if xs else None   # noqa: E731
+    out = {
+        "steps": n, "tokens": tokens,
+        "active_mean": round(mean(actives), 2),
+        "step_ms_p50": round(durs[n // 2], 3),
+        "step_ms_p99": round(durs[min(n - 1, int(n * 0.99))], 3),
+        "dispatch_ms_mean": round(mean(disps), 3) if disps else None,
+        "tokens_per_s": round(tokens / wall_s, 1) if wall_s > 0 else None,
+    }
+    if out["dispatch_ms_mean"] is not None and out["step_ms_p50"]:
+        dispatch_share = min(1.0, out["dispatch_ms_mean"]
+                             / max(mean(durs), 1e-9))
+        out["top"] = ("dispatch" if dispatch_share >= 0.5 else "scheduler")
+        out["hints"] = [
+            "dispatch {p}%: the per-token-step model call dominates — "
+            "more slots amortize it over more live sequences (`python -m "
+            "paddle_tpu tune serving/decode_slots`)".format(
+                p=round(dispatch_share * 100))
+        ] if out["top"] == "dispatch" else [
+            "scheduler {p}%: host-side admit/evict around the dispatch "
+            "dominates — lower step_wait_ms or batch admissions".format(
+                p=round(100 - dispatch_share * 100))
+        ]
+    return out
+
+
+# ---------------------------------------------------------------------------
 # compiled-executable facts + static-model calibration
 # ---------------------------------------------------------------------------
 def executable_facts(step) -> Optional[dict]:
@@ -423,6 +472,9 @@ def doctor_report(paths, program=None, assume_batch: int = 64,
     sb = serving_budget(events)
     if sb is not None:
         out["serving"] = sb
+    db = decode_budget(events)
+    if db is not None:
+        out["decode"] = db
     stats = tracing.span_stats(events)
     if stats:
         out["span_stats"] = stats
@@ -471,6 +523,19 @@ def render_doctor(report: dict) -> str:
                          f"{b['queue_wait_ms_mean']} ms; model dispatch "
                          f"mean: {b['dispatch_ms_mean']} ms")
         for h in sb.get("hints", []):
+            lines.append(f"  hint: {h}")
+    db = report.get("decode")
+    if db:
+        lines.append(
+            f"decode: {db['tokens']} token(s) in {db['steps']} "
+            f"step(s), mean active {db['active_mean']}, step p50 "
+            f"{db['step_ms_p50']} ms, p99 {db['step_ms_p99']} ms"
+            + (f", {db['tokens_per_s']} tokens/s"
+               if db.get("tokens_per_s") is not None else ""))
+        if db.get("dispatch_ms_mean") is not None:
+            lines.append(f"  step dispatch mean: "
+                         f"{db['dispatch_ms_mean']} ms")
+        for h in db.get("hints", []):
             lines.append(f"  hint: {h}")
     cal = report.get("calibration")
     if cal:

@@ -427,6 +427,30 @@ def summarize_logs(paths) -> dict:
             "states": [str(e.get("state")) for e in servings
                        if e.get("event") == "state"],
         }
+        dec_steps = [e for e in servings if e.get("event") == "decode_step"]
+        dec_done = [e for e in servings if e.get("event") == "decode_done"]
+        if dec_steps or dec_done:
+            active = [int(e.get("active", 0)) for e in dec_steps]
+            sdms = sorted(float(e["dispatch_ms"]) for e in dec_steps
+                          if e.get("dispatch_ms") is not None)
+            ttfts = sorted(float(e["ttft_ms"]) for e in servings
+                           if e.get("event") == "decode_admit"
+                           and e.get("ttft_ms") is not None)
+            summary["decode"] = {
+                "steps": len(dec_steps),
+                "sequences_done": len(dec_done),
+                "tokens": sum(int(e.get("tokens", 0)) for e in dec_done),
+                "active_mean": round(sum(active) / len(active), 2)
+                if active else None,
+                "step_ms_p50": round(sdms[len(sdms) // 2], 3)
+                if sdms else None,
+                "ttft_ms_p50": round(ttfts[len(ttfts) // 2], 3)
+                if ttfts else None,
+                "by_finish": {
+                    f: sum(1 for e in dec_done if e.get("finish") == f)
+                    for f in sorted({str(e.get("finish"))
+                                     for e in dec_done})},
+            }
     if tunings:
         by_event: Dict[str, int] = {}
         for e in tunings:
@@ -510,6 +534,21 @@ def render_summary(summary: dict) -> str:
             f"  shed={sv['shed']} deadline_expired={sv['deadline_expired']}"
             f" breaker_opens={sv['breaker_opens']}"
             + (f" states={'→'.join(sv['states'])}" if sv["states"] else ""))
+    dc = summary.get("decode")
+    if dc:
+        lines.append(
+            f"decode: {dc['tokens']} token(s) across "
+            f"{dc['sequences_done']} sequence(s) in {dc['steps']} "
+            f"step(s)"
+            + (f", mean active {dc['active_mean']}"
+               if dc.get("active_mean") is not None else "")
+            + (f", step p50 {dc['step_ms_p50']} ms"
+               if dc.get("step_ms_p50") is not None else "")
+            + (f", ttft p50 {dc['ttft_ms_p50']} ms"
+               if dc.get("ttft_ms_p50") is not None else ""))
+        if dc.get("by_finish"):
+            lines.append("  finish: " + " ".join(
+                f"{k}={v}" for k, v in sorted(dc["by_finish"].items())))
     tu = summary.get("tuning")
     if tu:
         kinds = " ".join(f"{k}={v}" for k, v in sorted(

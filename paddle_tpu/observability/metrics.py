@@ -114,6 +114,22 @@ METRIC_NAMES = (
      "live (unpadded) requests per dispatched serving batch"),
     ("serving/request_ms", "histogram",
      "admitted-request latency: admission to completed response"),
+    # incremental decode serving (paddle_tpu.serving.decode): the slot
+    # pool is the instrumented subsystem, same rationale as serving/*
+    ("serving/decode_tokens", "counter",
+     "tokens generated by decode slot pools (prefill first-tokens + one "
+     "per live slot per decode step)"),
+    ("serving/decode_tokens_per_s", "gauge",
+     "decode throughput: cumulative generated tokens over pool uptime"),
+    ("serving/decode_ttft_ms", "histogram",
+     "time to first token: request admission to prefill emitting the "
+     "first generated token"),
+    ("serving/decode_inter_token_ms", "histogram",
+     "gap between consecutive generated tokens of one sequence (the "
+     "streaming cadence; its p99 is what continuous batching bounds)"),
+    ("serving/decode_slot_occupancy", "gauge",
+     "live sequences over total slots at the last decode step (padded "
+     "compute fraction is 1 minus this)"),
     ("pipeline/fallback_steps", "counter",
      "run_pipelined steps dispatched through the per-step fallback "
      "(stream tail or padding-bucket signature change) instead of a "
@@ -255,6 +271,8 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
     "serving/queue_depth": _DEPTH_BUCKETS,
     "serving/batch_size": _COUNT_BUCKETS,
     "serving/request_ms": _MS_BUCKETS,
+    "serving/decode_ttft_ms": _MS_BUCKETS,
+    "serving/decode_inter_token_ms": _MS_BUCKETS,
     "tuning/trial_ms": _MS_BUCKETS,
     "http/request_ms": _MS_BUCKETS,
     "opprof/op_ms": _MS_BUCKETS,

@@ -80,6 +80,10 @@ SPAN_NAMES = (
      "(one trace per request; ends with status=ok or the typed error)"),
     ("serving/batch", "one coalesced serving batch: staging pickup -> "
      "dispatch -> reply; labels link member request ids and traces"),
+    ("serving/decode_step", "one token step of a decode slot pool: the "
+     "batched incremental-decode dispatch advancing every live slot by "
+     "one token (retry attempts attach as span events); labels: model, "
+     "active, step"),
     ("http/request", "one HTTP front request: socket read -> backend "
      "submit(s) -> last response byte; labels: method, path, status"),
     ("fleet/autoscale", "one executed autoscaler decision: trigger "

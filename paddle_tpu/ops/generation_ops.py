@@ -144,6 +144,68 @@ def _recurrent_alias(ctx, ins, attrs):
     return get_op_impl("rnn")(ctx, ins, attrs)
 
 
+@register_op("attention_with_cache")
+def _attention_with_cache(ctx, ins, attrs):
+    """Causal attention over a fixed-shape KV-cache slab (the incremental
+    decode-serving op; see serving/decode.py for the runtime around it).
+
+    Inputs:
+      Q, K, V    [B, Tq, D]   this dispatch's projections (Tq=Tmax for the
+                              prefill program, Tq=1 for the decode step)
+      CacheK/V   [B, Tmax, D] persistable state slabs — appended in place
+                              (outputs wired back to the SAME var names,
+                              the optimizer-op state-threading convention,
+                              so the executor carries them as donated
+                              state across dispatches)
+      Len        [B] int32    valid cached tokens BEFORE this dispatch;
+                              both the write offset and the causal-mask
+                              base (query i may see keys j <= Len + i)
+      WriteMask  [B] float32  rows > 0 commit their K/V writes; others
+                              leave their slab rows untouched (decode
+                              feeds the live-slot mask, prefill the admit
+                              mask — dead/foreign slots are never written)
+
+    Every output row depends only on that row of the inputs, which is
+    what makes slot admit/evict churn unable to perturb a surviving
+    sequence even at the bit level (pinned by tests/test_decode.py).
+    Scores and softmax are computed in float32 regardless of the cache
+    dtype; Out is cast back to Q's dtype.
+    """
+    import math
+
+    q = ins["Q"][0]
+    k = ins["K"][0]
+    v = ins["V"][0]
+    cache_k = ins["CacheK"][0]
+    cache_v = ins["CacheV"][0]
+    ln = ins["Len"][0].astype(jnp.int32)
+    wm = ins["WriteMask"][0]
+    Tq = q.shape[1]
+    Tmax = cache_k.shape[1]
+    scale = float(attrs.get("scale", 0.0)) or 1.0 / math.sqrt(q.shape[-1])
+
+    # vmap'd per-row append at the row's own offset; dynamic_update_slice
+    # clamps the start, so a (masked-out) write from a dead slot at
+    # Len==Tmax is harmless rather than out of bounds
+    def _write(cache, new):
+        written = jax.vmap(
+            lambda c, n, l: lax.dynamic_update_slice(c, n, (l, 0)))(
+                cache, new.astype(cache.dtype), ln)
+        return jnp.where((wm > 0)[:, None, None], written, cache)
+
+    ck_new = _write(cache_k, k)
+    cv_new = _write(cache_v, v)
+    scores = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                        ck_new.astype(jnp.float32)) * scale
+    jpos = jnp.arange(Tmax, dtype=jnp.int32)[None, None, :]
+    ipos = jnp.arange(Tq, dtype=jnp.int32)[None, :, None]
+    visible = jpos <= (ln[:, None, None] + ipos)
+    probs = jax.nn.softmax(jnp.where(visible, scores, NEG_INF), axis=-1)
+    out = jnp.einsum("bqk,bkd->bqd", probs,
+                     cv_new.astype(jnp.float32)).astype(q.dtype)
+    return {"Out": out, "CacheKOut": ck_new, "CacheVOut": cv_new}
+
+
 # ---------------------------------------------------------------------------
 # Sharding propagation (analysis.shard_prop): beam search is decode-time
 # data-dependent machinery — registering the explicit noop states that its
@@ -154,3 +216,22 @@ from ..analysis.shard_prop import shard_noop  # noqa: E402
 from ..core.registry import register_shard_fn  # noqa: E402
 
 register_shard_fn("beam_search", "beam_search_decode")(shard_noop())
+
+# attention_with_cache: the decode slot pool is a single-host serving
+# construct — its batch axis is the slot axis and the cache slabs are
+# session state, neither of which is ever mesh-sharded (the on-chip plan
+# shards heads/hidden inside a slot, a future op variant).  Replicated
+# outputs, stated explicitly.
+register_shard_fn("attention_with_cache")(shard_noop())
+
+from ..analysis.shape_infer import first  # noqa: E402
+from ..core.registry import register_shape_fn  # noqa: E402
+
+
+@register_shape_fn("attention_with_cache")
+def _attention_with_cache_shape(op, ins, attrs):
+    # Out mirrors Q; the cache outputs mirror their state slabs (the
+    # optimizer-op ParamOut <- Param convention for in-place threading)
+    return {"Out": first(ins, "Q"),
+            "CacheKOut": first(ins, "CacheK"),
+            "CacheVOut": first(ins, "CacheV")}

@@ -462,6 +462,28 @@ register_tunable(
                   "32k and 64k tokens (paired-window discipline, "
                   "spread < gain)")
 
+# Paged KV-cache gather for the decode slot pool (serving/decode.py):
+# replace the contiguous [S, Tmax, D] slabs with fixed-size pages plus a
+# per-slot page table, gathered into the attention tile by a Pallas
+# kernel — the vLLM layout, removing the max-len * slots HBM reservation.
+# On this CPU container the contiguous slabs are strictly better (the
+# gather is pure overhead without HBM pressure), so the search is
+# pre-registered pending hardware rather than fabricated here.
+register_tunable(
+    "pallas/paged_kv_gather", side="device",
+    space={"page_size": (16, 32, 64, 128), "gather_block": (128, 256, 512)},
+    default={"page_size": 64, "gather_block": 256},
+    description="paged KV-cache layout for incremental decode: tokens "
+                "per cache page and the rows-per-grid-step of the Pallas "
+                "page-table gather feeding attention_with_cache.",
+    pending_hardware=True,
+    decision_rule="adopt paging only when the on-chip decode benchmark "
+                  "shows >= 1.15x decode tokens/s over the contiguous "
+                  "slabs at >= 50% slot occupancy with mixed-length "
+                  "traces, OR the contiguous reservation exceeds 25% of "
+                  "HBM at the serving config — below either bar the "
+                  "gather is pure overhead and the slabs stay")
+
 
 _mesh_detect_warned = False
 

@@ -20,6 +20,10 @@ degradation paths.
 * ``python -m paddle_tpu serve --model DIR ...`` — the stdio-protocol
   process form (:mod:`paddle_tpu.serving.cli`): SIGTERM drains and
   exits 0, composing with ``distributed.supervisor`` for relaunch.
+* :mod:`paddle_tpu.serving.decode` — continuous-batching incremental
+  decode: KV-cache slot pools (``DecodeEngine`` + ``DecodeRuntime``)
+  mounted as tenants via ``Server.add_decode_model`` /
+  ``Server.submit_decode``, with per-token-step admit/evict.
 
 ZERO COST WHEN UNUSED: ``import paddle_tpu`` must never import this
 package (tier-1 pins that, plus byte-identical training-path behavior
@@ -31,8 +35,10 @@ from ..faults import (DeadlineExceeded, ModelUnavailable, Overloaded,
                       ServerClosed)
 from .model import Model
 from .server import ModelError, PendingResponse, Server
+from .decode import DecodeEngine, DecodeRuntime
 
 __all__ = [
     "Model", "Server", "PendingResponse", "ModelError",
+    "DecodeEngine", "DecodeRuntime",
     "Overloaded", "DeadlineExceeded", "ServerClosed", "ModelUnavailable",
 ]

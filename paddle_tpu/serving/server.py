@@ -327,6 +327,7 @@ class Server:
         self.warmup_buckets = list(warmup_buckets) if warmup_buckets \
             else [self.buckets[0], self.buckets[-1]]
         self._models: Dict[str, _ModelRuntime] = {}
+        self._decode: Dict[str, object] = {}   # name -> DecodeRuntime
         self._state = WARMING
         self._state_lock = threading.Lock()
         self._req_counter = 0
@@ -352,6 +353,34 @@ class Server:
             raise ValueError(f"duplicate model name {model.name!r}")
         self._models[model.name] = _ModelRuntime(model, self)
 
+    def add_decode_model(self, engine, name: Optional[str] = None,
+                         mode: str = "continuous",
+                         step_wait_ms: Optional[float] = None,
+                         retry_policy: Optional[_faults.RetryPolicy] = None,
+                         autotune: Optional[bool] = None):
+        """Mount an incremental-decode slot pool as a tenant: ``engine``
+        is a :class:`~paddle_tpu.serving.decode.DecodeEngine`; requests
+        go through :meth:`submit_decode`.  The pool inherits the server's
+        deadline/shedding/breaker envelope and shares its lifecycle
+        (start/drain/shutdown/health)."""
+        from .decode import DecodeRuntime   # lazy: decode imports server
+        if self._started:
+            raise RuntimeError(
+                "Server.add_decode_model: server already started")
+        pool = DecodeRuntime(
+            engine, name=name, mode=mode, step_wait_ms=step_wait_ms,
+            default_deadline_ms=self.default_deadline_ms,
+            queue_capacity=self.queue_capacity, shed=self.shed,
+            breaker_threshold=self.breaker_threshold,
+            breaker_cooldown_s=self.breaker_cooldown_s,
+            retry_policy=(retry_policy if retry_policy is not None
+                          else self.retry_policy),
+            autotune=autotune)
+        if pool.name in self._models or pool.name in self._decode:
+            raise ValueError(f"duplicate model name {pool.name!r}")
+        self._decode[pool.name] = pool
+        return pool
+
     def start(self):
         """Warm up every tenant, spawn its batcher/dispatcher pair, flip
         to ready.  Warmup dispatches the model's example at the smallest
@@ -359,7 +388,7 @@ class Server:
         (other buckets compile on first use, tagged cold in telemetry)."""
         if self._started:
             raise RuntimeError("Server.start: already started")
-        if not self._models:
+        if not self._models and not self._decode:
             raise ValueError("Server.start: no models added")
         self._started = True
         self._set_state(WARMING)
@@ -380,6 +409,8 @@ class Server:
                 name=f"pt-serving-dispatch-{rt.model.name}", daemon=True)
             rt.batcher.start()
             rt.dispatcher.start()
+        for pool in self._decode.values():
+            pool.start(warmup=self.warmup)
         self._set_state(READY)
         return self
 
@@ -392,6 +423,8 @@ class Server:
             with rt.cond:
                 rt.closed = True
                 rt.cond.notify_all()
+        for pool in self._decode.values():
+            pool.close()
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop the server.  ``drain=True`` (graceful): admission closes,
@@ -423,6 +456,10 @@ class Server:
                     continue
                 t.join(None if deadline is None
                        else max(0.0, deadline - time.monotonic()))
+        for pool in self._decode.values():
+            pool.shutdown(drain=drain,
+                          timeout=None if deadline is None
+                          else max(0.0, deadline - time.monotonic()))
         self._set_state(STOPPED)
 
     # -- admission -----------------------------------------------------------
@@ -557,6 +594,34 @@ class Server:
         return self.submit(feeds, model=model,
                            deadline_ms=deadline_ms).result(timeout)
 
+    def submit_decode(self, tokens, max_new_tokens: int,
+                      model: Optional[str] = None,
+                      deadline_ms: Optional[float] = -1.0,
+                      req_id=None) -> PendingResponse:
+        """Admit one generate request to a decode slot pool (see
+        ``add_decode_model``).  Completes with ``{"tokens", "finish",
+        "ttft_ms", "inter_token_ms"}``; admission rejections raise the
+        same typed errors as :meth:`submit`."""
+        if model is None:
+            if len(self._decode) != 1:
+                raise ValueError(
+                    f"decode model name required (decode tenants: "
+                    f"{sorted(self._decode)})")
+            pool = next(iter(self._decode.values()))
+        else:
+            pool = self._decode.get(model)
+            if pool is None:
+                raise ValueError(
+                    f"unknown decode model {model!r} (decode tenants: "
+                    f"{sorted(self._decode)})")
+        if self._state != READY:
+            raise _faults.ServerClosed(
+                f"server is {self._state}; admission closed")
+        if deadline_ms == -1.0:
+            deadline_ms = self.default_deadline_ms
+        return pool.submit(tokens, max_new_tokens,
+                           deadline_ms=deadline_ms, req_id=req_id)
+
     # -- health --------------------------------------------------------------
     def health(self) -> dict:
         models = {}
@@ -571,8 +636,12 @@ class Server:
                 "served": served,
                 "batches": batches,
             }
-        return {"state": self._state, "ready": self.ready(),
-                "models": models}
+        out = {"state": self._state, "ready": self.ready(),
+               "models": models}
+        if self._decode:
+            out["decode"] = {name: pool.health()
+                             for name, pool in self._decode.items()}
+        return out
 
     # -- batcher -------------------------------------------------------------
     def _expire(self, req: PendingResponse, where: str) -> bool:

@@ -54,6 +54,16 @@ Registered sites:
                           retries per the server's policy; ``fatal``
                           raises :class:`InjectedFault` (classified fatal
                           — feeds the per-model circuit breaker)
+``serving.decode_step``   per decode-pool token-step dispatch
+                          (``serving.decode.DecodeRuntime``; hit-count
+                          indexed; fires inside the retry rim BEFORE the
+                          executor call, so the donated KV slabs are
+                          untouched when it fires).  ``transient``
+                          retries per the pool's policy without
+                          corrupting surviving slots; ``fatal`` raises
+                          :class:`InjectedFault` — the affected ACTIVE
+                          sequences complete with typed errors, queued
+                          requests survive, and the breaker counts it
 ``tuning.trial``          per autotuner trial (``tuning.search.run_trial``;
                           hit-count indexed).  ``fail`` makes the trial's
                           measurement raise (recorded ``failed``);
@@ -107,8 +117,8 @@ __all__ = [
 
 KNOWN_SITES = ("trainer.step", "reader.item", "executor.dispatch",
                "master.call", "ckpt.write", "serving.request",
-               "serving.dispatch", "tuning.trial", "elastic.worker",
-               "master.heartbeat", "sparse.push")
+               "serving.dispatch", "serving.decode_step", "tuning.trial",
+               "elastic.worker", "master.heartbeat", "sparse.push")
 
 # THE zero-overhead gate: call sites guard every hook with
 # ``if faultinject.ENABLED:`` — one attribute load when off.

@@ -260,6 +260,64 @@ def _target_sparse_push_flush(smoke: bool) -> Callable[[dict], None]:
     return measure
 
 
+def _target_decode_slots(smoke: bool) -> Callable[[dict], None]:
+    """Decode slot-pool sizing under closed-loop generate load: slots is
+    the compiled decode batch (per-step amortization vs padded compute
+    at partial occupancy), step_wait_ms the idle-pool poll — the
+    continuous-batching knob pair benchmark/decode.py measured."""
+    import threading
+
+    from ..serving.decode import DecodeEngine, DecodeRuntime
+
+    V = 64
+    rng = np.random.RandomState(0)
+    n_requests = 8 if smoke else 64
+    clients = 2 if smoke else 4
+    prompts = [[int(t) for t in rng.randint(1, V, rng.randint(3, 9))]
+               for _ in range(16)]
+    max_news = [int(rng.randint(4, 17)) for _ in range(16)]
+    # one engine per slot count, built on first use — rebuilding per
+    # config would make the A/B pay compile inside timed windows
+    engines: Dict[int, DecodeEngine] = {}
+
+    def measure(cfg: dict):
+        s = int(cfg["slots"])
+        if s not in engines:
+            engines[s] = DecodeEngine(
+                vocab_size=V, hidden_dim=32, n_layers=1, slots=s,
+                max_len=32, seed=0, name=f"tune-dec{s}")
+            rt0 = DecodeRuntime(engines[s], step_wait_ms=1.0)
+            rt0.start(warmup=True)
+            rt0.shutdown()
+        rt = DecodeRuntime(engines[s],
+                           step_wait_ms=cfg["step_wait_ms"])
+        rt.start(warmup=False)
+        try:
+            errors = []
+            per_client = n_requests // clients
+
+            def client(ci):
+                try:
+                    for i in range(per_client):
+                        j = (ci * per_client + i) % len(prompts)
+                        rt.submit(prompts[j], max_news[j]).result(60.0)
+                except Exception as e:  # noqa: BLE001 — reported below
+                    errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(c,),
+                                        name=f"pt-tune-dec-{c}")
+                       for c in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+        finally:
+            rt.shutdown(drain=True, timeout=30.0)
+    return measure
+
+
 # ---------------------------------------------------------------------------
 # Device-side targets (reached only with the accelerator present;
 # search.tune returns the pending-hardware stub on CPU)
@@ -386,6 +444,7 @@ TARGETS: Dict[str, Callable[[bool], Callable[[dict], None]]] = {
     "executor/run_pipelined": _target_run_pipelined,
     "reader/prefetch": _target_reader_prefetch,
     "serving/batcher": _target_serving_batcher,
+    "serving/decode_slots": _target_decode_slots,
     "sparse/hot_rows": _target_sparse_hot_rows,
     "sparse/prefetch": _target_sparse_prefetch,
     "sparse/push_flush": _target_sparse_push_flush,
@@ -400,6 +459,7 @@ TARGETS: Dict[str, Callable[[bool], Callable[[dict], None]]] = {
 #: flag-gated Pallas conv kernels)
 _REGISTERING_MODULE = {
     "serving/batcher": "paddle_tpu.serving.server",
+    "serving/decode_slots": "paddle_tpu.serving.decode",
     "sparse/hot_rows": "paddle_tpu.sparse.session",
     "sparse/prefetch": "paddle_tpu.sparse.session",
     "sparse/push_flush": "paddle_tpu.sparse.session",

@@ -176,6 +176,115 @@ def test_beam_step_hook_forces_early_eos():
     assert not np.array_equal(np.asarray(h_ids), np.asarray(base_ids))
 
 
+def test_beam_hook_registry_roundtrip():
+    """register_beam_hook/get_beam_hook: explicit names round-trip, the
+    decoder accepts a registry NAME (not just a callable), and unknown
+    names fail with the actionable KeyError."""
+    import pytest
+
+    calls = []
+
+    def noop_hook(t, info):
+        calls.append("traced")
+        return None
+
+    name = layers.register_beam_hook("unit_noop_hook", noop_hook)
+    assert name == "unit_noop_hook"
+    assert layers.get_beam_hook("unit_noop_hook") is noop_hook
+    with pytest.raises(KeyError, match="not registered"):
+        layers.get_beam_hook("no_such_hook")
+
+    # a name-referenced no-op hook leaves generation unchanged
+    rng = np.random.RandomState(0)
+    V, T, bos, eos = 5, 3, 0, 4
+    P = rng.dirichlet(np.ones(V), size=V).astype("float32")
+    P[:, eos] = 1e-6
+    P /= P.sum(1, keepdims=True)
+    ids_v, _, _ = _markov_program(P, 2, T, bos, eos)
+    exe = pt.Executor()
+    feed = {"P": P, "init": np.zeros((1, 1), "float32")}
+    (base_ids,) = exe.run(feed=feed, fetch_list=[ids_v])
+
+    pt.core.reset_default_programs()
+    pt.core.reset_global_scope()
+    Pvar = layers.data("P", shape=[V, V], dtype="float32",
+                       append_batch_size=False)
+    init = layers.data("init", shape=[1], dtype="float32")
+    bs = layers.BeamSearchDecoder(beam_size=2, bos_id=bos, eos_id=eos,
+                                  max_len=T, vocab_size=V,
+                                  step_hook="unit_noop_hook")
+    with bs.step():
+        tok = bs.token()
+        mem = bs.memory(init=init)
+        bs.update_memory(mem, mem)
+        bs.set_probs(layers.gather(Pvar, tok))
+    h_ids_v, _, _ = bs()
+    (h_ids,) = pt.Executor().run(feed=feed, fetch_list=[h_ids_v])
+    np.testing.assert_array_equal(np.asarray(h_ids), np.asarray(base_ids))
+    assert calls        # the hook really ran inside the compiled scan
+
+
+def test_greedy_kv_decode_agrees_with_beam_k1():
+    """Bridge between the two generation paths (ISSUE 16): the KV-cache
+    incremental greedy chain (serving.decode.DecodeEngine) and the
+    compiled BeamSearchDecoder at beam_size=1 must pick the SAME token
+    sequence when fed the same per-step distributions.  The engine's
+    trajectory is replayed as a Markov table P[state] = softmax(logits
+    emitted from that state), which is exactly the decoder's input
+    contract — valid because the greedy chain visits distinct states."""
+    from paddle_tpu.serving.decode import DecodeEngine
+
+    eng = DecodeEngine(11, hidden_dim=10, n_layers=1, slots=2,
+                       max_len=16, len_buckets=(16,), eos_id=None,
+                       seed=9, name="g2b")
+    V, n = eng.vocab_size, 4
+
+    def chain(prompt):
+        """Greedy tokens + the [n, V] logit rows that chose them."""
+        eng.reset()
+        tok, row = eng.prefill(0, prompt)
+        rows, toks = [row], [tok]
+        cur = np.zeros(2, np.int64)
+        lens = np.zeros(2, np.int32)
+        act = np.zeros(2, np.float32)
+        cur[0], lens[0], act[0] = tok, len(prompt), 1.0
+        for _ in range(n - 1):
+            r = np.asarray(eng.decode_step(cur, lens, act)[0, 0],
+                           "float32")
+            toks.append(int(r.argmax()))
+            rows.append(r)
+            cur[0] = toks[-1]
+            lens[0] += 1
+        return toks, rows
+
+    # find a prompt whose chain visits distinct states (so the Markov
+    # replay is a well-defined function state -> next distribution)
+    for pick in range(20):
+        prompt = [3, 7, 1 + pick % (V - 1)]
+        toks, rows = chain(prompt)
+        states = toks[:-1]
+        if len(set(states)) == len(states) and \
+                len(set(states) | set(toks)) < V - 1:
+            break
+    else:
+        raise AssertionError("no prompt produced a distinct-state chain")
+    bos = next(i for i in range(V) if i not in states)
+    eos = next(i for i in range(V) if i not in states + toks + [bos])
+
+    P = np.full((V, V), 1.0 / V, "float32")
+    for state, row in zip([bos] + states, rows):
+        e = np.exp(row - row.max())
+        P[state] = e / e.sum()
+    P[:, eos] = 1e-9               # eos never argmax -> never emitted
+    P /= P.sum(1, keepdims=True)
+
+    ids_v, _, _ = _markov_program(P, 1, n, bos, eos)
+    ids, = pt.Executor().run(
+        feed={"P": P, "init": np.zeros((1, 1), "float32")},
+        fetch_list=[ids_v])
+    assert list(np.asarray(ids)[0, 0]) == toks
+
+
 def test_dsl_exports_layer_meta():
     """LayerOutput/LayerType/BeamInput/convex_comb_layer exist in the DSL
     surface (reference layers.py __all__), and behave: layer outputs ARE

@@ -390,6 +390,7 @@ def test_tunable_registry_matches_ast_scan():
 
     # surface the lazily-imported declarations so live is maximal
     importlib.import_module("paddle_tpu.serving.server")
+    importlib.import_module("paddle_tpu.serving.decode")
     importlib.import_module("paddle_tpu.ops.pallas_conv")
     importlib.import_module("paddle_tpu.sparse.session")
 
@@ -401,7 +402,8 @@ def test_tunable_registry_matches_ast_scan():
         f"(dynamic name construction defeats the duplicate gate): "
         f"{sorted(missing)}")
     assert live >= {"executor/run_pipelined", "reader/prefetch",
-                    "serving/batcher", "sparse/hot_rows",
+                    "serving/batcher", "serving/decode_slots",
+                    "pallas/paged_kv_gather", "sparse/hot_rows",
                     "sparse/prefetch", "sparse/push_flush",
                     "pallas/flash_attention",
                     "pallas/conv1x1_blocks", "xla/scoped_vmem_limit_kib",
