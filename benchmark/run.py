@@ -223,6 +223,14 @@ def run_decode(smoke=False):
     return [run_all(smoke=smoke)]
 
 
+def run_pserver(smoke=False):
+    """Delegate to benchmark/pserver.py (multi-host sparse parameter
+    server: batched binary wire vs naive JSON A/B, remote pull latency
+    vs in-process, shard pipelining A/B over a real process fleet)."""
+    from benchmark.pserver import run_all
+    return [run_all(smoke=smoke)]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None,
@@ -231,11 +239,12 @@ def main():
                          "for the cold-vs-warm startup A/B, 'autotune' "
                          "for the tuned-vs-default autotuner A/B, "
                          "'ctr' for the sparse-parameter-server CTR A/B, "
-                         "or 'decode' for the continuous-batching "
-                         "incremental-decode A/B")
+                         "'decode' for the continuous-batching "
+                         "incremental-decode A/B, or 'pserver' for the "
+                         "multi-host sparse parameter-server wire A/B")
     ap.add_argument("--smoke", action="store_true",
                     help="input_pipeline/compile_cache/autotune/ctr/"
-                         "decode only: seconds-fast path check")
+                         "decode/pserver only: seconds-fast path check")
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--iters", type=int, default=None,
                     help="steps per timed window (default: 60 for the "
@@ -265,6 +274,9 @@ def main():
         return
     if args.model == "decode":
         run_decode(smoke=args.smoke)
+        return
+    if args.model == "pserver":
+        run_pserver(smoke=args.smoke)
         return
     if args.all:
         for name, batch in HEADLINE:

@@ -917,6 +917,12 @@ def main(argv=None):
         # paddle_tpu (or running a plain trainer) never loads it
         from paddle_tpu.distributed.elastic import elastic_main
         return elastic_main(argv[1:])
+    if argv and argv[0] == "pserver":
+        # lazy: the sparse wire tier (sparse/{wire,pserver,client})
+        # rides the same zero-cost-when-unused contract — importing
+        # paddle_tpu or paddle_tpu.sparse never loads a socket stack
+        from paddle_tpu.sparse.pserver import pserver_main
+        return pserver_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="paddle_tpu",
         description="TrainerMain analog: run a v1 config on the TPU "
@@ -943,9 +949,11 @@ def main(argv=None):
                     "`paddle_tpu elastic --config conf.py --data "
                     "'parts/*' --workers K --root dir` runs the elastic "
                     "multi-worker training service with checkpointed "
-                    "mesh resize (see "
+                    "mesh resize, and `paddle_tpu pserver --shard k/N "
+                    "--dir dir` runs one sparse parameter-server shard "
+                    "behind the batched binary wire protocol (see "
                     "`paddle_tpu check|plan|stats|trace|doctor|profile|"
-                    "tune|serve|fleet|elastic --help`).")
+                    "tune|serve|fleet|elastic|pserver --help`).")
     ap.add_argument("--config", required=True, help="v1 config file")
     ap.add_argument("--job", default="train",
                     choices=["train", "test", "time", "checkgrad"])

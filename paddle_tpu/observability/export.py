@@ -284,6 +284,7 @@ def summarize_logs(paths) -> dict:
     faults: List[dict] = []
     servings: List[dict] = []
     tunings: List[dict] = []
+    pservers: List[dict] = []
     spans = 0
     last_snapshot: Optional[dict] = None
     snapshots = 0
@@ -307,6 +308,8 @@ def summarize_logs(paths) -> dict:
             servings.append(ev)
         elif kind == "tuning":
             tunings.append(ev)
+        elif kind == "pserver":
+            pservers.append(ev)
         elif kind == "span":
             spans += 1
 
@@ -471,6 +474,33 @@ def summarize_logs(paths) -> dict:
                          "config": e.get("config")}
                         for e in tunings if e.get("event") == "replay"],
         }
+    if pservers:
+        by_event: Dict[str, int] = {}
+        shards = set()
+        for e in pservers:
+            key = str(e.get("event", "unknown"))
+            by_event[key] = by_event.get(key, 0) + 1
+            if e.get("shard") is not None:
+                shards.add(int(e["shard"]))
+        shut = [e for e in pservers if e.get("event") == "shutdown"]
+        summary["pserver"] = {
+            "events": len(pservers), "by_event": by_event,
+            "shards": sorted(shards),
+            "checkpoints": by_event.get("checkpoint", 0),
+            "restores": [{"shard": e.get("shard"),
+                          "source": e.get("source"),
+                          "pushes_applied": e.get("pushes_applied")}
+                         for e in pservers
+                         if e.get("event") == "restore"],
+            "pulls": sum(int(e.get("pulls", 0)) for e in shut),
+            "pushes": sum(int(e.get("pushes", 0)) for e in shut),
+            "wire_mb_in": round(sum(
+                float(e.get("wire_bytes_in", 0)) for e in shut) / 2 ** 20,
+                3),
+            "wire_mb_out": round(sum(
+                float(e.get("wire_bytes_out", 0))
+                for e in shut) / 2 ** 20, 3),
+        }
     return summary
 
 
@@ -561,6 +591,22 @@ def render_summary(summary: dict) -> str:
             lines.append(f"  refusal: {r['tunable']} — {r['reason']}")
         for r in tu["replays"]:
             lines.append(f"  replay: {r['tunable']} -> {r['config']}")
+    ps = summary.get("pserver")
+    if ps:
+        kinds = " ".join(f"{k}={v}" for k, v in sorted(
+            ps["by_event"].items()))
+        lines.append(
+            f"pserver: {ps['events']} event(s) across shard(s) "
+            f"{ps['shards']}: {kinds}")
+        if ps["pulls"] or ps["pushes"]:
+            lines.append(
+                f"  served: {ps['pulls']} pull(s) {ps['pushes']} "
+                f"push(es), wire {ps['wire_mb_in']} MB in / "
+                f"{ps['wire_mb_out']} MB out")
+        for r in ps["restores"]:
+            lines.append(
+                f"  restore: shard {r['shard']} from {r['source']} "
+                f"(pushes_applied={r['pushes_applied']})")
     return "\n".join(lines)
 
 

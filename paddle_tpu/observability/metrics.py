@@ -252,6 +252,36 @@ METRIC_NAMES = (
     ("sparse/push_flush_ms", "histogram",
      "host wall time of one async-push worker drain (up to "
      "push_flush_batch queued gradient pushes applied FIFO)"),
+    # sparse parameter-server wire tier (sparse.pserver / sparse.client):
+    # only written inside pserver processes and RemoteSparseTable rounds —
+    # the tier is lazy-import gated, so in-process training never loads it
+    ("pserver/requests", "counter",
+     "wire requests served by pserver shards (one per batched frame)"),
+    ("pserver/pull_rows", "counter",
+     "rows pulled through the pserver wire path (server-side count)"),
+    ("pserver/push_rows", "counter",
+     "rows updated by pserver-side optimizer pushes"),
+    ("pserver/pull_rows_per_sec", "gauge",
+     "server-side kernel throughput of the most recent batched pull"),
+    ("pserver/push_rows_per_sec", "gauge",
+     "server-side kernel throughput of the most recent batched push"),
+    ("pserver/wire_bytes_in", "counter",
+     "bytes received over the pserver binary wire (frames in)"),
+    ("pserver/wire_bytes_out", "counter",
+     "bytes sent over the pserver binary wire (frames out)"),
+    ("pserver/frame_ms", "histogram",
+     "server wall time of one batched request frame: decode done to "
+     "reply queued (the wire-marshalling + kernel cost per round)"),
+    ("pserver/reconnects", "counter",
+     "client reconnects to a pserver shard (retry rim re-dials after a "
+     "torn frame / refused connection)"),
+    ("pserver/replication_lag_ms", "histogram",
+     "chain-backup forward round-trip per applied push: apply done to "
+     "backup ack (the price of zero-acked-push-loss durability)"),
+    ("pserver/backup_pushes", "counter",
+     "chain-backup pushes applied on behalf of a predecessor shard"),
+    ("pserver/checkpoints", "counter",
+     "durable pserver shard checkpoints committed (SIGTERM or op)"),
 )
 
 _MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
@@ -280,6 +310,8 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
     "sparse/pull_ms": _MS_BUCKETS,
     "sparse/push_ms": _MS_BUCKETS,
     "sparse/push_flush_ms": _MS_BUCKETS,
+    "pserver/frame_ms": _MS_BUCKETS,
+    "pserver/replication_lag_ms": _MS_BUCKETS,
 }
 _DEFAULT_BUCKETS = _MS_BUCKETS
 

@@ -104,6 +104,10 @@ SPAN_NAMES = (
      "feed stream (SparseSession.prefetch_feeds): the worker thread's "
      "per-batch sparse/pull spans cross-thread-parent to it; labels: "
      "depth"),
+    ("pserver/rpc", "one client round against the pserver fleet: "
+     "partition ids by shard -> write every shard's batched frame -> "
+     "read every reply (pipelined, so N-shard latency is max not sum); "
+     "retry attempts attach as span events; labels: op, table, shards"),
 )
 
 _REGISTERED = tuple(n for n, _ in SPAN_NAMES)

@@ -12,12 +12,29 @@ reproduced for the one-big-jit executor:
   dedup → cache-first pull → feed injection → ``<rows>@GRAD`` fetch →
   push), hot-rows cache, read-only inference mode, serving attachment.
 
+The **wire tier** promotes the table to a served fleet (the reference's
+C++/Go pserver processes) and is itself lazy — importing this package
+never opens a socket stack; only ``python -m paddle_tpu pserver`` and
+an explicit ``from paddle_tpu.sparse.client import RemoteSparseTable``
+load it:
+
+* :mod:`.wire` — length-prefixed binary framing (one batched frame per
+  request; zero-copy scatter-gather payloads) + the naive per-row JSON
+  control arm the benchmark gates against.
+* :mod:`.pserver` — the shard server process (``--shard k/N``):
+  vectorized kernels server-side, SIGTERM → checkpoint → exit 75,
+  chain-backup push replication.
+* :mod:`.client` — :class:`~.client.RemoteSparseTable`: client-side
+  ``id % N`` sharding, pipelined per-shard frames, retry/reconnect,
+  duck-types :class:`SparseTable` so a session binds it unchanged.
+
 Declare a host-side table with ``layers.embedding(..., sparse=True)``;
 the trainer wires the rim through ``train(sparse_tables=session)``.
 
 This package is **lazy-import gated** like serving/tuning/elastic:
 ``import paddle_tpu`` (and every training path that never opts in) never
-loads it — tests/test_repo_lint.py enforces the static half.
+loads it — and importing it never loads the wire tier —
+tests/test_repo_lint.py enforces both static halves.
 """
 from .session import (HotRowCache, SparseBinding, SparseSession,
                       table_specs, tables_for_program)

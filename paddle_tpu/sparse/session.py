@@ -250,7 +250,12 @@ class SparseSession:
                  async_push: int = 0,
                  push_flush_batch: Optional[int] = None,
                  observe: Optional[bool] = None):
-        if isinstance(tables, SparseTable):
+        if isinstance(tables, SparseTable) or (
+                hasattr(tables, "pull") and hasattr(tables, "push")
+                and hasattr(tables, "name")):
+            # one table, in-process or remote — RemoteSparseTable duck-
+            # types the SparseTable surface and binds identically (the
+            # wire tier stays lazy: no isinstance on a gated import)
             tables = [tables]
         if isinstance(tables, dict):
             self.tables: Dict[str, SparseTable] = dict(tables)

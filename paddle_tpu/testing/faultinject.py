@@ -96,6 +96,23 @@ Registered sites:
                           the site), without one it raises — a dropped
                           push is never silent (the grads exist nowhere
                           else)
+``pserver.rpc``           per request frame RECEIVED by a pserver shard
+                          (``sparse.pserver.PServer``; hit-count
+                          indexed, before dispatch).  ``drop`` closes
+                          the connection mid-exchange — the client sees
+                          a torn frame (typed ``WireTruncatedError``)
+                          and its retry rim reconnects and replays;
+                          ``transient`` answers a typed retryable error
+                          reply instead of the result
+``pserver.shard``         per APPLIED push on a pserver shard (index =
+                          the shard's persisted applied-push counter,
+                          restored from checkpoint/chain backup — the
+                          ``elastic.worker`` restored-counter
+                          convention, so a ``kill`` fired in one life
+                          never re-fires after relaunch).  ``kill``
+                          SIGKILLs the shard process AFTER the push is
+                          applied and chain-replicated but BEFORE the
+                          client ack — the zero-acked-push-loss case
 ========================  ==================================================
 
 Every firing increments the ``fault/injected`` counter and emits a
@@ -118,7 +135,8 @@ __all__ = [
 KNOWN_SITES = ("trainer.step", "reader.item", "executor.dispatch",
                "master.call", "ckpt.write", "serving.request",
                "serving.dispatch", "serving.decode_step", "tuning.trial",
-               "elastic.worker", "master.heartbeat", "sparse.push")
+               "elastic.worker", "master.heartbeat", "sparse.push",
+               "pserver.rpc", "pserver.shard")
 
 # THE zero-overhead gate: call sites guard every hook with
 # ``if faultinject.ENABLED:`` — one attribute load when off.

@@ -238,10 +238,13 @@ class SGD:
         sess = sparse_tables
         if sess is not None:
             if elastic is not None:
-                raise ValueError(
-                    "train(sparse_tables=...) cannot combine with "
-                    "elastic=... yet (the resize merge has no sparse-"
-                    "row story; see ROADMAP)")
+                raise NotImplementedError(
+                    "train(sparse_tables=..., elastic=...): the elastic "
+                    "resize merge has no in-process sparse-row story — "
+                    "host the rows outside the worker fleet instead: "
+                    "bind a RemoteSparseTable against a pserver fleet "
+                    "(python -m paddle_tpu pserver) so workers come and "
+                    "go while the row store stays put")
             if warmup:
                 raise ValueError(
                     "train(sparse_tables=..., warmup=True) is not "

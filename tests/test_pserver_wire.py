@@ -1,0 +1,259 @@
+"""Property tests for the pserver binary wire format (sparse/wire.py).
+
+What these pin (the ISSUE 17 wire contract):
+
+* round-trip **bit-identity** across dtypes/shapes/array counts — the
+  zero-copy scatter-gather path must never touch a byte;
+* failure TYPING: peer death mid-frame is a retryable
+  :class:`WireTruncatedError` (a ``ConnectionError`` → ``classify`` says
+  retryable), while garbage at a frame boundary (torn magic, undecodable
+  header, descriptor/length disagreement, insane declared size) is a
+  fatal :class:`WireProtocolError`, and a version skew is a fatal
+  :class:`WireVersionError` naming both versions;
+* the naive per-row JSON control arm round-trips too (it is the
+  benchmark baseline, not the hot path).
+
+Pure socketpair tests: no server process, no jax — tier-1 fast.
+"""
+import json
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.faults import classify
+from paddle_tpu.sparse import wire
+
+
+def _pipe():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def _send_bytes(raw: bytes):
+    """A reader socket whose peer wrote ``raw`` and closed."""
+    a, b = _pipe()
+    t = threading.Thread(target=lambda: (a.sendall(raw), a.close()))
+    t.start()
+    t.join(timeout=5.0)
+    return b
+
+
+def _frame_bytes(header: dict, arrays=()) -> bytes:
+    """Capture write_frame output as bytes (via a socketpair drain)."""
+    a, b = _pipe()
+    out = {}
+
+    def drain():
+        chunks = []
+        while True:
+            c = b.recv(1 << 16)
+            if not c:
+                break
+            chunks.append(c)
+        out["raw"] = b"".join(chunks)
+
+    t = threading.Thread(target=drain)
+    t.start()
+    wire.write_frame(a, header, arrays)
+    a.close()
+    t.join(timeout=5.0)
+    b.close()
+    return out["raw"]
+
+
+# -- round-trip bit-identity -------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int64", "int32",
+                                   "uint8", "bool"])
+def test_round_trip_bit_identity_per_dtype(dtype):
+    rng = np.random.default_rng(0)
+    a = (rng.standard_normal((7, 3)) * 100).astype(dtype)
+    src, dst = _pipe()
+    n = wire.write_frame(src, {"op": "x", "k": 1}, (a,))
+    header, arrays = wire.read_frame(dst)
+    assert header["op"] == "x" and header["k"] == 1
+    assert len(arrays) == 1
+    got = arrays[0]
+    assert got.dtype == a.dtype and got.shape == a.shape
+    assert got.tobytes() == a.tobytes()          # bit-identical, not just ==
+    assert header["_wire_nbytes"] == n           # counter accounting
+    src.close(); dst.close()
+
+
+def test_round_trip_many_arrays_and_empty():
+    arrays = (np.arange(12, dtype=np.int64).reshape(3, 4),
+              np.zeros((0, 8), np.float32),      # empty batch rides fine
+              np.full((1,), 3.5, np.float32),
+              np.frombuffer(b"\x00\x01\xfe\xff", np.uint8))
+    src, dst = _pipe()
+    wire.write_frame(src, {"op": "multi"}, arrays)
+    _, got = wire.read_frame(dst)
+    assert len(got) == len(arrays)
+    for g, a in zip(got, arrays):
+        assert g.dtype == a.dtype and g.shape == a.shape
+        assert g.tobytes() == a.tobytes()
+    src.close(); dst.close()
+
+
+def test_round_trip_empty_frame_header_only():
+    src, dst = _pipe()
+    wire.write_frame(src, {"op": "hello"})
+    header, arrays = wire.read_frame(dst)
+    assert header["op"] == "hello" and arrays == []
+    src.close(); dst.close()
+
+
+def test_big_endian_sender_converted_not_rejected():
+    # senders normalize to LE before framing; the receiver sees "<f4"
+    a = np.arange(6, dtype=">f4").reshape(2, 3)
+    src, dst = _pipe()
+    wire.write_frame(src, {"op": "x"}, (a,))
+    header, (got,) = wire.read_frame(dst)
+    assert header["bufs"][0][0] == "<f4"
+    np.testing.assert_array_equal(got, a.astype("<f4"))
+    src.close(); dst.close()
+
+
+def test_back_to_back_frames_stay_in_sync():
+    src, dst = _pipe()
+    for i in range(4):
+        wire.write_frame(src, {"i": i}, (np.full((i + 1,), i, np.int32),))
+    for i in range(4):
+        header, (arr,) = wire.read_frame(dst)
+        assert header["i"] == i and arr.shape == (i + 1,)
+    src.close(); dst.close()
+
+
+# -- failure typing ----------------------------------------------------------
+
+def test_truncated_payload_is_retryable_connection_error():
+    raw = _frame_bytes({"op": "x"}, (np.arange(64, dtype=np.float64),))
+    rd = _send_bytes(raw[:-17])                  # die mid-payload
+    with pytest.raises(wire.WireTruncatedError) as ei:
+        wire.read_frame(rd)
+    assert isinstance(ei.value, ConnectionError)
+    assert classify(ei.value) == "retryable"
+    rd.close()
+
+
+def test_truncated_preamble_and_header():
+    raw = _frame_bytes({"op": "x"})
+    for cut in (3, wire._PREAMBLE.size + 2):     # torn preamble / header
+        rd = _send_bytes(raw[:cut])
+        with pytest.raises(wire.WireTruncatedError):
+            wire.read_frame(rd)
+        rd.close()
+
+
+def test_clean_eof_at_boundary():
+    rd = _send_bytes(b"")
+    assert wire.read_frame(rd, eof_ok=True) is None   # idle close
+    rd.close()
+    rd = _send_bytes(b"")
+    with pytest.raises(wire.WireTruncatedError):
+        wire.read_frame(rd)                      # mid-conversation: typed
+    rd.close()
+
+
+def test_torn_magic_is_fatal_protocol_error():
+    raw = _frame_bytes({"op": "x"})
+    rd = _send_bytes(b"JUNK" + raw[4:])
+    with pytest.raises(wire.WireProtocolError, match="magic"):
+        wire.read_frame(rd)
+    rd.close()
+
+
+def test_cross_version_rejected_naming_both_versions():
+    raw = bytearray(_frame_bytes({"op": "x"}))
+    struct.pack_into("<H", raw, 4, wire.WIRE_VERSION + 1)
+    rd = _send_bytes(bytes(raw))
+    with pytest.raises(wire.WireVersionError) as ei:
+        wire.read_frame(rd)
+    msg = str(ei.value)
+    assert str(wire.WIRE_VERSION) in msg and str(wire.WIRE_VERSION + 1) in msg
+    assert not isinstance(ei.value, ConnectionError)  # never retried
+    rd.close()
+
+
+def test_insane_declared_lengths_capped():
+    pre = wire._PREAMBLE.pack(wire.MAGIC, wire.WIRE_VERSION,
+                              wire.MAX_HEADER_BYTES + 1, 0)
+    rd = _send_bytes(pre)
+    with pytest.raises(wire.WireProtocolError, match="header length"):
+        wire.read_frame(rd)
+    rd.close()
+    pre = wire._PREAMBLE.pack(wire.MAGIC, wire.WIRE_VERSION, 2,
+                              wire.MAX_PAYLOAD_BYTES + 1)
+    rd = _send_bytes(pre + b"{}")
+    with pytest.raises(wire.WireProtocolError, match="payload length"):
+        wire.read_frame(rd)
+    rd.close()
+
+
+def _handcrafted(header: dict, payload: bytes) -> bytes:
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    return wire._PREAMBLE.pack(wire.MAGIC, wire.WIRE_VERSION,
+                               len(hdr), len(payload)) + hdr + payload
+
+
+def test_descriptor_length_disagreement_fatal():
+    # descriptors declare MORE bytes than the payload holds
+    rd = _send_bytes(_handcrafted({"bufs": [["<f4", [4]]]}, b"\0" * 8))
+    with pytest.raises(wire.WireProtocolError, match="more bytes"):
+        wire.read_frame(rd)
+    rd.close()
+    # descriptors cover FEWER bytes than the payload holds
+    rd = _send_bytes(_handcrafted({"bufs": [["<f4", [1]]]}, b"\0" * 8))
+    with pytest.raises(wire.WireProtocolError, match="disagreement"):
+        wire.read_frame(rd)
+    rd.close()
+
+
+def test_big_endian_descriptor_rejected():
+    rd = _send_bytes(_handcrafted({"bufs": [[">f4", [2]]]}, b"\0" * 8))
+    with pytest.raises(wire.WireProtocolError, match="big-endian"):
+        wire.read_frame(rd)
+    rd.close()
+
+
+def test_undecodable_header_fatal():
+    raw = wire._PREAMBLE.pack(wire.MAGIC, wire.WIRE_VERSION, 4, 0) + b"\xff{]!"
+    rd = _send_bytes(raw)
+    with pytest.raises(wire.WireProtocolError, match="undecodable"):
+        wire.read_frame(rd)
+    rd.close()
+
+
+def test_bad_descriptor_shape_fatal():
+    rd = _send_bytes(_handcrafted({"bufs": [["<f4"]]}, b""))
+    with pytest.raises(wire.WireProtocolError, match="descriptor"):
+        wire.read_frame(rd)
+    rd.close()
+
+
+# -- the naive JSON control arm ----------------------------------------------
+
+def test_json_arm_round_trip():
+    a = np.arange(8, dtype=np.float32).reshape(2, 4) / 3.0
+    ids = np.array([5, 9], np.int64)
+    src, dst = _pipe()
+    wire.write_frame_json(src, {"op": "push"}, (ids, a))
+    header, payload_arrays = wire.read_frame(dst)
+    assert payload_arrays == [] and header["bufs"] == []  # all in the header
+    got_ids, got_a = wire.decode_json_arrays(header)
+    assert got_ids.dtype == np.int64 and got_a.dtype == np.float32
+    np.testing.assert_array_equal(got_ids, ids)
+    np.testing.assert_array_equal(got_a, a)      # f32 survives JSON exactly
+    src.close(); dst.close()
+
+
+def test_json_arm_is_bigger_on_the_wire():
+    a = np.random.default_rng(1).standard_normal((32, 16)).astype(np.float32)
+    assert len(_frame_bytes({"op": "x", "json_arrays": [
+        [a.dtype.name, list(a.shape), a.ravel().tolist()]]})) \
+        > 2 * len(_frame_bytes({"op": "x"}, (a,)))

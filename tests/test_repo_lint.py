@@ -948,6 +948,99 @@ def test_lint_gate_covers_sparse_package():
     assert "sparse.push" in KNOWN_SITES
 
 
+def _top_level_sparse_submodule_imports(
+        submods=("wire", "pserver", "client")):
+    """(rel, lineno) of every TOP-LEVEL import of the sparse WIRE TIER
+    (paddle_tpu/sparse/{wire,pserver,client}.py) from any module outside
+    the tier itself — including sparse/__init__.py, table.py and
+    session.py: importing paddle_tpu.sparse (the in-process
+    SparseTable/SparseSession surface) must not load a socket stack.
+    Lazy imports inside function bodies are the sanctioned form."""
+    own = {f"paddle_tpu/sparse/{m}.py" for m in submods}
+
+    def _is_hit(node, rel):
+        in_sparse = rel.startswith("paddle_tpu/sparse/")
+        full = tuple(f"paddle_tpu.sparse.{m}" for m in submods)
+        if isinstance(node, ast.Import):
+            return any(a.name.startswith(full) for a in node.names)
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith(full):
+                return True
+            if mod in ("paddle_tpu.sparse", "sparse"):
+                return any(a.name in submods for a in node.names)
+            if node.level > 0 and in_sparse:
+                # from .wire import X / from . import wire
+                if mod in submods:
+                    return True
+                if mod == "" and any(a.name in submods
+                                     for a in node.names):
+                    return True
+        return False
+
+    found = []
+    for rel, tree in _iter_sources():
+        if rel in own:
+            continue
+
+        def visit(node, in_func):
+            for child in ast.iter_child_nodes(node):
+                nested = in_func or isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                if _is_hit(child, rel) and not in_func:
+                    found.append((rel, child.lineno))
+                visit(child, nested)
+        visit(tree, False)
+    return found
+
+
+def test_pserver_wire_tier_only_imported_lazily():
+    """Zero-cost-when-unused for the sparse parameter-server WIRE tier
+    (ISSUE 17): importing paddle_tpu — or paddle_tpu.sparse itself,
+    i.e. running the in-process table — loads none of sparse/wire.py,
+    sparse/pserver.py, sparse/client.py.  Only the opted-in surfaces
+    (the `pserver` CLI branch, an explicit `from
+    paddle_tpu.sparse.client import RemoteSparseTable`) may load them,
+    lazily."""
+    problems = [
+        f"{rel}:{lineno}: top-level import of the sparse wire tier — "
+        f"must be lazy (inside a function) so `import "
+        f"paddle_tpu.sparse` stays socket-free"
+        for rel, lineno in _top_level_sparse_submodule_imports()]
+    assert not problems, "\n".join(problems)
+    # and the sanctioned lazy site exists (the CLI pserver branch)
+    with open(os.path.join(ROOT, "cli.py")) as fh:
+        assert "from paddle_tpu.sparse.pserver import pserver_main" \
+            in fh.read()
+    # the sparse package __init__ must not re-export the tier either
+    with open(os.path.join(ROOT, "sparse", "__init__.py")) as fh:
+        body = fh.read().split('"""', 2)[2]      # docstring MAY name it
+        for mod in ("wire", "pserver", "client"):
+            assert f"import {mod}" not in body
+
+
+def test_lint_gate_covers_pserver_tier():
+    """sparse/{wire,pserver,client}.py are inside every lint's scan
+    set, the pserver/* metric names are frozen in METRIC_NAMES, the
+    pserver/rpc span is frozen in SPAN_NAMES (the used==registered
+    check then keeps the client round instrumented), and the chaos
+    sites are registered in the faultinject harness."""
+    rels = {rel for rel, _ in _iter_sources()}
+    assert "paddle_tpu/sparse/wire.py" in rels
+    assert "paddle_tpu/sparse/pserver.py" in rels
+    assert "paddle_tpu/sparse/client.py" in rels
+    registered = {n for n, _ in _metric_names_table()}
+    assert {n for n in registered if n.startswith("pserver/")} >= {
+        "pserver/requests", "pserver/pull_rows", "pserver/push_rows",
+        "pserver/wire_bytes_in", "pserver/wire_bytes_out",
+        "pserver/frame_ms", "pserver/reconnects",
+        "pserver/replication_lag_ms", "pserver/backup_pushes",
+        "pserver/checkpoints"}
+    assert "pserver/rpc" in set(_span_names_table())
+    from paddle_tpu.testing.faultinject import KNOWN_SITES
+    assert {"pserver.rpc", "pserver.shard"} <= set(KNOWN_SITES)
+
+
 def test_shard_fn_registry_matches_ast_scan():
     """Same agreement gate for the sharding-propagation rules: every
     live register_shard_fn name is a string literal the duplicate lint

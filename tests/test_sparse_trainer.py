@@ -313,7 +313,11 @@ def test_trainer_guards():
     tr = pt.trainer.SGD(loss, update_equation=opt)
     with pytest.raises(ValueError, match="warmup"):
         tr.train(lambda: iter([]), sparse_tables=sess, warmup=True)
-    with pytest.raises(ValueError, match="elastic"):
+    # the elastic+sparse combination is a typed NotImplementedError whose
+    # message routes to the remote tier — the contract is pinned, not
+    # incidental (a bare ValueError would read as a usage mistake)
+    with pytest.raises(NotImplementedError,
+                       match="RemoteSparseTable.*pserver"):
         tr.train(lambda: iter([]), sparse_tables=sess, elastic=object(),
                  checkpoint_dir="/tmp/x")
 
