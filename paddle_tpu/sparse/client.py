@@ -41,6 +41,7 @@ import json
 import os
 import socket
 import threading
+import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -135,7 +136,13 @@ class RemoteSparseTable:
             "learning_rate": self.learning_rate,
             "epsilon": self.epsilon, "seed": self.seed, "init": init,
         }
-        self._cid = f"{os.getpid()}.{next(_CID_COUNTER)}"
+        # cid must be globally unique across the whole trainer fleet:
+        # shards dedup pushes on (cid, seq), and a pid-only cid collides
+        # across hosts (containers reuse low pids), silently dup-acking
+        # the second client's pushes.  hostname + pid + random covers
+        # hosts, processes, and pid reuse within a host.
+        self._cid = (f"{socket.gethostname()}.{os.getpid()}."
+                     f"{uuid.uuid4().hex[:8]}.{next(_CID_COUNTER)}")
         self._seq = 0
         self._socks: List[Optional[socket.socket]] = [None] * self.n_shards
         self._dials = [0] * self.n_shards
@@ -389,16 +396,22 @@ class RemoteSparseTable:
             grad_rows[live_sel].astype(self.dtype, copy=False))
         if self.wire_mode == "naive":
             return self._naive_push(live, grads, learning_rate)
+        # seq allocation and the round share ONE lock hold (the RLock
+        # makes _round's own acquisition nest): if a concurrent pusher
+        # could complete seq N+1's round before seq N's started, the
+        # shard would see N <= applied N+1 and dedup-drop a never-
+        # applied push.
         with self._lock:
             seq = self._seq
             self._seq += 1
-        per_shard = {
-            k: ({"op": "push", "table": self.name, "cid": self._cid,
-                 "seq": seq, "lr": learning_rate}, (sids, grads[sel]))
-            for k, sel, sids in self._partition(live)}
-        with span("pserver/rpc", op="push", table=self.name,
-                  shards=len(per_shard)):
-            replies = self._round(per_shard, what="push")
+            per_shard = {
+                k: ({"op": "push", "table": self.name, "cid": self._cid,
+                     "seq": seq, "lr": learning_rate},
+                    (sids, grads[sel]))
+                for k, sel, sids in self._partition(live)}
+            with span("pserver/rpc", op="push", table=self.name,
+                      shards=len(per_shard)):
+                replies = self._round(per_shard, what="push")
         return sum(reply.get("updated", 0)
                    for reply, _ in replies.values())
 
@@ -408,15 +421,17 @@ class RemoteSparseTable:
                   shards=self.n_shards, mode="naive"):
             for j, i in enumerate(live.tolist()):
                 k = i % self.n_shards
+                # same single lock hold over seq + round as push()
                 with self._lock:
                     seq = self._seq
                     self._seq += 1
-                replies = self._round(
-                    {k: ({"op": "push", "table": self.name,
-                          "cid": self._cid, "seq": seq,
-                          "lr": learning_rate},
-                         (np.asarray([i], np.int64), grads[j:j + 1]))},
-                    what="push")
+                    replies = self._round(
+                        {k: ({"op": "push", "table": self.name,
+                              "cid": self._cid, "seq": seq,
+                              "lr": learning_rate},
+                             (np.asarray([i], np.int64),
+                              grads[j:j + 1]))},
+                        what="push")
                 updated += replies[k][0].get("updated", 0)
         return updated
 

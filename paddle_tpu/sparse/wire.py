@@ -66,10 +66,15 @@ MAGIC = b"PTPS"
 WIRE_VERSION = 1
 _PREAMBLE = struct.Struct("<4sHIQ")      # magic, version, header_len, payload_len
 
-# Sanity caps: a torn/hostile preamble must never make the receiver
-# allocate unbounded memory before the protocol error surfaces.
-MAX_HEADER_BYTES = 1 << 26               # 64 MiB of JSON header
-MAX_PAYLOAD_BYTES = 1 << 36              # 64 GiB of row payload
+# Sanity caps, sized to the largest plausible single frame on this
+# tier (a whole-shard export), not "anything addressable".  Module
+# knobs: a deployment hosting bigger shards can raise them on both
+# ends.  Declared lengths past a cap are a protocol error before any
+# receive happens; below it, _recv_exact still grows its buffer
+# chunk-wise, so memory tracks the bytes the peer actually sent — a
+# torn or hostile preamble alone can never force a large allocation.
+MAX_HEADER_BYTES = 1 << 24               # 16 MiB of JSON header
+MAX_PAYLOAD_BYTES = 1 << 30              # 1 GiB of row payload
 
 
 class WireError(RuntimeError):
@@ -158,20 +163,32 @@ def decode_json_arrays(header: Dict) -> List[np.ndarray]:
     return out
 
 
+_RECV_CHUNK = 1 << 20                    # grow receive buffers 1 MiB at a time
+
+
 def _recv_exact(sock, n: int, what: str, *, eof_ok: bool = False
                 ) -> Optional[memoryview]:
-    buf = bytearray(n)
-    view = memoryview(buf)
+    """Receive exactly ``n`` bytes.  The buffer grows in
+    ``_RECV_CHUNK`` steps as bytes arrive, never ``n`` up-front, so a
+    declared length only costs memory once the peer actually sends the
+    bytes."""
+    buf = bytearray(min(n, _RECV_CHUNK))
     got = 0
     while got < n:
-        r = sock.recv_into(view[got:], n - got)
+        if got == len(buf):
+            buf += bytes(min(n - got, _RECV_CHUNK))
+        view = memoryview(buf)[got:]
+        try:
+            r = sock.recv_into(view)
+        finally:
+            view.release()       # else the next resize would fail
         if r == 0:
             if got == 0 and eof_ok:
                 return None      # clean close at a frame boundary
             raise WireTruncatedError(
                 f"peer closed mid-{what}: got {got}/{n} bytes")
         got += r
-    return view
+    return memoryview(buf)
 
 
 def read_frame(sock, *, eof_ok: bool = False
@@ -205,9 +222,11 @@ def read_frame(sock, *, eof_ok: bool = False
     hdr = _recv_exact(sock, header_len, "frame header")
     try:
         header = json.loads(bytes(hdr).decode("utf-8"))
-        bufs = header.get("bufs", [])
-        if not isinstance(header, dict) or not isinstance(bufs, list):
+        if not isinstance(header, dict):
             raise ValueError("frame header must be a JSON object")
+        bufs = header.get("bufs", [])
+        if not isinstance(bufs, list):
+            raise ValueError("frame header 'bufs' must be a list")
     except (ValueError, UnicodeDecodeError) as e:
         raise WireProtocolError(f"undecodable frame header: {e}") from e
     payload = _recv_exact(sock, payload_len, "frame payload") \

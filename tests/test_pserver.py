@@ -217,6 +217,20 @@ def test_push_retry_dedup_exactly_once():
     assert r3["updated"] == 2 and s.pushes_applied == 2
 
 
+def test_cid_globally_unique_shape():
+    # shards dedup pushes on (cid, seq): a pid-only cid collides across
+    # hosts (containers reuse low pids) and silently dup-acks the second
+    # client's pushes, so the cid carries hostname + pid + a random
+    # component and never repeats within a process either
+    import os
+    import socket
+    kw = dict(vocab_size=8, dim=2, addrs=[(HOST, 1)])
+    cids = {RemoteSparseTable("t", **kw)._cid for _ in range(8)}
+    assert len(cids) == 8
+    for cid in cids:
+        assert cid.startswith(f"{socket.gethostname()}.{os.getpid()}.")
+
+
 def test_faultinject_rpc_transient_is_retried(fleet2):
     kw = dict(vocab_size=32, dim=4, seed=0)
     oracle = SparseTable("t", num_shards=2, **kw)

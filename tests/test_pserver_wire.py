@@ -229,6 +229,41 @@ def test_undecodable_header_fatal():
     rd.close()
 
 
+def test_non_object_json_header_fatal():
+    # valid JSON that is not an object must be the TYPED protocol error
+    # (an AttributeError here would unwind the server's serve loop)
+    for bad in (b"[1,2]", b"42", b'"x"', b"null"):
+        raw = wire._PREAMBLE.pack(wire.MAGIC, wire.WIRE_VERSION,
+                                  len(bad), 0) + bad
+        rd = _send_bytes(raw)
+        with pytest.raises(wire.WireProtocolError, match="JSON object"):
+            wire.read_frame(rd)
+        rd.close()
+
+
+def test_non_list_bufs_fatal():
+    rd = _send_bytes(_handcrafted({"bufs": 5}, b""))
+    with pytest.raises(wire.WireProtocolError, match="'bufs'"):
+        wire.read_frame(rd)
+    rd.close()
+
+
+def test_frame_larger_than_recv_chunk_round_trips():
+    # exercises _recv_exact's chunk-wise buffer growth: the payload is
+    # several _RECV_CHUNKs, so the receive crosses multiple grow steps
+    a = np.arange(3 * (1 << 17) + 11, dtype=np.float64)  # > 3 MiB
+    assert a.nbytes > 3 * wire._RECV_CHUNK
+    src, dst = _pipe()
+    t = threading.Thread(
+        target=lambda: (wire.write_frame(src, {"op": "big"}, (a,)),
+                        src.close()))
+    t.start()
+    header, (got,) = wire.read_frame(dst)
+    t.join(timeout=5.0)
+    assert got.tobytes() == a.tobytes()
+    dst.close()
+
+
 def test_bad_descriptor_shape_fatal():
     rd = _send_bytes(_handcrafted({"bufs": [["<f4"]]}, b""))
     with pytest.raises(wire.WireProtocolError, match="descriptor"):
