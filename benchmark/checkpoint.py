@@ -1,0 +1,370 @@
+#!/usr/bin/env python
+"""Incremental-checkpoint benchmark (ISSUE 18): dirty-row sparse deltas
+and chunked dense diffs vs the full-save control, per the PR 9 paired-
+alternating discipline (median of per-pair ratios, noise gate, raw
+windows committed, refusals honest).  All rows REAL and in-container
+(CPU; the TPU row is a pending-hardware stub per the PR 1 convention).
+
+Arms:
+
+* ``commit_ab`` — the tentpole gate: per-task delta commit vs full-save
+  control at a 2M-row vocab with ~0.5% of the resident working set
+  touched per task.  Both arms train the SAME feed schedule on
+  identically-seeded tables, each committing blocking (wall includes
+  serialization + write + fsync).  Gates: wall ``min_speedup=5.0`` via
+  the paired A/B, plus ``bytes_ratio >= 10`` from the committed
+  manifests.  After the timed windows BOTH tips are restored and
+  asserted bit-identical (rows, Adagrad moment, export bytes) to each
+  other and to the live tables — the delta chain is fast because it
+  writes less, not because it drops state.
+* ``elastic_tasks`` — the task-boundary loop the elastic worker runs:
+  per task push + async commit through the REAL ``Checkpointer``
+  (``DeltaPolicy`` off vs on), durability barrier (``manager.wait()``)
+  at the window edge where task_finished reports.  Reported as tasks/s
+  per arm; the delta arm includes its periodic rebases (max_chain=8).
+* ``restore_chain`` — recovery cost: restore wall for a base+K-delta
+  chain vs a single full save of the SAME final state, shas asserted
+  equal.  Chain replay is expected to cost MORE than a full restore —
+  this row prices the durability win, it does not gate on it.
+
+Writes benchmark/checkpoint_results.json.
+
+Usage::
+
+    python benchmark/checkpoint.py [--smoke] [--out PATH]
+    python benchmark/run.py --model checkpoint [--smoke]
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "checkpoint_results.json")
+
+FULL = {
+    "vocab": 2_000_000,
+    "dim": 16,
+    "num_shards": 4,
+    "resident_rows": 400_000,     # warm working set (rows on disk)
+    "touched_per_task": 2_000,    # 0.5% of resident per task
+    "ab_pairs": 4,
+    "elastic_tasks_per_window": 3,
+    "elastic_pairs": 3,
+    "dense_param_floats": 1_000_000,   # 4 MB dense rider (chunk-diffed)
+    "chain_k": 8,
+}
+SMOKE = {
+    "vocab": 50_000,
+    "dim": 8,
+    "num_shards": 3,
+    "resident_rows": 4_000,
+    "touched_per_task": 40,
+    "ab_pairs": 2,
+    "elastic_tasks_per_window": 2,
+    "elastic_pairs": 2,
+    "dense_param_floats": 20_000,
+    "chain_k": 3,
+}
+
+
+# -- plumbing ----------------------------------------------------------------
+
+def _mk_table(cfg, name="emb"):
+    from paddle_tpu.sparse import SparseTable
+    return SparseTable(name, cfg["vocab"], cfg["dim"],
+                       optimizer="adagrad", learning_rate=0.05,
+                       num_shards=cfg["num_shards"], seed=3)
+
+
+def _warm(cfg, t):
+    ids = np.arange(cfg["resident_rows"], dtype=np.int64)
+    g = np.random.RandomState(7).standard_normal(
+        (len(ids), cfg["dim"])).astype(np.float32)
+    t.push(ids, g)
+
+
+def _feed(cfg, n_tasks, seed):
+    """Per-task (ids, grads) touching ~0.5% of the resident set."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_tasks):
+        ids = rng.choice(cfg["resident_rows"], size=cfg["touched_per_task"],
+                         replace=False).astype(np.int64)
+        out.append((ids, rng.standard_normal(
+            (len(ids), cfg["dim"])).astype(np.float32)))
+    return out
+
+
+def _scope(state, **dense):
+    import paddle_tpu as pt
+    sc = pt.Scope()
+    for k, v in state.items():
+        sc.set(k, v)
+    for k, v in dense.items():
+        sc.set(k, v)
+    return sc
+
+
+def _sha(state, extra=None):
+    h = hashlib.sha256()
+    for k in sorted(state):
+        a = np.ascontiguousarray(np.asarray(state[k]))
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    if extra is not None:
+        h.update(np.asarray(extra, np.float32).tobytes())
+    return h.hexdigest()
+
+
+def _restore_sha(cfg, root):
+    """Restore the newest commit and reduce it to the canonical table
+    export sha (+ dense vars hashed alongside)."""
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    sc = pt.Scope()
+    cm = CheckpointManager(root, async_save=False)
+    step = cm.restore(scope=sc)
+    state = {k: np.asarray(sc.get(k)) for k in sc.keys()
+             if k.startswith("__sparse__/")}
+    t = _mk_table(cfg)
+    t.restore_state_vars(state)
+    dense = [np.asarray(sc.get(k), np.float32)
+             for k in sorted(sc.keys())
+             if not k.startswith("__sparse__/")
+             and not k.startswith("__train_state__")]
+    h = hashlib.sha256(_sha(t.export_state_vars()).encode())
+    for a in dense:
+        h.update(a.tobytes())
+    return step, h.hexdigest()
+
+
+# -- arms --------------------------------------------------------------------
+
+def run_commit_ab(cfg, workdir, quiet=False):
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    from paddle_tpu.tuning.search import paired_ab
+
+    arms = {}
+    for mode in ("full", "delta"):
+        t = _mk_table(cfg)
+        _warm(cfg, t)
+        cm = CheckpointManager(os.path.join(workdir, f"ab_{mode}"),
+                               async_save=False, max_to_keep=3)
+        # both arms start from the SAME committed base so the delta arm
+        # chains and the full arm's windows measure steady-state saves
+        tok, st = t.export_full()
+        cm.save(0, _scope(st), blocking=True, kind="full",
+                on_commit=lambda info, tk=tok, tt=t: tt.commit_delta(tk))
+        arms[mode] = {"t": t, "cm": cm, "cursor": 0, "step": 0,
+                      "bytes": []}
+    n_windows = max(2, cfg["ab_pairs"]) + 1          # + warmup
+    feeds = _feed(cfg, n_windows, seed=11)
+
+    def measure(config):
+        arm = arms[config["mode"]]
+        ids, g = feeds[arm["cursor"]]
+        arm["cursor"] += 1
+        arm["t"].push(ids, g)
+        arm["step"] += 1
+        kind = config["mode"]
+        tok, st = (arm["t"].export_full() if kind == "full"
+                   else arm["t"].export_delta())
+        box = {}
+        arm["cm"].save(arm["step"], _scope(st), blocking=True, kind=kind,
+                       on_commit=lambda info, tk=tok, a=arm:
+                           (a["t"].commit_delta(tk), box.update(info)),
+                       on_fail=lambda exc, tk=tok, a=arm:
+                           a["t"].retract_delta(tk))
+        arm["bytes"].append(int(box["bytes"]))
+
+    ab = paired_ab(measure, {"mode": "full"}, {"mode": "delta"},
+                   pairs=cfg["ab_pairs"], warmup=1, min_speedup=5.0)
+    # bytes gate from the manifests of the TIMED windows (skip warmup)
+    fb = [float(b) for b in arms["full"]["bytes"][1:]]
+    db = [float(b) for b in arms["delta"]["bytes"][1:]]
+    bytes_ratio = float(np.median(fb) / max(1.0, np.median(db)))
+    ab["full_bytes_per_commit"] = fb
+    ab["delta_bytes_per_commit"] = db
+    ab["bytes_ratio"] = round(bytes_ratio, 2)
+    ab["min_bytes_ratio"] = 10.0
+    ab["bytes_accepted"] = bool(bytes_ratio >= 10.0)
+    ab["touched_fraction"] = cfg["touched_per_task"] / cfg["resident_rows"]
+    # bit-identity: both arms trained the same schedule, so the restored
+    # delta tip must equal the restored full tip AND the live tables
+    live = _sha(arms["full"]["t"].export_state_vars())
+    assert _sha(arms["delta"]["t"].export_state_vars()) == live, \
+        "arms diverged: the A/B compared two different runs"
+    _, full_sha = _restore_sha(cfg, os.path.join(workdir, "ab_full"))
+    _, delta_sha = _restore_sha(cfg, os.path.join(workdir, "ab_delta"))
+    ab["restore_bit_identical"] = bool(full_sha == delta_sha)
+    assert ab["restore_bit_identical"], \
+        "delta-chain restore diverged from the full-save oracle"
+    if not quiet:
+        print(json.dumps({"arm": "commit_ab", "speedup": ab["speedup"],
+                          "accepted": ab["accepted"],
+                          "bytes_ratio": ab["bytes_ratio"],
+                          "bytes_accepted": ab["bytes_accepted"]}),
+              flush=True)
+    return ab
+
+
+def run_elastic_tasks(cfg, workdir, quiet=False):
+    """The elastic task-boundary loop through the real Checkpointer:
+    async commit per task, durable barrier at the window edge."""
+    import paddle_tpu as pt
+    from paddle_tpu.sparse import SparseSession
+    from paddle_tpu.train_state import Checkpointer, DeltaPolicy
+    from paddle_tpu.tuning.search import paired_ab
+
+    class _Exe:
+        _step = 0
+
+    arms = {}
+    for mode in ("full", "delta"):
+        t = _mk_table(cfg)
+        _warm(cfg, t)
+        sess = SparseSession(t)
+        scope = pt.Scope()
+        scope.set("w", np.zeros(cfg["dense_param_floats"], np.float32))
+        ck = Checkpointer(os.path.join(workdir, f"el_{mode}"), _Exe(),
+                          handle_signals=False, delta_source=sess,
+                          delta=DeltaPolicy(enabled=(mode == "delta")))
+        ck.begin(scope, None, 0, {})
+        arms[mode] = {"t": t, "ck": ck, "cursor": 0}
+    per_win = cfg["elastic_tasks_per_window"]
+    n_tasks = (max(2, cfg["elastic_pairs"]) + 1) * per_win
+    feeds = _feed(cfg, n_tasks, seed=13)
+
+    def measure(config):
+        arm = arms[config["mode"]]
+        ck = arm["ck"]
+        for _ in range(per_win):
+            ids, g = feeds[arm["cursor"]]
+            arm["cursor"] += 1
+            arm["t"].push(ids, g)
+            ck.emitted += 1
+            ck._save(0, 0)                      # async commit pipeline
+        ck.manager.wait()                       # task_finished barrier
+
+    ab = paired_ab(measure, {"mode": "full"}, {"mode": "delta"},
+                   pairs=cfg["elastic_pairs"], warmup=1)
+    ab["tasks_per_window"] = per_win
+    ab["tasks_per_s"] = {
+        m: round(per_win / float(np.median(w)), 3)
+        for m, w in (("full", ab["default_windows"]),
+                     ("delta", ab["candidate_windows"]))}
+    for arm in arms.values():                   # drain before teardown
+        arm["ck"].manager.wait()
+    if not quiet:
+        print(json.dumps({"arm": "elastic_tasks",
+                          "speedup": ab["speedup"],
+                          "accepted": ab["accepted"],
+                          "tasks_per_s": ab["tasks_per_s"]}), flush=True)
+    return ab
+
+
+def run_restore_chain(cfg, workdir, quiet=False):
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+    t = _mk_table(cfg)
+    _warm(cfg, t)
+    chain = CheckpointManager(os.path.join(workdir, "chain"),
+                              async_save=False, max_to_keep=cfg["chain_k"] + 2)
+    tok, st = t.export_full()
+    chain.save(0, _scope(st), blocking=True, kind="full",
+               on_commit=lambda info, tk=tok: t.commit_delta(tk))
+    for k, (ids, g) in enumerate(_feed(cfg, cfg["chain_k"], seed=17), 1):
+        t.push(ids, g)
+        tok, st = t.export_delta()
+        chain.save(k, _scope(st), blocking=True, kind="delta",
+                   on_commit=lambda info, tk=tok: t.commit_delta(tk))
+    # a single full save of the SAME final state is the control
+    ctrl = CheckpointManager(os.path.join(workdir, "ctrl"),
+                             async_save=False)
+    tok, st = t.export_full()
+    ctrl.save(cfg["chain_k"], _scope(st), blocking=True, kind="full",
+              on_commit=lambda info, tk=tok: t.commit_delta(tk))
+
+    t0 = time.perf_counter()
+    step_c, sha_c = _restore_sha(cfg, os.path.join(workdir, "chain"))
+    chain_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    step_f, sha_f = _restore_sha(cfg, os.path.join(workdir, "ctrl"))
+    full_ms = (time.perf_counter() - t0) * 1e3
+    assert step_c == step_f == cfg["chain_k"]
+    row = {
+        "chain_len": cfg["chain_k"],
+        "chain_restore_ms": round(chain_ms, 1),
+        "full_restore_ms": round(full_ms, 1),
+        "replay_overhead_x": round(chain_ms / max(1e-9, full_ms), 2),
+        "bit_identical": bool(sha_c == sha_f),
+    }
+    assert row["bit_identical"], \
+        "base+K-delta replay diverged from the full-save oracle"
+    if not quiet:
+        print(json.dumps({"arm": "restore_chain", **row}), flush=True)
+    return row
+
+
+def run_all(cfg=None, smoke=False, quiet=False):
+    cfg = cfg or (SMOKE if smoke else FULL)
+    with tempfile.TemporaryDirectory(prefix="pt-ckpt-bench-") as workdir:
+        commit_ab = run_commit_ab(cfg, workdir, quiet=quiet)
+        elastic = run_elastic_tasks(cfg, workdir, quiet=quiet)
+        restore = run_restore_chain(cfg, workdir, quiet=quiet)
+    return {
+        "config": dict(cfg),
+        "commit_ab": commit_ab,
+        "elastic_tasks": elastic,
+        "restore_chain": restore,
+        "smoke": bool(smoke),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast path check (tiny sizes); does not "
+                         "overwrite the committed results file")
+    ap.add_argument("--out", default=OUT)
+    args = ap.parse_args()
+
+    row = run_all(smoke=args.smoke)
+    print(json.dumps(row, indent=1))
+    if args.smoke:
+        return
+    result = {
+        "benchmark": "incremental_checkpoint",
+        "device": "cpu (in-container; no TPU reachable)",
+        "cpu": row,
+        "tpu": {
+            "status": "pending-hardware",
+            "plan": "re-run benchmark/checkpoint.py on a chip host: the "
+                    "commit path is host-side (serialize + fsync) and "
+                    "the gates should hold as-is; the interesting chip "
+                    "row is elastic_tasks with real training steps "
+                    "overlapping the async writer",
+            "rows": [],
+        },
+    }
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -231,6 +231,14 @@ def run_pserver(smoke=False):
     return [run_all(smoke=smoke)]
 
 
+def run_checkpoint(smoke=False):
+    """Delegate to benchmark/checkpoint.py (incremental checkpointing:
+    delta-commit vs full-save wall/bytes A/B, elastic task-boundary
+    commit throughput, base+K-delta chain restore cost)."""
+    from benchmark.checkpoint import run_all
+    return [run_all(smoke=smoke)]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None,
@@ -240,11 +248,14 @@ def main():
                          "for the tuned-vs-default autotuner A/B, "
                          "'ctr' for the sparse-parameter-server CTR A/B, "
                          "'decode' for the continuous-batching "
-                         "incremental-decode A/B, or 'pserver' for the "
-                         "multi-host sparse parameter-server wire A/B")
+                         "incremental-decode A/B, 'pserver' for the "
+                         "multi-host sparse parameter-server wire A/B, "
+                         "or 'checkpoint' for the incremental-"
+                         "checkpoint delta-vs-full A/B")
     ap.add_argument("--smoke", action="store_true",
                     help="input_pipeline/compile_cache/autotune/ctr/"
-                         "decode/pserver only: seconds-fast path check")
+                         "decode/pserver/checkpoint only: seconds-fast "
+                         "path check")
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--iters", type=int, default=None,
                     help="steps per timed window (default: 60 for the "
@@ -277,6 +288,9 @@ def main():
         return
     if args.model == "pserver":
         run_pserver(smoke=args.smoke)
+        return
+    if args.model == "checkpoint":
+        run_checkpoint(smoke=args.smoke)
         return
     if args.all:
         for name, batch in HEADLINE:

@@ -15,6 +15,37 @@ once) plus a per-process manifest; process 0 merges the manifests and writes
 if the destination scope already holds a sharded array of the right shape,
 the checkpoint is read back shard-by-shard through ``mmap`` straight onto the
 matching devices (``jax.make_array_from_callback``) without a full host copy.
+
+**Incremental commits (delta chains).**  A commit is either a full base
+(``kind: "full"``) or a delta (``kind: "delta"``) referencing its parent
+commit by content hash (sha256 over the canonical manifest, chained
+git-style through ``parent``).  Three var modes ride in the manifest:
+
+* ``sparse`` — ``__sparse__/<table>/shard<k>/...`` triples.  A delta
+  commit's files hold only the table's DIRTY rows (sorted by id); restore
+  replays base→deltas merging by id, which is bit-identical to a full
+  export under ANY restoring shard count (rows re-insert by id).
+* ``chunks`` — dense vars diff at fixed-size chunk granularity: every
+  commit records the sha256 chunk table of each piece, and a delta writes
+  a ``.patch`` file holding only the chunks whose hash changed vs the
+  parent (an unchanged var writes nothing at all).
+* ``replace`` — whole-var writes (full commits, and any var a delta
+  cannot diff: new name, changed shape/dtype, changed piece layout).
+
+Restore of a delta tip resolves the parent chain (any broken/corrupt link
+fails the WHOLE tip, falling back to the previous durable commit — the
+torn-chain guarantee the ``ckpt.delta`` chaos site pins), replays
+base→deltas, and verifies both per-file md5s and the replayed chunk
+tables.  Retention is chain-aware: a kept tip retains every ancestor it
+still needs.  Delta commits are single-process (multi-host runs keep the
+full-save protocol; the chain machinery never adds collectives).
+
+Serialization + fsync run off the training thread on a persistent writer
+with a bounded queue (depth 1 → double-buffered: the trainer snapshots
+commit N+1 while N writes/fsyncs).  ``wait()`` is the hard durability
+barrier; ``on_commit``/``on_fail`` callbacks fire after the durable
+ack/failure — the hook the sparse dirty-set commit/retract protocol and
+the exactly-once elastic progress report hang off.
 """
 from __future__ import annotations
 
@@ -23,9 +54,10 @@ import json
 import logging
 import os
 import shutil
+import signal
 import threading
 import time
-from typing import Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -37,6 +69,18 @@ logger = logging.getLogger("paddle_tpu")
 # default for the cross-process commit/manifest barrier (overridable per
 # manager and via PADDLE_TPU_CKPT_TIMEOUT_S)
 DEFAULT_BARRIER_TIMEOUT_S = 600.0
+
+#: fixed chunk size for dense-var diffing in delta commits
+DEFAULT_CHUNK_BYTES = 1 << 20
+
+#: thread-name prefix of the async commit writer; the worker exits after
+#: a bounded idle linger (the sparse-session worker convention) so
+#: managers never leak threads without an explicit close
+THREAD_NAME_PREFIX = "pt-ckpt"
+
+_WRITER_LINGER_S = 0.5
+
+_SPARSE_PREFIX = "__sparse__/"
 
 
 class CheckpointTimeoutError(TimeoutError):
@@ -50,6 +94,12 @@ class CheckpointTimeoutError(TimeoutError):
             f"checkpoint barrier timed out after {timeout_s:g}s: {tag}")
         self.tag = tag
         self.timeout_s = timeout_s
+
+
+class DeltaChainError(RuntimeError):
+    """A delta commit cannot chain: no live committed parent, a
+    multi-process run, or a sparse shard layout that no longer matches
+    the parent manifest.  Callers fall back to a full rebase."""
 
 
 def _index_to_json(index, shape):
@@ -74,6 +124,57 @@ def _file_md5(path):
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+def _chunk_hashes(raw, chunk_bytes: int) -> List[str]:
+    """sha256 per fixed-size chunk of ``raw`` (the last chunk may be
+    short).  An empty buffer has an empty table."""
+    mv = memoryview(raw)
+    return [hashlib.sha256(mv[o:o + chunk_bytes]).hexdigest()
+            for o in range(0, len(mv), chunk_bytes)]
+
+
+def _meta_content_hash(meta: dict) -> str:
+    """Content hash of a commit: sha256 over the canonical JSON of the
+    meta WITHOUT the hash field itself.  The manifest carries every
+    file's md5 (and the parent's hash for deltas), so this transitively
+    commits to the chain's content, git-style."""
+    doc = {k: v for k, v in meta.items() if k != "content_hash"}
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode("utf-8")).hexdigest()
+
+
+def _fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _sparse_group(name: str) -> Optional[Tuple[str, str]]:
+    """``__sparse__/<t>/shard<k>/<member>`` -> (group prefix, member);
+    None for everything else (incl. the per-table ``/meta`` blob, which
+    replaces wholly)."""
+    if not name.startswith(_SPARSE_PREFIX):
+        return None
+    parts = name.split("/")
+    if len(parts) >= 4 and parts[2].startswith("shard"):
+        return "/".join(parts[:3]), "/".join(parts[3:])
+    return None
 
 
 def _shard_snapshot(name, arr):
@@ -107,10 +208,12 @@ class CheckpointManager:
     def __init__(self, root: str, max_to_keep: int = 3, async_save: bool = True,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None, barrier=None,
-                 barrier_timeout_s: Optional[float] = None):
+                 barrier_timeout_s: Optional[float] = None,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES):
         self.root = root
         self.max_to_keep = max_to_keep
         self.async_save = async_save
+        self.chunk_bytes = int(chunk_bytes)
         # cross-process file-barrier budget: constructor > env > default
         # (a big sharded model on slow storage legitimately needs more
         # than the default; a unit test wants far less)
@@ -128,11 +231,26 @@ class CheckpointManager:
         self._process_index = process_index
         self._process_count = process_count
         self._barrier = barrier
-        self._thread: Optional[threading.Thread] = None
-        # a failure in the async writer thread is held here and re-raised
-        # from the next wait()/save() on the calling thread — an
-        # uncommitted checkpoint must never be silently recorded as saved
+        # persistent async writer: a bounded FIFO queue (depth 1 =
+        # double-buffered — snapshot N+1 while N writes/fsyncs) drained
+        # by an idle-linger worker.  A failure is held sticky and
+        # re-raised from the next save()/wait() on the calling thread —
+        # an uncommitted checkpoint is never silently recorded as saved.
+        self._wcv = threading.Condition()
+        self._wq: List[dict] = []
+        self._winflight: Optional[dict] = None
+        self._wthread: Optional[threading.Thread] = None
+        self._writer_linger_s = _WRITER_LINGER_S
         self._write_failure: Optional[BaseException] = None
+        # delta-chain state (single-process only).  _committed is the
+        # durable tip's meta (the writer's truth: manifest + chunk
+        # tables the next delta diffs against); _planned_* is the main
+        # thread's optimistic view used for rebase policy while a write
+        # is still in flight.
+        self._chain_lock = threading.Lock()
+        self._committed: Optional[dict] = None
+        self._planned_alive = False
+        self._planned_len = 0
         os.makedirs(root, exist_ok=True)
 
     def _proc(self):
@@ -148,14 +266,76 @@ class CheckpointManager:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(tag)
 
+    # -- delta-chain surface -------------------------------------------------
+    def delta_supported(self) -> bool:
+        """Delta commits are single-process (the chain machinery never
+        adds collectives; multi-host runs keep the full protocol)."""
+        _, nprocs = self._proc()
+        return nprocs == 1
+
+    def chain_stats(self) -> dict:
+        """Policy inputs for the caller's rebase decision: ``alive`` —
+        a chainable tip exists (committed, or planned by an in-flight
+        write); ``len`` — planned chain length; ``bytes`` — cumulative
+        delta bytes since the last committed base; ``base_bytes`` — the
+        last committed base's size."""
+        with self._chain_lock:
+            tip = self._committed
+            return {"alive": self._planned_alive,
+                    "len": self._planned_len,
+                    "bytes": 0 if tip is None else int(
+                        tip.get("chain_bytes", 0)),
+                    "base_bytes": 0 if tip is None else int(
+                        tip.get("base_bytes", 0))}
+
+    def _adopt_tip(self, meta: Optional[dict]):
+        _, nprocs = self._proc()
+        if nprocs != 1:
+            return
+        with self._chain_lock:
+            chainable = bool(meta and meta.get("content_hash"))
+            self._committed = meta if chainable else None
+            self._planned_alive = chainable
+            self._planned_len = int(meta.get("chain_len", 0)) \
+                if chainable else 0
+
     # -- save --------------------------------------------------------------
     def save(self, step: int, scope: Optional[Scope] = None,
-             var_names=None, blocking: bool = False):
-        import jax
-
+             var_names=None, blocking: bool = False, kind: str = "full",
+             on_commit: Optional[Callable[[dict], None]] = None,
+             on_fail: Optional[Callable[[BaseException], None]] = None):
+        """Snapshot ``scope`` synchronously and commit it, async by
+        default.  ``kind="delta"`` chains onto the committed tip (sparse
+        vars must hold the dirty-rows-only export; dense vars chunk-diff
+        automatically) and requires a live single-process chain —
+        :class:`DeltaChainError` otherwise, BEFORE anything is written,
+        so the caller can re-export a full rebase.  ``on_commit(info)``
+        fires after the durable ack (fsync'd, meta committed);
+        ``on_fail(exc)`` fires if the write fails or is dropped because
+        an earlier queued write failed."""
+        if kind not in ("full", "delta"):
+            raise ValueError(f"save kind must be 'full' or 'delta', "
+                             f"got {kind!r}")
         scope = global_scope() if scope is None else scope
         names = var_names or scope.keys()
-        self.wait()                    # never two writers for one manager
+        proc, nprocs = self._proc()
+        # sticky async failure surfaces on the calling thread first (the
+        # historical wait()-in-save contract)
+        self._raise_write_failure()
+        if kind == "delta":
+            if nprocs != 1:
+                raise DeltaChainError(
+                    "delta commits are single-process; multi-host runs "
+                    "keep the full-save protocol")
+            with self._chain_lock:
+                if not self._planned_alive:
+                    raise DeltaChainError(
+                        "no live parent chain (nothing committed or the "
+                        "last write failed) — export a full rebase")
+        # a re-save of a pending step (emergency over periodic) must not
+        # race the writer inside the same tmp dir: drain first
+        if step in self._pending_steps():
+            self.wait()
         # snapshot to host synchronously (per-shard copies, cheap vs a
         # training step and never a cross-device gather); write async
         snap = {}
@@ -168,21 +348,124 @@ class CheckpointManager:
                        if pieces else str(getattr(arr, "dtype", "float32")),
                        pieces)
         nonce = self._begin_attempt(step)
+        job = {"step": step, "snap": snap, "nonce": nonce, "kind": kind,
+               "on_commit": on_commit, "on_fail": on_fail}
+        if nprocs == 1:
+            with self._chain_lock:
+                if kind == "delta":
+                    self._planned_len += 1
+                else:
+                    self._planned_alive = True
+                    self._planned_len = 0
         if self.async_save and not blocking:
-            self._thread = threading.Thread(
-                target=self._write_guarded, args=(step, snap, nonce),
-                daemon=True)
-            self._thread.start()
+            with self._wcv:
+                self._raise_write_failure_locked()
+                while self._wq and self._write_failure is None:
+                    self._wcv.wait()
+                self._raise_write_failure_locked()
+                self._wq.append(job)
+                if self._wthread is None:
+                    t = threading.Thread(
+                        target=self._writer_main,
+                        name=f"{THREAD_NAME_PREFIX}-writer", daemon=True)
+                    self._wthread = t
+                    t.start()
+                self._wcv.notify_all()
         else:
-            self._write(step, snap, nonce)
+            self.wait()                  # FIFO after any queued writes
+            self._run_job(job)
 
-    def _write_guarded(self, step, snap, nonce):
+    def _pending_steps(self):
+        with self._wcv:
+            steps = {j["step"] for j in self._wq}
+            if self._winflight is not None:
+                steps.add(self._winflight["step"])
+            return steps
+
+    def _raise_write_failure_locked(self):
+        if self._write_failure is not None:
+            err, self._write_failure = self._write_failure, None
+            raise err
+
+    def _raise_write_failure(self):
+        with self._wcv:
+            self._raise_write_failure_locked()
+
+    def _writer_main(self):
+        while True:
+            with self._wcv:
+                if not self._wq:
+                    self._wcv.wait(timeout=self._writer_linger_s)
+                    if not self._wq:
+                        self._wthread = None
+                        self._wcv.notify_all()
+                        return
+                job = self._wq.pop(0)
+                self._winflight = job
+                self._wcv.notify_all()   # unblock a bounded producer
+            try:
+                self._run_job(job)
+            except BaseException as e:  # noqa: BLE001 — held sticky and
+                # re-raised from the next save()/wait() on the caller's
+                # thread.  Queued jobs are DROPPED with their on_fail
+                # fired: a delta queued behind a failed commit has no
+                # durable parent to chain onto.
+                logger.error("async checkpoint write for ckpt-%s failed: "
+                             "%s: %s", job["step"], type(e).__name__, e)
+                with self._wcv:
+                    self._write_failure = e
+                    dropped = list(self._wq)
+                    self._wq.clear()
+                    self._winflight = None
+                    self._wthread = None
+                    self._wcv.notify_all()
+                for dj in dropped:
+                    self._safe_call(dj.get("on_fail"), e)
+                return
+            with self._wcv:
+                self._winflight = None
+                self._wcv.notify_all()
+
+    @staticmethod
+    def _safe_call(fn, *args):
+        if fn is None:
+            return
         try:
-            self._write(step, snap, nonce)
-        except BaseException as e:  # noqa: BLE001 — re-raised in wait()
-            logger.error("async checkpoint write for ckpt-%s failed: "
-                         "%s: %s", step, type(e).__name__, e)
-            self._write_failure = e
+            fn(*args)
+        except Exception:  # noqa: BLE001 — a callback must never mask
+            logger.exception("checkpoint commit callback failed")
+
+    def _run_job(self, job):
+        t0 = time.perf_counter()
+        try:
+            info = self._write(job["step"], job["snap"], job["nonce"],
+                               job["kind"])
+        except BaseException as e:  # noqa: BLE001
+            _, nprocs = self._proc()
+            if nprocs == 1:
+                with self._chain_lock:
+                    self._planned_alive = False
+                    self._planned_len = 0
+            self._safe_call(job.get("on_fail"), e)
+            raise
+        info["ms"] = (time.perf_counter() - t0) * 1e3
+        self._emit_commit(info)
+        self._safe_call(job.get("on_commit"), info)
+        return info
+
+    def _emit_commit(self, info):
+        from ..observability import emit_event, inc_counter, observe_hist
+        observe_hist("checkpoint/commit_ms", info["ms"])
+        if info["kind"] == "delta":
+            inc_counter("checkpoint/delta_bytes", info["bytes"])
+            inc_counter("checkpoint/delta_rows", info["rows"])
+        elif info.get("rebase"):
+            inc_counter("checkpoint/rebase_total")
+        emit_event("ckpt", event="commit", step=info["step"],
+                   commit_kind=info["kind"], bytes=info["bytes"],
+                   rows=info["rows"], ms=round(info["ms"], 3),
+                   chain_len=info.get("chain_len", 0),
+                   rebase=bool(info.get("rebase")))
 
     def _begin_attempt(self, step: int) -> str:
         """Synchronous (main-thread) attempt setup: clear stale artifacts of
@@ -210,39 +493,127 @@ class CheckpointManager:
         with open(os.path.join(d, "attempt.json")) as f:
             return json.load(f)["nonce"]
 
-    def _write(self, step: int, snap, nonce: str):
+    def _fire_fault(self, site: str, path: Optional[str]):
+        """Per-written-file fault hook: ``ckpt.write`` on full commits,
+        ``ckpt.delta`` on delta commits.  ``truncate`` tears the file
+        AFTER its md5 is recorded (restore's verify must catch it);
+        ``kill`` (ckpt.delta only) SIGKILLs this process mid-chain — the
+        chaos case where restore must land on the last durable prefix."""
+        if not _fi.ENABLED:
+            return
+        action = _fi.check(site)
+        if action is None:
+            return
+        if action == "truncate" and path is not None:
+            with open(path, "r+b") as fh:
+                fh.truncate(max(os.path.getsize(path) // 2, 1))
+        elif action == "kill" and site == "ckpt.delta":
+            os.kill(os.getpid(), signal.SIGKILL)
+        else:
+            # generic actions (error/transient/drop) raise like every
+            # other site — a consumed spec entry is never a silent no-op
+            _fi.raise_for(action, site)
+
+    def _write(self, step: int, snap, nonce: str, kind: str = "full"):
         proc, nprocs = self._proc()
         d = os.path.join(self.root, f"ckpt-{step}.tmp")
         final = os.path.join(self.root, f"ckpt-{step}")
+        delta = kind == "delta"
+        site = "ckpt.delta" if delta else "ckpt.write"
+        parent = None
+        if delta:
+            with self._chain_lock:
+                parent = self._committed
+            if parent is None:
+                raise DeltaChainError("delta commit with no committed "
+                                      "parent tip")
+            # fail fast, before any bytes land: a delta's sparse shard
+            # layout must match the parent's exactly (same tables, same
+            # shard count) — the merge-by-id replay has no way to know
+            # which rows of a DIFFERENT layout are live
+            pg = {n for n in parent["vars"] if _sparse_group(n)}
+            cg = {n for n in snap if _sparse_group(n)}
+            if pg != cg:
+                raise DeltaChainError(
+                    f"sparse layout changed vs parent commit "
+                    f"(parent-only: {sorted(pg - cg)[:4]}, "
+                    f"new: {sorted(cg - pg)[:4]}) — export a full rebase")
+        cb = self.chunk_bytes
+        bytes_written = 0
+        sparse_rows = 0
         manifest = {}
         for n, (shape, dtype, pieces) in snap.items():
             base = n.replace("/", "__")
+            grp = _sparse_group(n)
+            mode = "sparse" if grp else (
+                "chunks" if (delta and nprocs == 1) else "replace")
+            pent = parent["vars"].get(n) if parent is not None else None
+            diffable = (
+                mode == "chunks" and pent is not None
+                and pent.get("shape") == list(shape)
+                and pent.get("dtype") == dtype
+                and int(pent.get("chunk_bytes", 0) or 0) == cb
+                and len(pent.get("shards", [])) == len(pieces))
             shards = []
             for k, (idx, data) in enumerate(pieces):
+                arr = np.asarray(data)
+                if diffable:
+                    pe = pent["shards"][k]
+                    if pe.get("index") == idx and \
+                            pe.get("chunks") is not None:
+                        raw = arr.tobytes()
+                        cur = _chunk_hashes(raw, cb)
+                        old = pe["chunks"]
+                        if len(cur) == len(old):
+                            changed = [i for i, (a, b)
+                                       in enumerate(zip(old, cur))
+                                       if a != b]
+                            entry = {"index": idx,
+                                     "shard_shape": list(arr.shape),
+                                     "chunks": cur}
+                            if not changed:
+                                entry["patch"] = None
+                                shards.append(entry)
+                                continue
+                            fn = f"{base}.p{proc}s{k}.patch"
+                            path = os.path.join(d, fn)
+                            with open(path, "wb") as fh:
+                                for ci in changed:
+                                    fh.write(raw[ci * cb:(ci + 1) * cb])
+                            entry["patch"] = {
+                                "file": fn, "md5": _file_md5(path),
+                                "changed": changed}
+                            self._fire_fault(site, path)
+                            _fsync_file(path)
+                            bytes_written += os.path.getsize(path)
+                            shards.append(entry)
+                            continue
+                # full piece write (full commits; undiffable pieces of a
+                # delta — new var, changed shape/layout — become a fresh
+                # in-chain base for this var)
                 fn = f"{base}.p{proc}s{k}.npy"
                 path = os.path.join(d, fn)
-                np.save(path, data)
-                shards.append({"file": fn, "md5": _file_md5(path),
-                               "index": idx,
-                               "shard_shape": list(data.shape)})
-                if _fi.ENABLED:
-                    action = _fi.check("ckpt.write")
-                    if action == "truncate":
-                        # torn-write simulation: the manifest md5 above
-                        # was computed from the full file, so restore's
-                        # verify pass must detect this shard as corrupt
-                        with open(path, "r+b") as fh:
-                            fh.truncate(
-                                max(os.path.getsize(path) // 2, 1))
-                    elif action is not None:
-                        # generic actions (error/transient/drop) raise
-                        # like every other site — a consumed spec entry
-                        # must never be a silent no-op
-                        _fi.raise_for(action, "ckpt.write")
+                np.save(path, arr)
+                entry = {"file": fn, "md5": _file_md5(path),
+                         "index": idx, "shard_shape": list(arr.shape)}
+                if mode != "sparse" and nprocs == 1:
+                    # chunk table for the NEXT delta's diff (single-proc
+                    # only: that is the only place deltas are legal)
+                    entry["chunks"] = _chunk_hashes(arr.tobytes(), cb)
+                self._fire_fault(site, path)
+                _fsync_file(path)
+                bytes_written += os.path.getsize(path)
+                if mode == "sparse" and n.endswith("/ids"):
+                    sparse_rows += int(arr.size)
+                shards.append(entry)
             manifest[n] = {"shape": list(shape), "dtype": dtype,
-                           "shards": shards}
-        with open(os.path.join(d, f"shards-{proc}.json"), "w") as f:
+                           "shards": shards, "mode": mode}
+            if mode != "sparse" and nprocs == 1:
+                manifest[n]["chunk_bytes"] = cb
+        mpath = os.path.join(d, f"shards-{proc}.json")
+        with open(mpath, "w") as f:
             json.dump({"nonce": nonce, "vars": manifest}, f)
+        _fsync_file(mpath)
         # Cross-process coordination in THIS thread uses nonce-matched FILE
         # waits, not device collectives: enqueueing sync_global_devices from
         # the async writer would interleave with the training thread's
@@ -263,6 +634,8 @@ class CheckpointManager:
                 return True
             self._wait_for(_all_manifests_fresh,
                            f"ckpt-{step} shard manifests")
+        meta = None
+        rebase = False
         if proc == 0:
             merged = {}
             for p in range(nprocs):
@@ -270,27 +643,56 @@ class CheckpointManager:
                     part = json.load(f)["vars"]
                 for n, info in part.items():
                     if n not in merged:
-                        merged[n] = {"shape": info["shape"],
-                                     "dtype": info["dtype"], "shards": []}
+                        merged[n] = {k: v for k, v in info.items()
+                                     if k != "shards"}
+                        merged[n]["shards"] = []
                     merged[n]["shards"].extend(info["shards"])
             meta = {"step": step, "timestamp": time.time(),
-                    "format": "sharded-v1", "nonce": nonce, "vars": merged}
+                    "format": "sharded-v1", "nonce": nonce, "vars": merged,
+                    "kind": kind}
+            if nprocs == 1:
+                prev = parent
+                if not delta:
+                    with self._chain_lock:
+                        prev = self._committed
+                rebase = (not delta and prev is not None
+                          and int(prev.get("chain_len", 0)) > 0)
+                if delta:
+                    meta["parent"] = parent["content_hash"]
+                    meta["chain_len"] = int(parent.get("chain_len", 0)) + 1
+                    meta["base_bytes"] = int(parent.get("base_bytes", 0))
+                    meta["chain_bytes"] = \
+                        int(parent.get("chain_bytes", 0)) + bytes_written
+                else:
+                    meta["parent"] = None
+                    meta["chain_len"] = 0
+                    meta["base_bytes"] = bytes_written
+                    meta["chain_bytes"] = 0
+                meta["delta_bytes"] = bytes_written
+                meta["content_hash"] = _meta_content_hash(meta)
             # meta written last = commit point (service.go checkpoint
             # protocol: the etcd record there, a JSON file here)
-            with open(os.path.join(d, "meta.json"), "w") as f:
+            meta_path = os.path.join(d, "meta.json")
+            with open(meta_path, "w") as f:
                 json.dump(meta, f)
+            _fsync_file(meta_path)
+            _fsync_dir(d)
             if os.path.exists(final):
                 # re-save of the same step (emergency over periodic):
                 # never a window with NO copy on disk — shelve the old
                 # one aside (".tmp" suffix keeps it out of all_steps),
                 # land the new, then drop the shelf
-                prev = final + ".prev.tmp"
-                shutil.rmtree(prev, ignore_errors=True)
-                os.rename(final, prev)
+                prev_dir = final + ".prev.tmp"
+                shutil.rmtree(prev_dir, ignore_errors=True)
+                os.rename(final, prev_dir)
                 os.rename(d, final)
-                shutil.rmtree(prev, ignore_errors=True)
+                shutil.rmtree(prev_dir, ignore_errors=True)
             else:
                 os.rename(d, final)
+            _fsync_dir(self.root)
+            if nprocs == 1:
+                with self._chain_lock:
+                    self._committed = meta
             self._gc()
         elif nprocs > 1:
             # non-zero processes return once THIS attempt's commit
@@ -302,6 +704,12 @@ class CheckpointManager:
                 except (OSError, json.JSONDecodeError):
                     return False
             self._wait_for(_committed, f"ckpt-{step} commit")
+        return {"step": step, "kind": kind, "bytes": bytes_written,
+                "rows": sparse_rows, "rebase": rebase,
+                "chain_len": 0 if meta is None
+                else int(meta.get("chain_len", 0)),
+                "content_hash": None if meta is None
+                else meta.get("content_hash")}
 
     def _wait_for(self, cond, what, timeout_s: Optional[float] = None,
                   poll_s: float = 0.05):
@@ -314,23 +722,74 @@ class CheckpointManager:
             time.sleep(poll_s)
 
     def wait(self):
-        """Join a pending async write; re-raise its failure (if any) on
-        this thread, so 'saved' is never silently a lie."""
-        if self._thread is not None and self._thread.is_alive():
-            self._thread.join()
-        err, self._write_failure = self._write_failure, None
-        if err is not None:
-            raise err
+        """Hard durability barrier: block until every queued/in-flight
+        async write has committed (fsync'd, meta landed); re-raise a
+        write failure (if any) on this thread, so 'saved' is never
+        silently a lie."""
+        with self._wcv:
+            while (self._wq or self._winflight is not None) \
+                    and self._write_failure is None:
+                self._wcv.wait()
+            self._raise_write_failure_locked()
+
+    # -- retention ---------------------------------------------------------
+    def _read_meta(self, d) -> Optional[dict]:
+        try:
+            with open(os.path.join(d, "meta.json")) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError, ValueError):
+            return None
+
+    def _commit_index(self) -> Dict[str, Tuple[int, str, dict]]:
+        """content_hash -> (step, dir, meta) over every durable commit
+        dir (committed + orphaned shelves) — the parent-resolution map
+        for chain replay and chain-aware GC."""
+        idx: Dict[str, Tuple[int, str, dict]] = {}
+        for s in self.all_steps():
+            for d in self._candidate_dirs(s):
+                meta = self._read_meta(d)
+                if meta is None:
+                    continue
+                h = meta.get("content_hash")
+                if h and h not in idx:
+                    idx[h] = (s, d, meta)
+        return idx
 
     def _gc(self):
         steps = sorted(self.all_steps())
-        for s in steps[:-self.max_to_keep]:
-            # a step's data may live in the committed dir and/or an
-            # orphaned re-commit shelf — retention retires both
-            shutil.rmtree(os.path.join(self.root, f"ckpt-{s}"),
-                          ignore_errors=True)
-            shutil.rmtree(os.path.join(self.root, f"ckpt-{s}.prev.tmp"),
-                          ignore_errors=True)
+        if len(steps) > self.max_to_keep:
+            keep = set(steps[-self.max_to_keep:])
+            # chain-aware retention: a kept delta tip still NEEDS its
+            # ancestors — walk each kept commit's parent chain and pin
+            # every base/delta it replays through
+            idx = self._commit_index()
+            metas = {}
+            for s in steps:
+                for d in self._candidate_dirs(s):
+                    m = self._read_meta(d)
+                    if m is not None:
+                        metas.setdefault(s, m)
+            for s in sorted(keep):
+                m = metas.get(s)
+                hops = 0
+                while (m is not None and m.get("kind") == "delta"
+                        and m.get("parent") and hops < 10000):
+                    got = idx.get(m["parent"])
+                    if got is None:
+                        break
+                    ps, _pd, m = got
+                    keep.add(ps)
+                    hops += 1
+            for s in steps:
+                if s in keep:
+                    continue
+                # a step's data may live in the committed dir and/or an
+                # orphaned re-commit shelf — retention retires both
+                shutil.rmtree(os.path.join(self.root, f"ckpt-{s}"),
+                              ignore_errors=True)
+                shutil.rmtree(
+                    os.path.join(self.root, f"ckpt-{s}.prev.tmp"),
+                    ignore_errors=True)
         # orphaned re-commit shelves (crash between the shelve renames)
         # for steps whose committed dir exists again are just leaks
         for d in os.listdir(self.root):
@@ -366,7 +825,11 @@ class CheckpointManager:
                 scope: Optional[Scope] = None, verify: bool = True) -> int:
         """Load newest (or given) checkpoint into scope; returns its step.
         Corrupt checkpoints (md5 mismatch) are skipped, falling back to the
-        previous one — the pserver recover-on-restart behavior.
+        previous one — the pserver recover-on-restart behavior.  A delta
+        tip resolves and replays its WHOLE parent chain (base→deltas,
+        sparse rows merged by id, dense chunks patched in place); any
+        broken or corrupt link fails the whole tip, falling back to the
+        last durable commit — never a torn mix.
 
         Vars whose destination in ``scope`` is already a sharded jax Array
         of the checkpointed shape are restored shard-by-shard onto the
@@ -376,27 +839,37 @@ class CheckpointManager:
         scope = global_scope() if scope is None else scope
         candidates = ([step] if step is not None
                       else list(reversed(self.all_steps())))
+        index = None
         for s, d in ((s, d) for s in candidates
                      for d in self._candidate_dirs(s)):
             try:
                 with open(os.path.join(d, "meta.json")) as f:
                     meta = json.load(f)
-                if verify:
-                    for n, info in meta["vars"].items():
-                        for sh in info["shards"]:
-                            path = os.path.join(d, sh["file"])
-                            if _file_md5(path) != sh["md5"]:
-                                raise IOError(f"md5 mismatch for {n}")
-                loaded = {n: self._load_var(d, n, info, scope)
-                          for n, info in meta["vars"].items()}
+                if meta.get("kind", "full") == "delta":
+                    if index is None:
+                        index = self._commit_index()
+                    chain = self._resolve_chain(meta, d, index)
+                    if verify:
+                        for cd, cm in chain:
+                            self._verify_commit(cd, cm)
+                    replayed = self._replay_chain(chain, verify)
+                    loaded = {n: self._place(scope, n, arr)
+                              for n, arr in replayed.items()}
+                else:
+                    if verify:
+                        self._verify_commit(d, meta)
+                    loaded = {n: self._load_var(d, n, info, scope)
+                              for n, info in meta["vars"].items()}
                 for n, arr in loaded.items():
                     scope.set(n, arr)
+                self._adopt_tip(meta)
                 return s
             except Exception as e:  # noqa: BLE001 — any corruption mode
-                # (truncated shard, md5 mismatch, garbled meta) must fall
-                # back to the previous checkpoint, never fail the restore
-                # — the pserver recover-on-restart behavior.  Loudly: the
-                # skipped step is a durability incident worth alerting on.
+                # (truncated shard, md5 mismatch, garbled meta, broken
+                # delta chain) must fall back to the previous checkpoint,
+                # never fail the restore — the pserver recover-on-restart
+                # behavior.  Loudly: the skipped step is a durability
+                # incident worth alerting on.
                 from ..observability import emit_event, inc_counter
                 logger.warning(
                     "checkpoint ckpt-%s is corrupt/unreadable (%s: %s); "
@@ -407,6 +880,177 @@ class CheckpointManager:
                            error=f"{type(e).__name__}: {e}")
                 continue
         raise FileNotFoundError(f"no valid checkpoint under {self.root}")
+
+    def _verify_commit(self, d, meta):
+        """Integrity pass over one commit dir: every referenced file's
+        md5, plus the recorded content hash (delta-era commits only)."""
+        if meta.get("content_hash") and \
+                _meta_content_hash(meta) != meta["content_hash"]:
+            raise IOError(f"content-hash mismatch for {d}")
+        for n, info in meta["vars"].items():
+            for sh in info["shards"]:
+                if sh.get("file"):
+                    path = os.path.join(d, sh["file"])
+                    if _file_md5(path) != sh["md5"]:
+                        raise IOError(f"md5 mismatch for {n}")
+                patch = sh.get("patch")
+                if patch:
+                    path = os.path.join(d, patch["file"])
+                    if _file_md5(path) != patch["md5"]:
+                        raise IOError(f"patch md5 mismatch for {n}")
+
+    def _resolve_chain(self, tip_meta, tip_dir, index):
+        """[(dir, meta)] base→tip; raises when any parent link is
+        missing (GC'd, corrupt meta, dangling hash) — the whole tip is
+        then invalid and restore falls back."""
+        chain = [(tip_dir, tip_meta)]
+        m = tip_meta
+        while m.get("kind", "full") == "delta":
+            p = m.get("parent")
+            if not p or p not in index:
+                raise IOError(
+                    f"delta chain broken at ckpt-{m.get('step')}: parent "
+                    f"{str(p)[:12]}... not found")
+            _s, d, m = index[p]
+            chain.append((d, m))
+            if len(chain) > 10000:
+                raise IOError("delta chain too long (cycle?)")
+        chain.reverse()
+        return chain
+
+    def _assemble(self, d, info) -> np.ndarray:
+        """One commit's full host copy of a var (pieces re-placed by
+        their index windows)."""
+        shape = tuple(info["shape"])
+        dtype = np.dtype(info["dtype"])
+        full = np.empty(shape, dtype)
+        for sh in info["shards"]:
+            idx = tuple(slice(a, b) for a, b in sh["index"])
+            full[idx] = _as_dtype(np.load(os.path.join(d, sh["file"])),
+                                  dtype)
+        return full
+
+    @staticmethod
+    def _merge_sparse_group(gp, basemap, deltamap, members):
+        """Merge one sparse shard group: sorted-union ids, delta rows
+        overriding the base's — exactly what re-pushing those rows would
+        have produced, so replay is bit-identical to a full export."""
+        ids_key = gp + "/ids"
+        bids = np.asarray(basemap[ids_key], np.int64)
+        dids = np.asarray(deltamap[ids_key], np.int64)
+        uids = np.union1d(bids, dids)
+        out = {ids_key: uids}
+        for m in members:
+            if m == ids_key:
+                continue
+            b, dl = basemap[m], deltamap[m]
+            ref = b if (b.size or not dl.size) else dl
+            res = np.empty((len(uids),) + ref.shape[1:], ref.dtype)
+            if len(bids):
+                res[np.searchsorted(uids, bids)] = b
+            if len(dids):
+                res[np.searchsorted(uids, dids)] = dl
+            out[m] = res
+        return out
+
+    def _replay_chunked(self, chain, name, tip_info, verify):
+        """Reconstruct a chunk-diffed var: walk back per piece to its
+        newest full file, then patch changed chunks forward; the final
+        bytes must hash to the tip's recorded chunk table."""
+        shape = tuple(tip_info["shape"])
+        dtype = np.dtype(tip_info["dtype"])
+        full = np.empty(shape, dtype)
+        for pi, tent in enumerate(tip_info["shards"]):
+            base_ci = None
+            for ci in range(len(chain) - 1, -1, -1):
+                vi = chain[ci][1]["vars"].get(name)
+                if vi is None or pi >= len(vi["shards"]):
+                    break
+                if vi["shards"][pi].get("file"):
+                    base_ci = ci
+                    break
+            if base_ci is None:
+                raise IOError(
+                    f"chunk chain for {name!r} piece {pi} has no base")
+            e0 = chain[base_ci][1]["vars"][name]["shards"][pi]
+            arr0 = _as_dtype(
+                np.load(os.path.join(chain[base_ci][0], e0["file"])),
+                dtype)
+            raw = bytearray(arr0.tobytes())
+            for ci in range(base_ci + 1, len(chain)):
+                vi = chain[ci][1]["vars"][name]
+                e = vi["shards"][pi]
+                patch = e.get("patch")
+                if not patch:
+                    continue
+                cbi = int(vi.get("chunk_bytes", DEFAULT_CHUNK_BYTES))
+                with open(os.path.join(chain[ci][0], patch["file"]),
+                          "rb") as f:
+                    data = f.read()
+                off = 0
+                for cidx in patch["changed"]:
+                    lo = cidx * cbi
+                    hi = min(lo + cbi, len(raw))
+                    raw[lo:hi] = data[off:off + (hi - lo)]
+                    off += hi - lo
+            if verify and tent.get("chunks") is not None:
+                cbt = int(tip_info.get("chunk_bytes",
+                                       DEFAULT_CHUNK_BYTES))
+                if _chunk_hashes(bytes(raw), cbt) != tent["chunks"]:
+                    raise IOError(
+                        f"replayed chunks for {name!r} piece {pi} do not "
+                        f"match the tip's chunk table")
+            piece = np.frombuffer(bytes(raw), dtype=dtype).reshape(
+                tent["shard_shape"])
+            idx = tuple(slice(a, b) for a, b in tent["index"])
+            full[idx] = piece
+        return full
+
+    def _replay_chain(self, chain, verify) -> Dict[str, np.ndarray]:
+        """Materialize the tip state: base→deltas, per the tip manifest's
+        var modes.  The tip's var set is authoritative."""
+        tip_vars = chain[-1][1]["vars"]
+        groups: Dict[str, List[str]] = {}
+        for n, info in tip_vars.items():
+            grp = _sparse_group(n)
+            if info.get("mode") == "sparse" and grp:
+                groups.setdefault(grp[0], []).append(n)
+        out: Dict[str, np.ndarray] = {}
+        done = set()
+        for gp, members in groups.items():
+            merged = None
+            for d, meta in chain:
+                cur = {}
+                for m in members:
+                    mi = meta["vars"].get(m)
+                    if mi is None:
+                        cur = None
+                        break
+                    cur[m] = self._assemble(d, mi)
+                if cur is None:
+                    continue   # group introduced later in the chain
+                merged = cur if merged is None else \
+                    self._merge_sparse_group(gp, merged, cur, members)
+            if merged is not None:
+                out.update(merged)
+            done.update(members)
+        for n, info in tip_vars.items():
+            if n in done:
+                continue
+            if info.get("mode") == "chunks":
+                out[n] = self._replay_chunked(chain, n, info, verify)
+            else:
+                out[n] = self._assemble(chain[-1][0], info)
+        return out
+
+    def _place(self, scope, name, full: np.ndarray):
+        import jax
+        import jax.numpy as jnp
+        dest = scope.get(name) if scope.has(name) else None
+        if isinstance(dest, jax.Array) and not isinstance(
+                dest, np.ndarray) and dest.shape == full.shape:
+            return jax.device_put(full, dest.sharding)
+        return jnp.asarray(full)
 
     def _candidate_dirs(self, step: int):
         """EXISTING directories that may hold step's data, preferred

@@ -285,6 +285,7 @@ def summarize_logs(paths) -> dict:
     servings: List[dict] = []
     tunings: List[dict] = []
     pservers: List[dict] = []
+    ckpts: List[dict] = []
     spans = 0
     last_snapshot: Optional[dict] = None
     snapshots = 0
@@ -310,6 +311,8 @@ def summarize_logs(paths) -> dict:
             tunings.append(ev)
         elif kind == "pserver":
             pservers.append(ev)
+        elif kind == "ckpt":
+            ckpts.append(ev)
         elif kind == "span":
             spans += 1
 
@@ -501,6 +504,25 @@ def summarize_logs(paths) -> dict:
                 float(e.get("wire_bytes_out", 0))
                 for e in shut) / 2 ** 20, 3),
         }
+    if ckpts:
+        commits = [e for e in ckpts if e.get("event") == "commit"]
+        fulls = [e for e in commits if e.get("commit_kind") == "full"]
+        deltas = [e for e in commits if e.get("commit_kind") == "delta"]
+        cms = sorted(float(e["ms"]) for e in commits
+                     if e.get("ms") is not None)
+        summary["checkpoint"] = {
+            "events": len(ckpts), "commits": len(commits),
+            "full": len(fulls), "delta": len(deltas),
+            "rebases": sum(1 for e in commits if e.get("rebase")),
+            "delta_mb": round(sum(float(e.get("bytes", 0))
+                                  for e in deltas) / 2 ** 20, 3),
+            "delta_rows": sum(int(e.get("rows", 0)) for e in deltas),
+            "full_mb": round(sum(float(e.get("bytes", 0))
+                                 for e in fulls) / 2 ** 20, 3),
+            "commit_ms_p50": round(cms[len(cms) // 2], 3) if cms else None,
+            "max_chain_len": max((int(e.get("chain_len", 0))
+                                  for e in commits), default=0),
+        }
     return summary
 
 
@@ -607,6 +629,15 @@ def render_summary(summary: dict) -> str:
             lines.append(
                 f"  restore: shard {r['shard']} from {r['source']} "
                 f"(pushes_applied={r['pushes_applied']})")
+    ck = summary.get("checkpoint")
+    if ck:
+        lines.append(
+            f"checkpoint: {ck['commits']} commit(s): {ck['full']} full "
+            f"({ck['full_mb']} MB) + {ck['delta']} delta "
+            f"({ck['delta_mb']} MB, {ck['delta_rows']} sparse row(s)), "
+            f"{ck['rebases']} rebase(s), max chain {ck['max_chain_len']}"
+            + (f", commit p50 {ck['commit_ms_p50']} ms"
+               if ck.get("commit_ms_p50") is not None else ""))
     return "\n".join(lines)
 
 
@@ -628,16 +659,28 @@ def prom_name(name: str) -> str:
 
 def metric_name_from_prom(prom: str) -> str:
     """Inverse of :func:`prom_name` (accepts the ``_total`` counter
-    suffix the exposition appends)."""
+    suffix the exposition appends).
+
+    A registered metric may itself end in ``_total`` (e.g.
+    ``checkpoint/rebase_total``), so the suffix is only treated as the
+    exposition's counter decoration when the full body is NOT already a
+    frozen METRIC_NAMES entry.
+    """
     if not prom.startswith(_PROM_PREFIX):
         raise ValueError(f"not a paddle_tpu prometheus name: {prom!r}")
     body = prom[len(_PROM_PREFIX):]
+
+    def _split(b: str) -> str:
+        sub, sep, rest = b.partition("_")
+        if not sep:
+            raise ValueError(f"unsplittable prometheus name: {prom!r}")
+        return f"{sub}/{rest}"
+
     if body.endswith("_total"):
-        body = body[:-len("_total")]
-    sub, sep, rest = body.partition("_")
-    if not sep:
-        raise ValueError(f"unsplittable prometheus name: {prom!r}")
-    return f"{sub}/{rest}"
+        registered = {n for n, _k, _h in _metrics.METRIC_NAMES}
+        if _split(body) not in registered:
+            body = body[:-len("_total")]
+    return _split(body)
 
 
 def _prom_escape(v: str) -> str:
@@ -686,10 +729,12 @@ def to_prometheus(snapshot: Optional[dict] = None) -> str:
         if kind == "counter":
             # HELP/TYPE on the _total name: in the classic text format
             # only histograms/summaries get suffix grace, so metadata on
-            # the bare base would orphan the sample's family
-            lines.append(f"# HELP {base}_total {_prom_escape(help_)}")
-            lines.append(f"# TYPE {base}_total counter")
-            lines.append(f"{base}_total {_prom_num(snap['value'])}")
+            # the bare base would orphan the sample's family.  Don't
+            # double the suffix when the metric name already carries it.
+            ctr = base if base.endswith("_total") else base + "_total"
+            lines.append(f"# HELP {ctr} {_prom_escape(help_)}")
+            lines.append(f"# TYPE {ctr} counter")
+            lines.append(f"{ctr} {_prom_num(snap['value'])}")
         elif kind == "gauge":
             if not snap["values"]:
                 continue

@@ -282,6 +282,19 @@ METRIC_NAMES = (
      "chain-backup pushes applied on behalf of a predecessor shard"),
     ("pserver/checkpoints", "counter",
      "durable pserver shard checkpoints committed (SIGTERM or op)"),
+    # incremental checkpointing (distributed.checkpoint delta chains)
+    ("checkpoint/delta_bytes", "counter",
+     "bytes written by delta commits (sparse dirty rows + dense chunk "
+     "patches) — the wire/disk cost full rebases amortize away"),
+    ("checkpoint/delta_rows", "counter",
+     "sparse rows serialized into delta commits (dirty rows only)"),
+    ("checkpoint/rebase_total", "counter",
+     "full commits that terminated a live delta chain (policy rebase "
+     "or forced fallback after a chain error)"),
+    ("checkpoint/commit_ms", "histogram",
+     "writer wall time of one durable commit, serialize to fsync'd "
+     "meta (full and delta alike; the trainer only pays this when a "
+     "hard barrier drains the queue)"),
 )
 
 _MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
@@ -312,6 +325,7 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
     "sparse/push_flush_ms": _MS_BUCKETS,
     "pserver/frame_ms": _MS_BUCKETS,
     "pserver/replication_lag_ms": _MS_BUCKETS,
+    "checkpoint/commit_ms": _MS_BUCKETS,
 }
 _DEFAULT_BUCKETS = _MS_BUCKETS
 

@@ -146,6 +146,10 @@ class PServer:
                         "push_rows": 0, "wire_bytes_in": 0,
                         "wire_bytes_out": 0, "backup_pushes": 0}
         self._backup_sock = None
+        # lazy shard-local CheckpointManager (delta-chain manifest form);
+        # stays None until the first checkpoint()/recovery so dir-less
+        # servers never touch the checkpoint machinery
+        self._ckpt_manager = None
         self._listen: Optional[socket.socket] = None
         self._sel: Optional[selectors.DefaultSelector] = None
         self._stop = False
@@ -610,28 +614,91 @@ class PServer:
             return None
         return os.path.join(self.dir, f"shard{self.shard}")
 
-    def checkpoint(self) -> Optional[str]:
-        """Durable shard checkpoint: per-table npz dirs + the dedup/
-        counter state, committed tmp+rename so a SIGKILL mid-write
-        leaves the previous commit intact."""
+    # shard-local delta-chain policy (the Checkpointer's defaults): a
+    # restore replays at most _DELTA_MAX_CHAIN links, and cumulative
+    # delta bytes past half the base force a rebase
+    _DELTA_MAX_CHAIN = 8
+    _DELTA_REBASE_FRACTION = 0.5
+
+    def _manager(self):
         root = self._ckpt_dir()
         if root is None:
             return None
-        os.makedirs(root, exist_ok=True)
-        for name, t in self._tables.items():
-            t.save(os.path.join(root, f"table_{name}"))
+        if self._ckpt_manager is None:
+            os.makedirs(root, exist_ok=True)
+            from ..distributed.checkpoint import CheckpointManager
+            self._ckpt_manager = CheckpointManager(
+                root, max_to_keep=3, async_save=False,
+                process_index=0, process_count=1)
+        return self._ckpt_manager
+
+    def _ckpt_snapshot(self, kind: str):
+        """One commit's scope + dirty-set tokens: every table's rows
+        (full or dirty-only) plus the dedup/counter state as a synthetic
+        var, so counters and rows commit ATOMICALLY."""
+        from ..core.scope import Scope
+        scope = Scope()
+        tokens: Dict[str, int] = {}
+        for name, t in sorted(self._tables.items()):
+            tok, sv = (t.export_delta() if kind == "delta"
+                       else t.export_full())
+            tokens[name] = tok
+            for k, v in sv.items():
+                scope.set(k, v)
         meta = {"shard": self.shard, "n_shards": self.n_shards,
                 "tables": sorted(self._tables),
                 "specs": self._specs,
                 "applied_seq": self._applied_seq,
                 "pushes_applied": self.pushes_applied}
-        tmp = os.path.join(root, "state.json.tmp")
-        with open(tmp, "w") as fh:
-            json.dump(meta, fh, sort_keys=True, indent=1)
-        os.replace(tmp, os.path.join(root, "state.json"))
+        scope.set("__pserver__/state", np.frombuffer(
+            json.dumps(meta, sort_keys=True).encode("utf-8"),
+            dtype=np.uint8).copy())
+        return scope, tokens
+
+    def checkpoint(self) -> Optional[str]:
+        """Durable shard checkpoint on the delta-chain manifest
+        (``distributed/checkpoint.py``): a full base when no chain is
+        live (or the rebase thresholds trip), a dirty-rows-only delta
+        otherwise — the SIGTERM grace window costs what the shard
+        CHANGED, not what it holds.  Commits are blocking (this is the
+        shard's durability barrier); dirty sets clear only on the
+        durable ack and re-dirty on failure."""
+        cm = self._manager()
+        if cm is None:
+            return None
+        from ..distributed.checkpoint import DeltaChainError
+        st = cm.chain_stats()
+        kind = "delta" if (
+            st["alive"] and st["len"] < self._DELTA_MAX_CHAIN
+            and (st["base_bytes"] <= 0
+                 or st["bytes"] < self._DELTA_REBASE_FRACTION
+                 * st["base_bytes"])) else "full"
+        step = (cm.latest_step() or 0) + 1
+        scope, tokens = self._ckpt_snapshot(kind)
+
+        def _ack(tk, commit):
+            for name, tok in tk.items():
+                t = self._tables.get(name)
+                if t is not None:
+                    (t.commit_delta if commit else t.retract_delta)(tok)
+
+        try:
+            cm.save(step, scope, blocking=True, kind=kind,
+                    on_commit=lambda info, tk=tokens: _ack(tk, True),
+                    on_fail=lambda exc, tk=tokens: _ack(tk, False))
+        except DeltaChainError:
+            # chain invalidated under us (e.g. a table created since the
+            # parent commit changed the sparse layout): rebase full
+            _ack(tokens, False)
+            kind = "full"
+            scope, tokens = self._ckpt_snapshot(kind)
+            cm.save(step, scope, blocking=True, kind=kind,
+                    on_commit=lambda info, tk=tokens: _ack(tk, True),
+                    on_fail=lambda exc, tk=tokens: _ack(tk, False))
+        root = self._ckpt_dir()
         inc_counter("pserver/checkpoints")
         emit_event("pserver", event="checkpoint", shard=self.shard,
-                   dir=root, **self._totals)
+                   dir=root, commit_kind=kind, **self._totals)
         return root
 
     def _recover(self):
@@ -691,8 +758,48 @@ class PServer:
 
     def _recover_from_checkpoint(self) -> bool:
         root = self._ckpt_dir()
-        if root is None or not os.path.exists(
-                os.path.join(root, "state.json")):
+        if root is None or not os.path.isdir(root):
+            return False
+        cm = self._manager()
+        if cm.all_steps():
+            from ..core.scope import Scope
+            scope = Scope()
+            try:
+                # replays the delta chain base->tip; a torn tip (kill
+                # mid-chain) falls back inside restore() to the last
+                # durable prefix
+                cm.restore(scope=scope)
+            except FileNotFoundError:
+                return self._recover_legacy(root)
+            if not scope.has("__pserver__/state"):
+                return self._recover_legacy(root)
+            meta = json.loads(bytes(np.asarray(
+                scope.get("__pserver__/state"),
+                dtype=np.uint8)).decode("utf-8"))
+            state = {k: np.asarray(scope.get(k)) for k in scope.keys()
+                     if k.startswith(_STATE_PREFIX)}
+            for name in meta.get("tables", []):
+                spec = dict(meta["specs"][name])
+                spec["init"] = list(spec["init"])
+                t = _table_from_spec(spec)
+                t.restore_state_vars(state)
+                self._tables[name] = t
+                self._specs[name] = spec
+            self._applied_seq = {k: int(v) for k, v in
+                                 meta.get("applied_seq", {}).items()}
+            self.pushes_applied = int(meta.get("pushes_applied", 0))
+            emit_event("pserver", event="restore", shard=self.shard,
+                       source="checkpoint", tables=sorted(self._tables),
+                       pushes_applied=self.pushes_applied)
+            return True
+        return self._recover_legacy(root)
+
+    def _recover_legacy(self, root: str) -> bool:
+        """Pre-delta checkpoint layout (per-table npz dirs +
+        ``state.json``): read-only fallback so shards upgraded in place
+        restore their last old-format commit; the next checkpoint()
+        rewrites in manifest form."""
+        if not os.path.exists(os.path.join(root, "state.json")):
             return False
         with open(os.path.join(root, "state.json")) as fh:
             meta = json.load(fh)
@@ -705,7 +812,7 @@ class PServer:
                              meta.get("applied_seq", {}).items()}
         self.pushes_applied = int(meta.get("pushes_applied", 0))
         emit_event("pserver", event="restore", shard=self.shard,
-                   source="checkpoint", tables=sorted(self._tables),
+                   source="checkpoint-legacy", tables=sorted(self._tables),
                    pushes_applied=self.pushes_applied)
         return True
 

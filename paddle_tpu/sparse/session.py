@@ -803,6 +803,58 @@ class SparseSession:
             out.update(t.export_state_vars())
         return out
 
+    # -- incremental checkpoint (delta-source surface) ----------------------
+    # The Checkpointer's delta source duck-type: export_delta/export_full
+    # return (tokens, state); commit_delta acks after the durable write,
+    # retract_delta re-dirties on writer failure.  Same flush-first
+    # barrier as export_state_vars: every acked async push is in the
+    # snapshot before the dirty set is cleared.
+    @property
+    def supports_delta(self) -> bool:
+        return all(hasattr(t, "export_delta")
+                   for t in self.tables.values())
+
+    @property
+    def dirty_rows(self) -> int:
+        """Rows the next delta commit would export across all tables."""
+        return sum(t.dirty_rows for t in self.tables.values())
+
+    def export_delta(self):
+        """Dirty rows of every bound table as ``(tokens, state)`` —
+        ``tokens`` maps table name -> pending-set token."""
+        self.flush()
+        tokens: Dict[str, int] = {}
+        out: Dict[str, np.ndarray] = {}
+        for name, t in self.tables.items():
+            tok, st = t.export_delta()
+            tokens[name] = tok
+            out.update(st)
+        return tokens, out
+
+    def export_full(self):
+        """Full table state under the same token protocol — the rebase
+        form (dirty set snapshotted atomically with the export)."""
+        self.flush()
+        tokens: Dict[str, int] = {}
+        out: Dict[str, np.ndarray] = {}
+        for name, t in self.tables.items():
+            tok, st = t.export_full()
+            tokens[name] = tok
+            out.update(st)
+        return tokens, out
+
+    def commit_delta(self, tokens: Dict[str, int]):
+        for name, tok in (tokens or {}).items():
+            t = self.tables.get(name)
+            if t is not None:
+                t.commit_delta(tok)
+
+    def retract_delta(self, tokens: Dict[str, int]):
+        for name, tok in (tokens or {}).items():
+            t = self.tables.get(name)
+            if t is not None:
+                t.retract_delta(tok)
+
     def restore_from_scope(self, scope) -> bool:
         """Pop ``__sparse__/...`` vars a Checkpointer restore left in
         ``scope`` and load them into the bound tables.  Returns False

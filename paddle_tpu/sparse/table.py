@@ -171,6 +171,14 @@ class _MemoryShard:
         self.rows = np.empty((0, self.dim), self.dtype)
         self.slots: Dict[str, np.ndarray] = {
             s: np.empty((0, self.dim), self.dtype) for s in slot_names}
+        # incremental-checkpoint bookkeeping, aligned with the arena
+        # (positions are append-only and stable): pos_ids inverts the
+        # id map (arena position -> id) so a delta export costs
+        # O(dirty), and dirty marks positions touched since the last
+        # ACKED commit.  Always plain in-memory arrays — even for mmap
+        # arenas — because they are transient commit state.
+        self.pos_ids = np.empty(0, np.int64)
+        self.dirty = np.zeros(0, bool)
 
     # -- arena management ---------------------------------------------------
     def _alloc(self, shape) -> np.ndarray:
@@ -184,6 +192,12 @@ class _MemoryShard:
             new = self._alloc((cap, self.dim))
             new[:self.n] = arr[:self.n]
             self.slots[s] = new
+        new_ids = np.empty(cap, np.int64)
+        new_ids[:self.n] = self.pos_ids[:self.n]
+        self.pos_ids = new_ids
+        new_dirty = np.zeros(cap, bool)
+        new_dirty[:self.n] = self.dirty[:self.n]
+        self.dirty = new_dirty
         self._cap = cap
 
     def reserve(self, extra: int):
@@ -209,6 +223,10 @@ class _MemoryShard:
                 arr[sl] = slots[s]
             else:
                 arr[sl] = 0
+        self.pos_ids[sl] = ids
+        # lazily initialized rows are dirty: a full export includes
+        # them, so a delta chain must too for bit-identical replay
+        self.dirty[sl] = True
         if isinstance(self.index, dict):
             for j, i in enumerate(ids.tolist()):
                 self.index[int(i)] = self.n + j
@@ -220,6 +238,7 @@ class _MemoryShard:
 
     def clear(self):
         self.index.clear()
+        self.dirty[:] = False
         self.n = 0
 
 
@@ -360,6 +379,15 @@ class SparseTable:
         self.rows_initialized = 0
         self.init_seconds = 0.0
         self.last_init = None
+        # incremental-checkpoint pending sets: an export snapshot moves
+        # the dirty positions into _pending under an opaque token; a
+        # durable-commit ack drops them (commit_delta), a writer failure
+        # re-marks them dirty (retract_delta).  _ckpt_gen fences stale
+        # tokens across a restore (restore rebinds arena contents, so a
+        # pre-restore snapshot's positions no longer mean anything).
+        self._pending: Dict[int, Tuple[int, List[np.ndarray]]] = {}
+        self._next_token = 0
+        self._ckpt_gen = 0
 
     # -- init ---------------------------------------------------------------
     @staticmethod
@@ -591,6 +619,7 @@ class SparseTable:
             live = ids[live_sel]
             for k, sel, rows_idx in self._parts(live):
                 shard = self._shards[k]
+                shard.dirty[rows_idx] = True
                 g = grads[live_sel[sel]]
                 p = shard.rows[rows_idx]
                 # Mirrors the device optimizer-op lowerings
@@ -657,33 +686,126 @@ class SparseTable:
         shard so the export is byte-deterministic.  All arrays are fresh
         copies: the async checkpoint writer may still be serializing them
         while training mutates the arenas."""
+        with self._lock:
+            return self._export_state_vars_locked()
+
+    def _export_state_vars_locked(self) -> Dict[str, np.ndarray]:
+        prefix = f"{_STATE_PREFIX}/{self.name}"
+        out: Dict[str, np.ndarray] = {}
+        out[f"{prefix}/meta"] = np.frombuffer(
+            json.dumps(self._meta(), sort_keys=True).encode("utf-8"),
+            dtype=np.uint8).copy()
+        for k, shard in enumerate(self._shards):
+            if self.impl == "reference":
+                ids = np.array(sorted(shard.index), np.int64)
+                pos = np.fromiter((shard.index[int(i)] for i in ids),
+                                  np.int64, len(ids))
+            else:
+                ids, pos = shard.index.sorted_items()
+                # same aliasing guarantee as the reference branch:
+                # the exported array must never be a live view of
+                # the id map (a consumer mutating it would corrupt
+                # the index)
+                ids = ids.copy()
+            out[f"{prefix}/shard{k}/ids"] = ids
+            out[f"{prefix}/shard{k}/rows"] = \
+                shard.rows[pos].copy() if len(ids) else \
+                np.empty((0, self.dim), self.dtype)
+            for s in self.slot_names:
+                out[f"{prefix}/shard{k}/slot/{s}"] = \
+                    shard.slots[s][pos].copy() if len(ids) else \
+                    np.empty((0, self.dim), self.dtype)
+        return out
+
+    # -- incremental checkpoint (dirty-row deltas) --------------------------
+    @property
+    def dirty_rows(self) -> int:
+        """Rows touched (pushed or lazily initialized) since the last
+        ACKED commit snapshot — the size the next delta would export."""
+        with self._lock:
+            return sum(int(s.dirty[:s.n].sum()) for s in self._shards)
+
+    def _snapshot_dirty_locked(self) -> Tuple[int, List[np.ndarray]]:
+        """Move every currently-dirty position into a pending set keyed
+        by a fresh token.  Caller holds the lock.  The snapshot happens
+        BEFORE any serialization is handed to an async writer, so a row
+        pushed DURING serialization re-enters the dirty set (its
+        position is simply marked again) and is never silently clean."""
+        pend = []
+        for shard in self._shards:
+            pos = np.nonzero(shard.dirty[:shard.n])[0]
+            shard.dirty[pos] = False
+            pend.append(pos)
+        token = self._next_token
+        self._next_token += 1
+        self._pending[token] = (self._ckpt_gen, pend)
+        return token, pend
+
+    def export_delta(self) -> Tuple[int, Dict[str, np.ndarray]]:
+        """Serialize ONLY the rows touched since the last acked commit,
+        as ``(token, state)``: the same synthetic-scope-var keys as
+        :meth:`export_state_vars` (meta + per-shard sorted
+        ``ids``/``rows``/``slot/*``) but each shard carries just its
+        dirty rows.  The dirty positions move to a pending set under
+        ``token`` — call :meth:`commit_delta` after the durable ack, or
+        :meth:`retract_delta` on writer failure (which re-marks them
+        dirty so the rows ride the next commit).  All arrays are fresh
+        copies."""
         prefix = f"{_STATE_PREFIX}/{self.name}"
         out: Dict[str, np.ndarray] = {}
         with self._lock:
+            token, pend = self._snapshot_dirty_locked()
             out[f"{prefix}/meta"] = np.frombuffer(
                 json.dumps(self._meta(), sort_keys=True).encode("utf-8"),
                 dtype=np.uint8).copy()
             for k, shard in enumerate(self._shards):
-                if self.impl == "reference":
-                    ids = np.array(sorted(shard.index), np.int64)
-                    pos = np.fromiter((shard.index[int(i)] for i in ids),
-                                      np.int64, len(ids))
+                pos = pend[k]
+                if pos.size:
+                    ids = shard.pos_ids[pos]
+                    order = np.argsort(ids, kind="stable")
+                    ids, pos = ids[order], pos[order]
+                    out[f"{prefix}/shard{k}/ids"] = ids.copy()
+                    out[f"{prefix}/shard{k}/rows"] = shard.rows[pos].copy()
+                    for s in self.slot_names:
+                        out[f"{prefix}/shard{k}/slot/{s}"] = \
+                            shard.slots[s][pos].copy()
                 else:
-                    ids, pos = shard.index.sorted_items()
-                    # same aliasing guarantee as the reference branch:
-                    # the exported array must never be a live view of
-                    # the id map (a consumer mutating it would corrupt
-                    # the index)
-                    ids = ids.copy()
-                out[f"{prefix}/shard{k}/ids"] = ids
-                out[f"{prefix}/shard{k}/rows"] = \
-                    shard.rows[pos].copy() if len(ids) else \
-                    np.empty((0, self.dim), self.dtype)
-                for s in self.slot_names:
-                    out[f"{prefix}/shard{k}/slot/{s}"] = \
-                        shard.slots[s][pos].copy() if len(ids) else \
+                    out[f"{prefix}/shard{k}/ids"] = np.empty(0, np.int64)
+                    out[f"{prefix}/shard{k}/rows"] = \
                         np.empty((0, self.dim), self.dtype)
-        return out
+                    for s in self.slot_names:
+                        out[f"{prefix}/shard{k}/slot/{s}"] = \
+                            np.empty((0, self.dim), self.dtype)
+        return token, out
+
+    def export_full(self) -> Tuple[int, Dict[str, np.ndarray]]:
+        """Full export under the same token protocol — the periodic
+        rebase form: snapshots (clears) the dirty set atomically with
+        the serialization, so an acked full commit leaves exactly the
+        rows pushed after it dirty."""
+        with self._lock:
+            token, _pend = self._snapshot_dirty_locked()
+            out = self._export_state_vars_locked()
+        return token, out
+
+    def commit_delta(self, token: int):
+        """Durable-ack: forget the pending positions of ``token`` (they
+        are in a committed checkpoint now).  Idempotent; tolerates
+        tokens invalidated by a restore."""
+        with self._lock:
+            self._pending.pop(token, None)
+
+    def retract_delta(self, token: int):
+        """Writer-failure path: re-mark the pending positions of
+        ``token`` dirty so those rows ride the next commit.  Idempotent;
+        a token minted before a restore is a stale no-op (the restore
+        already rebuilt table contents from a durable checkpoint)."""
+        with self._lock:
+            entry = self._pending.pop(token, None)
+            if entry is None or entry[0] != self._ckpt_gen:
+                return
+            for shard, pos in zip(self._shards, entry[1]):
+                shard.dirty[pos] = True
 
     def restore_state_vars(self, state: Dict[str, np.ndarray]):
         """Restore from an :meth:`export_state_vars` mapping (keys may
@@ -733,6 +855,13 @@ class SparseTable:
                     self.dtype).reshape(len(ids), self.dim)
                     for s in self.slot_names}
                 self._insert_by_id(ids, rows, slots)
+            # a restored table IS the committed checkpoint state: every
+            # row is clean relative to it, and any pre-restore snapshot
+            # token is stale (positions were rebuilt)
+            for shard in self._shards:
+                shard.dirty[:shard.n] = False
+            self._pending.clear()
+            self._ckpt_gen += 1
 
     def _insert_by_id(self, ids: np.ndarray, rows: np.ndarray,
                       slots: Dict[str, np.ndarray]):

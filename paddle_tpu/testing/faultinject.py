@@ -113,6 +113,15 @@ Registered sites:
                           SIGKILLs the shard process AFTER the push is
                           applied and chain-replicated but BEFORE the
                           client ack — the zero-acked-push-loss case
+``ckpt.delta``            per file written by a DELTA commit (sparse
+                          dirty-row pieces and dense chunk patches;
+                          full commits keep firing ``ckpt.write``).
+                          ``truncate`` tears the file after its md5 is
+                          recorded — restore must reject the tip and
+                          fall back to the last durable prefix of the
+                          chain; ``kill`` SIGKILLs the process
+                          mid-chain (no handler, no retraction — the
+                          torn-chain recovery case)
 ========================  ==================================================
 
 Every firing increments the ``fault/injected`` counter and emits a
@@ -136,7 +145,7 @@ KNOWN_SITES = ("trainer.step", "reader.item", "executor.dispatch",
                "master.call", "ckpt.write", "serving.request",
                "serving.dispatch", "serving.decode_step", "tuning.trial",
                "elastic.worker", "master.heartbeat", "sparse.push",
-               "pserver.rpc", "pserver.shard")
+               "pserver.rpc", "pserver.shard", "ckpt.delta")
 
 # THE zero-overhead gate: call sites guard every hook with
 # ``if faultinject.ENABLED:`` — one attribute load when off.

@@ -35,14 +35,15 @@ from typing import Optional
 import numpy as np
 
 from .core.scope import Scope
-from .distributed.checkpoint import CheckpointManager
+from .distributed.checkpoint import (DEFAULT_CHUNK_BYTES,
+                                     CheckpointManager, DeltaChainError)
 from .faults import EXIT_PREEMPTED, Preempted  # noqa: F401  (re-export)
 from .observability import emit_event, inc_counter
 
 logger = logging.getLogger("paddle_tpu")
 
 __all__ = ["TRAIN_STATE_VERSION", "TRAIN_STATE_VAR", "TrainState",
-           "Checkpointer"]
+           "Checkpointer", "DeltaPolicy"]
 
 TRAIN_STATE_VERSION = 1
 # the synthetic scope var the loop state rides in (never a program var,
@@ -101,6 +102,28 @@ class TrainState:
         return cls(**{k: v for k, v in d.items() if k in known})
 
 
+@dataclasses.dataclass
+class DeltaPolicy:
+    """When and how the Checkpointer commits incremental checkpoints.
+
+    A delta commit writes only what changed since the previous commit
+    (sparse dirty rows, dense chunk patches) and chains to it by content
+    hash; restore replays the chain, so commit cost scales with the
+    task's touched set, not model size.  Two thresholds force a full
+    rebase (which re-anchors restore cost and lets retention free the
+    old chain): ``max_chain`` — chain length a restore may have to
+    replay — and ``rebase_fraction`` — cumulative delta bytes as a
+    fraction of the last base's size (past it, deltas stop paying for
+    themselves).  Deltas are single-process; multi-host runs silently
+    keep full saves.  ``enabled=False`` restores the pre-delta behavior
+    everywhere."""
+
+    enabled: bool = True
+    max_chain: int = 8
+    rebase_fraction: float = 0.5
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES
+
+
 class Checkpointer:
     """Trainer-side checkpoint/preemption coordinator (one per
     ``train(checkpoint_dir=...)`` call).
@@ -117,7 +140,8 @@ class Checkpointer:
                  save_every_n_steps: Optional[int] = None,
                  master=None, max_to_keep: int = 3,
                  handle_signals: bool = True, extra_state=None,
-                 state_vars=None):
+                 state_vars=None, delta_source=None,
+                 delta: Optional[DeltaPolicy] = None):
         if save_every_n_steps is not None and save_every_n_steps < 1:
             raise ValueError(f"save_every_n_steps must be >= 1, got "
                              f"{save_every_n_steps}")
@@ -125,8 +149,21 @@ class Checkpointer:
         self.exe = exe
         self.save_every = save_every_n_steps
         self.master = master
+        self.delta = DeltaPolicy() if delta is None else delta
         self.manager = CheckpointManager(checkpoint_dir,
-                                         max_to_keep=max_to_keep)
+                                         max_to_keep=max_to_keep,
+                                         chunk_bytes=self.delta.chunk_bytes)
+        # delta_source: the sparse session's incremental-export surface
+        # (export_delta/export_full returning (tokens, state);
+        # commit_delta acks AFTER the durable write, retract_delta
+        # re-dirties on writer failure).  When present it supersedes
+        # ``state_vars`` — the token protocol snapshots the dirty set
+        # atomically WITH the export, so rows pushed while the async
+        # writer is serializing are never marked clean (they land in the
+        # next delta).
+        self._delta_source = delta_source if (
+            delta_source is not None
+            and getattr(delta_source, "supports_delta", False)) else None
         self.handle_signals = handle_signals
         # extra_state(): JSON-serializable dict captured at every save
         # into TrainState.elastic — the elastic worker's stream position
@@ -372,13 +409,68 @@ class Checkpointer:
             else None)
         scope = self._scope
         scope.set(TRAIN_STATE_VAR, ts.to_array())
-        rider_keys = []
-        if self._state_vars is not None:
-            for k, v in self._state_vars().items():
+        # incremental-commit policy: chain a delta while the chain is
+        # alive and under both rebase thresholds; otherwise a full
+        # rebase.  Emergency saves follow the same policy — a small
+        # delta is exactly what makes the SIGTERM grace window cheap.
+        kind = "full"
+        if self.delta.enabled and self.manager.delta_supported():
+            st = self.manager.chain_stats()
+            if st["alive"] and st["len"] < self.delta.max_chain and \
+                    (st["base_bytes"] <= 0
+                     or st["bytes"] < self.delta.rebase_fraction
+                     * st["base_bytes"]):
+                kind = "delta"
+        src = self._delta_source
+        rider_keys: list = []
+
+        def _set_riders(state):
+            for k, v in state.items():
                 scope.set(k, v)
-                rider_keys.append(k)
+                if k not in rider_keys:
+                    rider_keys.append(k)
+
+        tokens = None
+        if src is not None:
+            # the dirty set snapshots ATOMICALLY with the export (before
+            # anything reaches the async writer); commit_delta only runs
+            # on the durable ack, retract_delta re-dirties on failure —
+            # rows pushed mid-serialization stay dirty for the next delta
+            tokens, sv = (src.export_delta() if kind == "delta"
+                          else src.export_full())
+            _set_riders(sv)
+        elif self._state_vars is not None:
+            _set_riders(self._state_vars())
+
+        def _attempt(k, tk):
+            on_commit = on_fail = None
+            if src is not None:
+                on_commit = lambda info, t=tk: src.commit_delta(t)  # noqa: E731
+                on_fail = lambda exc, t=tk: src.retract_delta(t)    # noqa: E731
+            self.manager.save(self.emitted, scope, blocking=blocking,
+                              kind=k, on_commit=on_commit,
+                              on_fail=on_fail)
+
         try:
-            self.manager.save(self.emitted, scope, blocking=blocking)
+            try:
+                _attempt(kind, tokens)
+            except DeltaChainError:
+                # the chain died between the policy check and the commit
+                # (async writer failure, sparse layout change): retract
+                # the delta snapshot and rebase with a full export
+                if src is not None:
+                    src.retract_delta(tokens)
+                    tokens, sv = src.export_full()
+                    _set_riders(sv)
+                _attempt("full", tokens)
+        except BaseException:
+            # save() raised before this job could run (sticky failure of
+            # an EARLIER write, barrier timeout): nothing durable holds
+            # this snapshot — re-dirty it.  Idempotent vs the job's own
+            # on_fail (the token pops once).
+            if src is not None and tokens is not None:
+                src.retract_delta(tokens)
+            raise
         finally:
             scope.delete(TRAIN_STATE_VAR)
             for k in rider_keys:

@@ -286,7 +286,8 @@ class SGD:
                                                  else None),
                                     state_vars=(sess.export_state_vars
                                                 if sess is not None
-                                                else None))
+                                                else None),
+                                    delta_source=sess)
                 ts = None
                 if resume:
                     ts = ckpt.restore(

@@ -114,6 +114,11 @@ def no_leaked_pipeline_threads():
     sparse_mod = sys.modules.get("paddle_tpu.sparse.session")
     if sparse_mod is not None:
         prefixes.append(sparse_mod.THREAD_NAME_PREFIX)
+    # the checkpoint commit writer has the same bounded-idle-linger
+    # contract (distributed/checkpoint.py)
+    ckpt_mod = sys.modules.get("paddle_tpu.distributed.checkpoint")
+    if ckpt_mod is not None:
+        prefixes.append(ckpt_mod.THREAD_NAME_PREFIX)
 
     def leaked():
         return [t for t in threading.enumerate()

@@ -1,0 +1,67 @@
+"""Incremental-checkpoint benchmark gate: the --smoke arm runs the REAL
+code path in-process (tier-1, seconds); the full A/B is @slow per the
+frozen fast-allowlist convention (it is also what commits
+benchmark/checkpoint_results.json)."""
+import json
+import os
+
+import pytest
+
+from benchmark.checkpoint import SMOKE, run_all
+
+RESULTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmark", "checkpoint_results.json")
+
+
+def test_checkpoint_smoke_row_complete():
+    row = run_all(smoke=True, quiet=True)
+    assert row["smoke"] is True
+    # the smoke config shrinks everything EXCEPT the claim structure
+    assert set(SMOKE) <= set(row["config"])
+    ab = row["commit_ab"]
+    assert len(ab["pair_ratios"]) >= 2
+    assert len(ab["default_windows"]) == len(ab["candidate_windows"])
+    assert ab["accepted"] in (True, False)
+    if not ab["accepted"]:
+        assert ab["refusal_reason"]
+    assert ab["min_speedup"] == 5.0          # the acceptance bar
+    assert ab["min_bytes_ratio"] == 10.0
+    assert ab["bytes_ratio"] > 0
+    assert ab["full_bytes_per_commit"] and ab["delta_bytes_per_commit"]
+    # bit-identity is asserted INSIDE the benchmark; the row records it
+    assert ab["restore_bit_identical"] is True
+    el = row["elastic_tasks"]
+    assert el["tasks_per_s"]["full"] > 0
+    assert el["tasks_per_s"]["delta"] > 0
+    rc = row["restore_chain"]
+    assert rc["bit_identical"] is True
+    assert rc["chain_restore_ms"] > 0 and rc["full_restore_ms"] > 0
+    assert rc["chain_len"] == row["config"]["chain_k"]
+
+
+def test_committed_results_structure():
+    """The committed JSON carries real CPU rows + the pending-hardware
+    TPU stub (PR 1 convention), and the committed full-size run clears
+    BOTH acceptance gates (>=5x wall, >=10x bytes) with raw windows."""
+    with open(RESULTS) as fh:
+        data = json.load(fh)
+    assert data["benchmark"] == "incremental_checkpoint"
+    cpu = data["cpu"]
+    ab = cpu["commit_ab"]
+    assert ab["accepted"] or ab["refusal_reason"]
+    assert ab["default_windows"] and ab["candidate_windows"]
+    assert ab["restore_bit_identical"] is True
+    # the committed run is the acceptance evidence for this PR
+    assert ab["accepted"] is True and ab["speedup"] >= 5.0
+    assert ab["bytes_accepted"] is True and ab["bytes_ratio"] >= 10.0
+    assert cpu["config"]["touched_per_task"] <= \
+        0.01 * cpu["config"]["resident_rows"]     # <=1% touched rows
+    assert cpu["restore_chain"]["bit_identical"] is True
+    assert data["tpu"]["status"] == "pending-hardware"
+
+
+@pytest.mark.slow
+def test_checkpoint_full_ab_runs():
+    row = run_all(smoke=False, quiet=True)
+    assert row["commit_ab"]["restore_bit_identical"] is True
+    assert row["restore_chain"]["bit_identical"] is True

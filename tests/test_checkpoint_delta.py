@@ -1,0 +1,524 @@
+"""Incremental checkpointing: dirty-row sparse deltas, chunked dense
+diffs, the delta-chain manifest, and the async double-buffered commit
+pipeline (distributed/checkpoint.py + sparse table token protocol).
+
+Covers the PR 18 acceptance surface:
+  - base + delta chains restore bit-identical to the live state at the
+    last acked commit (rows, optimizer slots, export bytes);
+  - the manifest records kind/parent/chain_len/content_hash and restore
+    verifies the whole chain, falling back to the last durable prefix
+    when a link is torn (ckpt.delta truncate) or half-written (SIGKILL
+    mid-chain, the @slow subprocess round);
+  - dense vars chunk-diff (unchanged vars cost zero delta bytes);
+  - a row pushed between the dirty-set snapshot and the durable ack is
+    never marked clean (the concurrent-push regression);
+  - writer failure retracts the snapshot so those rows ride the next
+    commit, and the Checkpointer's policy rebases full on chain caps.
+"""
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointManager, DeltaChainError)
+from paddle_tpu.sparse import SparseSession, SparseTable
+from paddle_tpu.testing import faultinject as fi
+from paddle_tpu.testing.faultinject import InjectedFault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VOCAB, DIM = 800, 6
+
+
+def _mk_table(seed=11, num_shards=3, impl="vectorized", name="emb"):
+    return SparseTable(name, VOCAB, DIM, optimizer="adagrad",
+                       learning_rate=0.1, num_shards=num_shards,
+                       seed=seed, impl=impl)
+
+
+def _touch(t, rng, n=40):
+    ids = np.unique(rng.randint(0, VOCAB, n).astype(np.int64))
+    t.push(ids, rng.randn(len(ids), t.dim).astype(np.float32))
+    return ids
+
+
+def _scope_of(state, **dense):
+    sc = pt.Scope()
+    for k, v in state.items():
+        sc.set(k, v)
+    for k, v in dense.items():
+        sc.set(k, v)
+    return sc
+
+
+def _commit(cm, t, step, kind, rng=None, **dense):
+    """One blocking commit under the token protocol; returns the meta."""
+    tok, st = t.export_full() if kind == "full" else t.export_delta()
+    cm.save(step, _scope_of(st, **dense), blocking=True, kind=kind,
+            on_commit=lambda info, tk=tok: t.commit_delta(tk),
+            on_fail=lambda exc, tk=tok: t.retract_delta(tk))
+    with open(os.path.join(str(cm.root), f"ckpt-{step}",
+                           "meta.json")) as f:
+        return json.load(f)
+
+
+def _state_sha(state, w=None):
+    h = hashlib.sha256()
+    for k in sorted(state):
+        a = np.ascontiguousarray(np.asarray(state[k]))
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    if w is not None:
+        h.update(np.asarray(w, np.float32).tobytes())
+    return h.hexdigest()
+
+
+def _restore_table(cm, seed=11, num_shards=3, impl="vectorized",
+                   name="emb", step=None):
+    sc = pt.Scope()
+    restored = cm.restore(step=step, scope=sc)
+    state = {k: np.asarray(sc.get(k)) for k in sc.keys()
+             if k.startswith("__sparse__/")}
+    t = _mk_table(seed=seed, num_shards=num_shards, impl=impl, name=name)
+    t.restore_state_vars(state)
+    return restored, t, sc
+
+
+# ---------------------------------------------------------------------------
+# Delta-chain round trip + manifest
+# ---------------------------------------------------------------------------
+def test_delta_chain_restores_bit_identical(tmp_path, rng):
+    """base + 2 deltas replay to EXACTLY the live state at the last
+    commit: rows, Adagrad moment, and the canonical export bytes."""
+    t = _mk_table()
+    cm = CheckpointManager(str(tmp_path), async_save=False)
+    w = np.zeros(2048, np.float32)
+
+    _touch(t, rng)
+    m1 = _commit(cm, t, 1, "full", w=w.copy())
+    _touch(t, rng)
+    w[100:200] += 1.0
+    m2 = _commit(cm, t, 2, "delta", w=w.copy())
+    _touch(t, rng)
+    w[1500] = -3.0
+    m3 = _commit(cm, t, 3, "delta", w=w.copy())
+
+    # manifest chain: kind/parent/chain_len/content_hash
+    assert (m1["kind"], m2["kind"], m3["kind"]) == ("full", "delta",
+                                                    "delta")
+    assert m1["chain_len"] == 0 and m2["chain_len"] == 1 \
+        and m3["chain_len"] == 2
+    assert m2["parent"] == m1["content_hash"]
+    assert m3["parent"] == m2["content_hash"]
+
+    cm2 = CheckpointManager(str(tmp_path), async_save=False)
+    restored, t2, sc = _restore_table(cm2)
+    assert restored == 3
+    assert np.array_equal(np.asarray(sc.get("w"), np.float32), w)
+    # export bytes are the strictest equality: ids, rows, AND slots
+    assert _state_sha(t.export_state_vars()) == \
+        _state_sha(t2.export_state_vars())
+    allids = np.arange(VOCAB, dtype=np.int64)
+    assert np.array_equal(t.pull(allids), t2.pull(allids))
+    assert np.array_equal(t.pull_slot("moment", allids),
+                          t2.pull_slot("moment", allids))
+
+
+def test_delta_bytes_scale_with_touched_rows(tmp_path, rng):
+    """A delta touching ~2% of rows is far smaller than the full base
+    (the reason this PR exists) and records its size in the manifest."""
+    t = _mk_table()
+    t.push(np.arange(VOCAB, dtype=np.int64),
+           rng.randn(VOCAB, DIM).astype(np.float32))
+    cm = CheckpointManager(str(tmp_path), async_save=False)
+    m1 = _commit(cm, t, 1, "full")
+    _touch(t, rng, n=16)
+    m2 = _commit(cm, t, 2, "delta")
+    assert m2["delta_bytes"] > 0
+    assert m2["delta_bytes"] * 10 < m1["base_bytes"]
+    assert m2["chain_bytes"] == m2["delta_bytes"]
+    assert m2["base_bytes"] == m1["base_bytes"]
+
+
+def test_unchanged_dense_var_costs_zero_delta_bytes(tmp_path):
+    """Chunk diff: a dense var identical to the parent writes NO patch
+    file; a single-chunk change patches just that chunk."""
+    chunk = 4096
+    w = np.zeros(16 * chunk // 4, np.float32)        # 16 chunks
+    cm = CheckpointManager(str(tmp_path), async_save=False,
+                           chunk_bytes=chunk)
+    cm.save(1, _scope_of({}, w=w.copy()), blocking=True)
+    # no change at all -> zero-byte delta
+    cm.save(2, _scope_of({}, w=w.copy()), blocking=True, kind="delta")
+    with open(tmp_path / "ckpt-2" / "meta.json") as f:
+        m2 = json.load(f)
+    assert m2["delta_bytes"] == 0
+    ent = m2["vars"]["w"]
+    assert ent["mode"] == "chunks"
+    assert all(sh["patch"] is None for sh in ent["shards"])
+    # one element -> exactly one changed chunk in the patch
+    w[5 * chunk // 4] = 7.0
+    cm.save(3, _scope_of({}, w=w.copy()), blocking=True, kind="delta")
+    with open(tmp_path / "ckpt-3" / "meta.json") as f:
+        m3 = json.load(f)
+    sh = m3["vars"]["w"]["shards"][0]
+    assert sh["patch"] is not None and sh["patch"]["changed"] == [5]
+    assert 0 < m3["delta_bytes"] <= 2 * chunk
+    sc = pt.Scope()
+    assert CheckpointManager(str(tmp_path)).restore(scope=sc) == 3
+    assert np.array_equal(np.asarray(sc.get("w"), np.float32), w)
+
+
+def test_delta_requires_live_matching_chain(tmp_path, rng):
+    """Fail fast BEFORE bytes land: no committed parent, or a sparse
+    group layout that differs from the parent, raises DeltaChainError
+    (the caller's cue to re-export a full rebase)."""
+    t = _mk_table()
+    _touch(t, rng)
+    cm = CheckpointManager(str(tmp_path), async_save=False)
+    with pytest.raises(DeltaChainError):
+        cm.save(1, _scope_of(t.export_full()[1]), blocking=True,
+                kind="delta")
+    _commit(cm, t, 1, "full")
+    _touch(t, rng)
+    tok, st = t.export_delta()
+    dropped = {k: v for k, v in st.items()
+               if not k.startswith("__sparse__/emb/shard2/")}
+    with pytest.raises(DeltaChainError):
+        cm.save(2, _scope_of(dropped), blocking=True, kind="delta")
+    t.retract_delta(tok)
+    assert not os.path.isdir(tmp_path / "ckpt-2")
+    # a failed delta attempt conservatively kills the planned chain —
+    # the next delta refuses up front and a full rebase revives it
+    assert not cm.chain_stats()["alive"]
+    with pytest.raises(DeltaChainError):
+        cm.save(2, _scope_of({}), blocking=True, kind="delta")
+    _commit(cm, t, 2, "full")
+    _touch(t, rng)
+    _commit(cm, t, 3, "delta")
+
+
+def test_restore_adopts_tip_and_next_delta_chains_onto_it(tmp_path, rng):
+    t = _mk_table()
+    _touch(t, rng)
+    cm = CheckpointManager(str(tmp_path), async_save=False)
+    _commit(cm, t, 1, "full")
+    _touch(t, rng)
+    m2 = _commit(cm, t, 2, "delta")
+
+    cm2 = CheckpointManager(str(tmp_path), async_save=False)
+    assert not cm2.chain_stats()["alive"]      # nothing adopted yet
+    restored, t2, _ = _restore_table(cm2)
+    assert restored == 2 and cm2.chain_stats()["alive"]
+    _touch(t2, rng)
+    m3 = _commit(cm2, t2, 3, "delta")
+    assert m3["parent"] == m2["content_hash"] and m3["chain_len"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Dirty-set token protocol (satellite a: concurrent push mid-commit)
+# ---------------------------------------------------------------------------
+def test_push_between_snapshot_and_ack_stays_dirty(rng):
+    """A row pushed while the writer is serializing the snapshot must
+    ride the NEXT delta — the ack can only clean rows it actually
+    captured."""
+    t = _mk_table()
+    a = _touch(t, rng)
+    tok, st = t.export_delta()
+    assert t.dirty_rows == 0                    # snapshot moved them
+    b = np.array([VOCAB - 1], np.int64)
+    assert b[0] not in a
+    t.push(b, np.ones((1, DIM), np.float32))    # "mid-serialization"
+    t.commit_delta(tok)                         # durable ack
+    assert t.dirty_rows == 1                    # b survived the ack
+    _, st2 = t.export_delta()
+    nxt = np.concatenate([v for k, v in st2.items() if k.endswith("/ids")])
+    assert list(nxt) == [VOCAB - 1]
+
+
+def test_retract_re_dirties_and_is_idempotent(rng):
+    t = _mk_table()
+    ids = _touch(t, rng)
+    tok, _ = t.export_delta()
+    assert t.dirty_rows == 0
+    t.retract_delta(tok)
+    assert t.dirty_rows == len(ids)             # back on the next commit
+    t.retract_delta(tok)                        # double-fire: no-op
+    assert t.dirty_rows == len(ids)
+    tok2, _ = t.export_delta()
+    t.commit_delta(tok2)
+    t.retract_delta(tok2)                       # retract after ack: no-op
+    assert t.dirty_rows == 0
+
+
+def test_writer_failure_retracts_so_rows_ride_next_commit(tmp_path, rng):
+    """End-to-end: an injected delta-file write failure fires on_fail,
+    the dirty set comes back, and a fresh manager commits those rows in
+    the full rebase."""
+    t = _mk_table()
+    _touch(t, rng)
+    cm = CheckpointManager(str(tmp_path), async_save=False)
+    _commit(cm, t, 1, "full")
+    ids = _touch(t, rng)
+    fi.configure("ckpt.delta@1=error")
+    try:
+        tok, st = t.export_delta()
+        with pytest.raises(InjectedFault):
+            cm.save(2, _scope_of(st), blocking=True, kind="delta",
+                    on_commit=lambda info, tk=tok: t.commit_delta(tk),
+                    on_fail=lambda exc, tk=tok: t.retract_delta(tk))
+    finally:
+        fi.clear()
+    assert t.dirty_rows == len(ids)
+    # failed write killed the chain: the policy's next commit is full
+    assert not cm.chain_stats()["alive"]
+    _commit(cm, t, 2, "full")
+    assert t.dirty_rows == 0
+    _, t2, _ = _restore_table(CheckpointManager(str(tmp_path)))
+    assert _state_sha(t.export_state_vars()) == \
+        _state_sha(t2.export_state_vars())
+
+
+# ---------------------------------------------------------------------------
+# Checkpointer policy (rebase caps) + async pipeline
+# ---------------------------------------------------------------------------
+def _mk_checkpointer(tmp_path, sess, **kw):
+    from paddle_tpu.train_state import Checkpointer
+
+    class _Exe:
+        _step = 0
+    return Checkpointer(str(tmp_path), _Exe(), handle_signals=False,
+                        delta_source=sess, **kw)
+
+
+def test_checkpointer_policy_full_then_deltas_then_rebase(tmp_path, rng):
+    from paddle_tpu.train_state import DeltaPolicy
+    t = _mk_table()
+    # a big base keeps each ~10-row delta far under rebase_fraction, so
+    # the ONLY rebase trigger in this run is the max_chain cap
+    t.push(np.arange(VOCAB, dtype=np.int64),
+           rng.randn(VOCAB, DIM).astype(np.float32))
+    sess = SparseSession(t)
+    ck = _mk_checkpointer(tmp_path, sess,
+                          delta=DeltaPolicy(max_chain=2), max_to_keep=10)
+    scope = pt.Scope()
+    scope.set("w", np.zeros(256, np.float32))
+    ck.begin(scope, None, 0, {})
+    kinds = []
+    for step in range(1, 6):
+        _touch(t, rng, n=10)
+        ck.emitted = step
+        ck._save(0, 0, blocking=True)
+        with open(tmp_path / f"ckpt-{step}" / "meta.json") as f:
+            kinds.append(json.load(f)["kind"])
+    # chain caps at max_chain=2 deltas, then a full rebase starts anew
+    assert kinds == ["full", "delta", "delta", "full", "delta"]
+    assert t.dirty_rows == 0
+    snap = pt.observability.registry().snapshot()
+    assert snap["checkpoint/rebase_total"]["value"] >= 1
+    assert snap["checkpoint/delta_rows"]["value"] > 0
+    _, t2, _ = _restore_table(CheckpointManager(str(tmp_path)))
+    assert _state_sha(t.export_state_vars()) == \
+        _state_sha(t2.export_state_vars())
+
+
+def test_async_pipeline_commits_in_order_and_acks_late(tmp_path, rng):
+    """Async double-buffered commits: several queued deltas land in
+    order, wait() drains, and every token acks (dirty set empty)."""
+    t = _mk_table()
+    cm = CheckpointManager(str(tmp_path), async_save=True)
+    _touch(t, rng)
+    tok, st = t.export_full()
+    cm.save(1, _scope_of(st), kind="full",
+            on_commit=lambda info, tk=tok: t.commit_delta(tk),
+            on_fail=lambda exc, tk=tok: t.retract_delta(tk))
+    for step in (2, 3, 4):
+        _touch(t, rng)
+        tok, st = t.export_delta()
+        cm.save(step, _scope_of(st), kind="delta",
+                on_commit=lambda info, tk=tok: t.commit_delta(tk),
+                on_fail=lambda exc, tk=tok: t.retract_delta(tk))
+    cm.wait()
+    assert t.dirty_rows == 0
+    metas = []
+    for step in (1, 2, 3, 4):
+        with open(tmp_path / f"ckpt-{step}" / "meta.json") as f:
+            metas.append(json.load(f))
+    assert [m["kind"] for m in metas] == ["full"] + ["delta"] * 3
+    for child, parent in zip(metas[1:], metas[:-1]):
+        assert child["parent"] == parent["content_hash"]
+    _, t2, _ = _restore_table(CheckpointManager(str(tmp_path)))
+    assert _state_sha(t.export_state_vars()) == \
+        _state_sha(t2.export_state_vars())
+
+
+def test_gc_pins_delta_ancestors_until_rebase(tmp_path, rng):
+    """max_to_keep counts steps, but a kept delta tip pins its whole
+    ancestor chain; the chain frees once no kept tip references it."""
+    t = _mk_table()
+    cm = CheckpointManager(str(tmp_path), async_save=False, max_to_keep=2)
+    _touch(t, rng)
+    _commit(cm, t, 1, "full")
+    for step in (2, 3, 4):
+        _touch(t, rng)
+        _commit(cm, t, step, "delta")
+    assert cm.all_steps() == [1, 2, 3, 4]       # tip 4 pins 1-3
+    _touch(t, rng)
+    _commit(cm, t, 5, "full")
+    assert cm.all_steps() == [1, 2, 3, 4, 5]    # kept tip 4 still pins
+    _touch(t, rng)
+    _commit(cm, t, 6, "full")
+    assert cm.all_steps() == [5, 6]             # chain finally freed
+
+
+# ---------------------------------------------------------------------------
+# Torn-delta durability (satellite b, fast half)
+# ---------------------------------------------------------------------------
+def test_truncated_delta_falls_back_to_durable_prefix(tmp_path, rng):
+    """ckpt.delta truncate tears a delta file AFTER its md5 is recorded:
+    chain verification must reject the whole tip and restore the previous
+    durable commit exactly."""
+    t = _mk_table()
+    cm = CheckpointManager(str(tmp_path), async_save=False)
+    _touch(t, rng)
+    _commit(cm, t, 1, "full")
+    _touch(t, rng)
+    _commit(cm, t, 2, "delta")
+    oracle = _state_sha(t.export_state_vars())  # durable prefix = step 2
+    _touch(t, rng)
+    fi.configure("ckpt.delta@1=truncate")
+    try:
+        _commit(cm, t, 3, "delta")
+        assert fi.fired("ckpt.delta") == 1
+    finally:
+        fi.clear()
+    before = pt.observability.registry().snapshot()[
+        "fault/checkpoint_fallbacks"]["value"]
+    restored, t2, _ = _restore_table(CheckpointManager(str(tmp_path)))
+    assert restored == 2
+    assert _state_sha(t2.export_state_vars()) == oracle
+    after = pt.observability.registry().snapshot()[
+        "fault/checkpoint_fallbacks"]["value"]
+    assert after - before == 1
+
+
+# ---------------------------------------------------------------------------
+# Kill mid-chain (satellite b, @slow chaos round)
+# ---------------------------------------------------------------------------
+_CHILD = textwrap.dedent("""
+    import hashlib, json, os, sys
+    import numpy as np
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    from paddle_tpu.sparse import SparseTable
+    from paddle_tpu.testing import faultinject
+
+    root, acked, spec = sys.argv[1], sys.argv[2], sys.argv[3]
+    if spec:
+        faultinject.configure(spec)
+    rng = np.random.RandomState(7)
+    t = SparseTable("emb", 2000, 6, optimizer="adagrad",
+                    learning_rate=0.1, num_shards=3, seed=11)
+    cm = CheckpointManager(root, async_save=False, max_to_keep=10)
+    w = np.zeros(4096, np.float32)
+
+    def sha(state, w):
+        h = hashlib.sha256()
+        for k in sorted(state):
+            a = np.ascontiguousarray(np.asarray(state[k]))
+            h.update(k.encode()); h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode()); h.update(a.tobytes())
+        h.update(np.asarray(w, np.float32).tobytes())
+        return h.hexdigest()
+
+    for step in range(1, 9):
+        ids = np.unique(rng.randint(0, 2000, 40).astype(np.int64))
+        t.push(ids, rng.randn(len(ids), 6).astype(np.float32))
+        w[(step * 13) % 4096] += 1.0
+        kind = "full" if step == 1 else "delta"
+        tok, st = t.export_full() if kind == "full" else t.export_delta()
+        sc = pt.Scope()
+        for k, v in st.items():
+            sc.set(k, v)
+        sc.set("w", w.copy())
+        cm.save(step, sc, blocking=True, kind=kind,
+                on_commit=lambda info, tk=tok: t.commit_delta(tk),
+                on_fail=lambda exc, tk=tok: t.retract_delta(tk))
+        # the save returned -> this commit is DURABLE: record the acked
+        # oracle atomically so the on-disk acked file enumerates exactly
+        # the commits restore may land on
+        tmp = acked + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": step,
+                       "sha": sha(t.export_state_vars(), w)}, f)
+            f.flush(); os.fsync(f.fileno())
+        os.replace(tmp, acked)
+        dfd = os.open(os.path.dirname(acked), os.O_RDONLY)
+        os.fsync(dfd); os.close(dfd)
+    print("DONE", flush=True)
+""")
+
+
+def _run_child(tmp_path, spec):
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_FAULT_SPEC", None)
+    env.pop("PADDLE_TPU_METRICS_LOG", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    root = str(tmp_path / "ckpt")
+    acked = str(tmp_path / "acked.json")
+    child = tmp_path / "child.py"
+    child.write_text(_CHILD)
+    proc = subprocess.run(
+        [sys.executable, str(child), root, acked, spec],
+        env=env, capture_output=True, text=True, timeout=300)
+    return proc, root, acked
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(360)
+def test_kill_mid_chain_restores_last_acked_commit(tmp_path):
+    """SIGKILL while a delta's files are being written: the survivor
+    restores EXACTLY the newest commit whose save() had returned in the
+    child — sha256 over rows+slots+dense vs the acked oracle."""
+    # each delta commit writes ~10 delta files (3 shards x ids/rows/
+    # moment + the dense patch) — index 25 kills inside the 3rd delta
+    proc, root, acked = _run_child(tmp_path, "ckpt.delta@25=kill")
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    assert "DONE" not in proc.stdout            # it really died mid-run
+    with open(acked) as f:
+        oracle = json.load(f)
+    assert oracle["step"] >= 2                  # died with deltas on disk
+    cm = CheckpointManager(root, async_save=False)
+    sc = pt.Scope()
+    restored = cm.restore(scope=sc)
+    assert restored == oracle["step"]
+    t2 = SparseTable("emb", 2000, 6, optimizer="adagrad",
+                     learning_rate=0.1, num_shards=3, seed=11)
+    state = {k: np.asarray(sc.get(k)) for k in sc.keys()
+             if k.startswith("__sparse__/")}
+    t2.restore_state_vars(state)
+    h = hashlib.sha256()
+    st = t2.export_state_vars()
+    for k in sorted(st):
+        a = np.ascontiguousarray(np.asarray(st[k]))
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    h.update(np.asarray(sc.get("w"), np.float32).tobytes())
+    assert h.hexdigest() == oracle["sha"]
+    # and the survivor can keep chaining from the adopted tip
+    _touch(t2, np.random.RandomState(0))
+    _commit(cm, t2, restored + 1, "delta")
