@@ -33,7 +33,18 @@ PT031     error     sharded dim not divisible by its mesh axis size
 PT040     error     sharding spec double-books a mesh axis across dims
 PT041     warning   sharding conflict at an op: a reshard is required
 PT042     warning   sharding propagation blind spot: op has no shard rule
+PT050     warning   shared attribute written both under and outside a lock
+PT051     error     static lock-acquisition-order cycle
+PT052     warning   blocking call while holding a lock
+PT053     error     Condition.wait outside a while-predicate loop
+PT054     error     lock acquisition reachable from a signal handler
+PT055     warning   framework thread without a registered pt- name prefix
 ========  ========  =====================================================
+
+The PT05x family is emitted by :mod:`.concurrency` — an AST pass over the
+*host source tree* (the threaded runtime itself), not the Program IR, so
+its diagnostics locate findings as ``path:line`` in the message and leave
+``op`` empty.
 """
 from __future__ import annotations
 
@@ -63,6 +74,14 @@ CODES = {
     "PT040": (ERROR, "mesh axis double-booked across dims of one spec"),
     "PT041": (WARNING, "sharding conflict at an op (reshard required)"),
     "PT042": (WARNING, "sharding propagation blind spot (no shard rule)"),
+    "PT050": (WARNING, "shared attribute written both under and outside "
+                       "a lock (guard inconsistency)"),
+    "PT051": (ERROR, "static lock-acquisition-order cycle"),
+    "PT052": (WARNING, "blocking call while holding a lock"),
+    "PT053": (ERROR, "Condition.wait outside a while-predicate loop"),
+    "PT054": (ERROR, "lock acquisition reachable from a signal handler"),
+    "PT055": (WARNING, "framework thread without a registered pt- name "
+                       "prefix"),
 }
 
 

@@ -355,7 +355,31 @@ def job_check(argv):
                          "plan's own mesh applies when --mesh is omitted")
     ap.add_argument("--strict", action="store_true",
                     help="exit nonzero on warnings too")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run the PT05x lock-discipline pass over the "
+                         "paddle_tpu host source tree instead of a "
+                         "program (analysis.concurrency): findings "
+                         "beyond the frozen baseline fail the check")
     args = ap.parse_args(argv)
+    if args.concurrency:
+        if args.program is not None or args.config is not None:
+            ap.error("--concurrency analyzes the host source tree; "
+                     "it takes no program/--config")
+        from paddle_tpu.analysis import concurrency as _cc
+        findings = _cc.analyze_package()
+        new, suppressed, stale = _cc.apply_baseline(findings)
+        print(_cc.render_report(findings), flush=True)
+        warn_new = [f for f in new
+                    if _cc.CODES[f.code][0] != "error"]
+        err_new = [f for f in new if _cc.CODES[f.code][0] == "error"]
+        failed = bool(err_new or stale
+                      or (args.strict and warn_new))
+        print(json.dumps({"check": "FAIL" if failed else "PASS",
+                          "findings": len(findings),
+                          "new": len(new), "stale": len(stale),
+                          "baselined": sum(suppressed.values())}),
+              flush=True)
+        return 1 if failed else 0
     if (args.program is None) == (args.config is None):
         ap.error("give exactly one of a program file or --config")
 

@@ -63,6 +63,7 @@ import numpy as np
 
 from ..core.scope import Scope, global_scope
 from ..testing import faultinject as _fi
+from ..testing import lockwatch as _lw
 
 logger = logging.getLogger("paddle_tpu")
 
@@ -236,7 +237,7 @@ class CheckpointManager:
         # by an idle-linger worker.  A failure is held sticky and
         # re-raised from the next save()/wait() on the calling thread —
         # an uncommitted checkpoint is never silently recorded as saved.
-        self._wcv = threading.Condition()
+        self._wcv = _lw.make_condition("checkpoint.writer")
         self._wq: List[dict] = []
         self._winflight: Optional[dict] = None
         self._wthread: Optional[threading.Thread] = None
@@ -247,7 +248,7 @@ class CheckpointManager:
         # tables the next delta diffs against); _planned_* is the main
         # thread's optimistic view used for rebase policy while a write
         # is still in flight.
-        self._chain_lock = threading.Lock()
+        self._chain_lock = _lw.make_lock("checkpoint.chain")
         self._committed: Optional[dict] = None
         self._planned_alive = False
         self._planned_len = 0

@@ -26,6 +26,7 @@ from typing import Callable, List, Optional
 
 from ..faults import RetryPolicy, classify
 from ..testing import faultinject as _fi
+from ..testing import lockwatch as _lw
 
 logger = logging.getLogger("paddle_tpu")
 
@@ -69,7 +70,7 @@ class Master:
             raise ValueError(f"world must be >= 1, got {world}")
         self.world = world
         self.heartbeat_lease_s = float(heartbeat_lease_s)
-        self._lock = threading.Lock()
+        self._lock = _lw.make_lock("master.queue")
         self.todo: List[Task] = []
         self.pending = {}           # task_id -> (Task, deadline, slot)
         self.done: List[Task] = []
@@ -219,7 +220,7 @@ class Master:
             if self.world is not None:
                 self._release_slot_leases(slot)
                 if cursor is not None:
-                    self._reconcile_cursor(slot, int(cursor))
+                    self._reconcile_cursor_locked(slot, int(cursor))
                 # the authoritative committed count for this shard: the
                 # worker adopts it as its cursor (post-resize there is no
                 # per-worker cursor to carry — the re-shard rebased it).
@@ -315,7 +316,7 @@ class Master:
         arithmetic must not count it."""
         return t.num_failures >= self.failure_max
 
-    def _reconcile_cursor(self, slot: int, cursor: int):
+    def _reconcile_cursor_locked(self, slot: int, cursor: int):
         """(locked) force the first ``cursor`` tasks of ``slot``'s shard
         (ascending id, EXCLUDING failure-budget drops — the worker was
         never served those, so its cursor doesn't count them) done;
@@ -482,7 +483,7 @@ class MasterServer:
         self._server = Server((host, port), Handler)
         self.host, self.port = self._server.server_address
         self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True)
+                                        name="pt-master-rpc", daemon=True)
 
     def _dispatch(self, method, params):
         if method not in self.METHODS:
@@ -550,7 +551,7 @@ class MasterClient:
         self._retries = self._retry_policy.max_attempts
         self._sock = None
         self._file = None
-        self._lock = threading.Lock()
+        self._lock = _lw.make_lock("master.client")
 
     def _connect(self, timeout=None):
         self._sock = socket.create_connection(

@@ -38,6 +38,7 @@ from typing import Callable, Optional, Sequence
 import logging
 
 from ..faults import EXIT_PREEMPTED, Preempted
+from ..testing import lockwatch as _lw
 from ..observability import emit_event, inc_counter
 
 logger = logging.getLogger("paddle_tpu")
@@ -84,7 +85,7 @@ class Supervisor:
         # RLock: terminate() may run inside a signal handler ON the
         # thread that is blocked in run_command's wait while holding
         # this lock — a plain Lock would self-deadlock there
-        self._child_lock = threading.RLock()
+        self._child_lock = _lw.make_rlock("supervisor.child")
         self._terminated = False   # deliberate stop: no relaunch
 
     def _note_restart(self, what: str, outcome: str, delay_s: float):

@@ -24,6 +24,7 @@ import time
 from typing import Dict, List, Optional
 
 from . import metrics as _metrics
+from ..testing import lockwatch as _lw
 
 logger = logging.getLogger("paddle_tpu")
 
@@ -49,7 +50,7 @@ class _Writer:
     follows the ``metrics_log`` flag (a changed path reopens)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _lw.make_lock("observability.export")
         self._path: Optional[str] = None
         self._fh = None
 
@@ -523,6 +524,26 @@ def summarize_logs(paths) -> dict:
             "max_chain_len": max((int(e.get("chain_len", 0))
                                   for e in commits), default=0),
         }
+    if last_snapshot is not None:
+        # lock-order watchdog (testing.lockwatch): only populated when
+        # the run had PADDLE_TPU_LOCKWATCH on — absent metrics mean the
+        # watchdog was off, and the section is omitted entirely
+        m = last_snapshot.get("metrics") or {}
+        held = m.get("concurrency/lock_held_ms") or {}
+        edges = ((m.get("concurrency/order_edges") or {})
+                 .get("values") or {})
+        if held.get("count"):
+            summary["lockwatch"] = {
+                "holds": held.get("count", 0),
+                "held_ms_max": held.get("max"),
+                "order_edges": int(edges.get("", 0)),
+                "order_violations": int(
+                    (m.get("concurrency/order_violations") or {})
+                    .get("value", 0)),
+                "long_holds": int(
+                    (m.get("concurrency/long_holds") or {})
+                    .get("value", 0)),
+            }
     return summary
 
 
@@ -638,6 +659,15 @@ def render_summary(summary: dict) -> str:
             f"{ck['rebases']} rebase(s), max chain {ck['max_chain_len']}"
             + (f", commit p50 {ck['commit_ms_p50']} ms"
                if ck.get("commit_ms_p50") is not None else ""))
+    lk = summary.get("lockwatch")
+    if lk:
+        lines.append(
+            f"lockwatch: {lk['holds']} watched hold(s), "
+            f"{lk['order_edges']} order edge(s), "
+            f"{lk['order_violations']} violation(s), "
+            f"{lk['long_holds']} long hold(s)"
+            + (f", longest {lk['held_ms_max']} ms"
+               if lk.get("held_ms_max") is not None else ""))
     return "\n".join(lines)
 
 

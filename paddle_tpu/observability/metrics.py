@@ -29,7 +29,8 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 __all__ = [
-    "METRIC_NAMES", "HISTOGRAM_BUCKETS", "MetricsRegistry", "registry",
+    "METRIC_NAMES", "THREAD_NAME_PREFIXES", "HISTOGRAM_BUCKETS",
+    "MetricsRegistry", "registry",
     "inc_counter", "set_gauge", "observe_hist", "enabled",
 ]
 
@@ -295,6 +296,42 @@ METRIC_NAMES = (
      "writer wall time of one durable commit, serialize to fsync'd "
      "meta (full and delta alike; the trainer only pays this when a "
      "hard barrier drains the queue)"),
+    # lock-order watchdog (testing.lockwatch): writes only happen when
+    # PADDLE_TPU_LOCKWATCH is on — the factories return PLAIN threading
+    # primitives when off, so production paths never reach these helpers
+    ("concurrency/order_violations", "counter",
+     "lock-acquisition-order cycles detected by lockwatch (each raised "
+     "as a deterministic LockOrderViolation instead of deadlocking)"),
+    ("concurrency/order_edges", "gauge",
+     "distinct lock-class ordering edges in the process-wide lockwatch "
+     "acquisition graph"),
+    ("concurrency/long_holds", "counter",
+     "lock holds exceeding the PADDLE_TPU_LOCKWATCH_HOLD_MS watchdog "
+     "threshold"),
+    ("concurrency/lock_held_ms", "histogram",
+     "watched-lock hold time, acquire to release (lockwatch on only)"),
+)
+
+# ---------------------------------------------------------------------------
+# Frozen framework thread-name prefixes.  (prefix, help) — every thread the
+# framework starts MUST carry a name beginning with one of these (AST-gated
+# by the PT055 concurrency pass + tests/test_repo_lint.py; runtime-asserted
+# by the conftest thread-leak fixture), so leak reports, `stats` output and
+# operator tooling can attribute any thread to its subsystem by name alone.
+# ---------------------------------------------------------------------------
+THREAD_NAME_PREFIXES = (
+    ("pt-input-pipeline", "reader pipeline prefetch workers"),
+    ("pt-reader", "reader decorator xmap/pipe workers"),
+    ("pt-sparse", "sparse session prefetch + async-push workers"),
+    ("pt-ckpt", "incremental checkpoint commit writer"),
+    ("pt-serving", "serving batcher/dispatcher/stdin threads"),
+    ("pt-decode", "continuous-batching decode loop"),
+    ("pt-http", "HTTP serving front acceptor"),
+    ("pt-fleet", "fleet router/drain/autoscale/replica-io threads"),
+    ("pt-elastic", "elastic worker heartbeat daemons"),
+    ("pt-master", "distributed master RPC server"),
+    ("pt-pserver", "sparse pserver selector/acceptor loops"),
+    ("pt-tune", "autotuner trial client threads"),
 )
 
 _MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,

@@ -164,9 +164,11 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     return
                 out_q.put(mapper(d))
 
-        threading.Thread(target=read_worker, daemon=True).start()
-        workers = [threading.Thread(target=map_worker, daemon=True)
-                   for _ in range(process_num)]
+        threading.Thread(target=read_worker, daemon=True,
+                         name="pt-reader-xmap-read").start()
+        workers = [threading.Thread(target=map_worker, daemon=True,
+                                    name=f"pt-reader-xmap-map-{i}")
+                   for i in range(process_num)]
         for w in workers:
             w.start()
         finished = 0

@@ -38,6 +38,7 @@ import time as _time
 from typing import Callable, Optional, Sequence
 
 from .. import observability as _obs
+from ..testing import lockwatch as _lw
 from ..core.registry import register_tunable
 
 __all__ = ["prefetch", "interleave", "THREAD_NAME_PREFIX"]
@@ -242,7 +243,7 @@ def prefetch(reader: Callable, buffer_size: Optional[int] = None,
 
     def data_reader():
         it = iter(reader())
-        lock = threading.Lock()
+        lock = _lw.make_lock("pipeline.shared_source")
         exhausted = object()
 
         def source():

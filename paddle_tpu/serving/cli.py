@@ -43,6 +43,7 @@ import time
 from typing import Dict, Optional
 
 from .. import faults as _faults
+from ..testing import lockwatch as _lw
 from .model import Model
 from .server import Server
 
@@ -58,7 +59,7 @@ class _Emitter:
 
     def __init__(self, fh):
         self._fh = fh
-        self._lock = threading.Lock()
+        self._lock = _lw.make_lock("serving.cli.emitter")
         self._dead = False
 
     def emit(self, obj: dict):

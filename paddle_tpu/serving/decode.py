@@ -61,6 +61,7 @@ from .. import faults as _faults
 from .. import observability as obs
 from ..core.registry import register_tunable
 from ..testing import faultinject as _fi
+from ..testing import lockwatch as _lw
 from .server import ModelError, PendingResponse
 
 logger = logging.getLogger("paddle_tpu")
@@ -327,8 +328,8 @@ class DecodeRuntime:
                                 backoff_max_s=0.1, seed=0)
         # RLock: submit() consults breaker_state() while holding the
         # admission condition, which shares this lock
-        self.lock = threading.RLock()
-        self.cond = threading.Condition(self.lock)
+        self.lock = _lw.make_rlock("serving.decode")
+        self.cond = _lw.make_condition("serving.decode", self.lock)
         self.queue: collections.deque = collections.deque()
         self.slots: List[Optional[_Seq]] = [None] * engine.slots
         self.closed = False
@@ -579,8 +580,9 @@ class DecodeRuntime:
         the engine slabs — a dispatch that died after donation may have
         consumed the old buffers, and the evicted sessions' state is
         unrecoverable anyway.  Queued requests are untouched."""
-        actives = [s for s in self.slots if s is not None]
-        self.slots = [None] * self.engine.slots
+        with self.cond:
+            actives = [s for s in self.slots if s is not None]
+            self.slots = [None] * self.engine.slots
         for w in actives:
             w.req._complete(error=err)
         self.engine.reset()

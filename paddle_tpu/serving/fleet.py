@@ -62,6 +62,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from .. import faults as _faults
 from .. import observability as obs
 from ..distributed.supervisor import Supervisor
+from ..testing import lockwatch as _lw
 from .server import Server
 from .server import ModelError as _ModelError
 
@@ -133,7 +134,7 @@ class FleetPending:
         self.attempts = 0            # replicas this request was offered to
         self._event = threading.Event()
         self._callbacks: List[Callable] = []
-        self._lock = threading.Lock()
+        self._lock = _lw.make_lock("fleet.request")
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -291,7 +292,7 @@ class ProcessReplica:
         self.cordoned = False
         self._wire = 0
         self._pending: Dict[str, FleetPending] = {}
-        self._lock = threading.Lock()
+        self._lock = _lw.make_lock("fleet.replica")
         self._reader: Optional[threading.Thread] = None
         # outbound lines drain on a dedicated writer thread: a full
         # stdin pipe (slow replica) must never block the router's
@@ -651,7 +652,7 @@ class FleetRouter:
         self.backlog_limit = backlog_limit
         self.replicas: List[object] = []
         self._next_index = 0
-        self._lock = threading.RLock()
+        self._lock = _lw.make_rlock("fleet.router")
         self._state = "warming"
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []

@@ -66,6 +66,7 @@ from .. import observability as obs
 from ..core.executor import pad_batch, stack_feeds
 from ..core.registry import register_tunable
 from ..testing import faultinject as _fi
+from ..testing import lockwatch as _lw
 from .model import Model
 
 logger = logging.getLogger("paddle_tpu")
@@ -145,7 +146,7 @@ class PendingResponse:
         self.span = None
         self._event = threading.Event()
         self._callbacks: List[Callable] = []
-        self._lock = threading.Lock()
+        self._lock = _lw.make_lock("serving.request")
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
@@ -198,8 +199,8 @@ class _ModelRuntime:
     def __init__(self, model: Model, server: "Server"):
         self.model = model
         self.srv = server
-        self.lock = threading.Lock()
-        self.cond = threading.Condition(self.lock)
+        self.lock = _lw.make_lock("serving.rt")
+        self.cond = _lw.make_condition("serving.rt", self.lock)
         self.queue: collections.deque = collections.deque()
         self.staging: _queue_mod.Queue = _queue_mod.Queue(
             maxsize=max(1, server.staging_depth))
@@ -329,7 +330,7 @@ class Server:
         self._models: Dict[str, _ModelRuntime] = {}
         self._decode: Dict[str, object] = {}   # name -> DecodeRuntime
         self._state = WARMING
-        self._state_lock = threading.Lock()
+        self._state_lock = _lw.make_lock("serving.server.state")
         self._req_counter = 0
         self._started = False
 

@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import faults as _faults
+from ..testing import lockwatch as _lw
 from .. import observability as obs
 from ..observability.tracing import span
 from . import wire
@@ -146,7 +147,7 @@ class RemoteSparseTable:
         self._seq = 0
         self._socks: List[Optional[socket.socket]] = [None] * self.n_shards
         self._dials = [0] * self.n_shards
-        self._lock = threading.RLock()
+        self._lock = _lw.make_rlock("sparse.client")
         # stats mirrors, refreshed from every reply's piggyback
         self._shard_stats: Dict[int, Dict] = {}
         self.rows_initialized = 0

@@ -110,7 +110,8 @@ class PServer:
 
         srv = PServer(shard=0, n_shards=1)
         port = srv.start()            # bind; returns the chosen port
-        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t = threading.Thread(target=srv.serve_forever, daemon=True,
+                             name="pt-pserver-serve")
         ...
         srv.stop(); t.join()
     """

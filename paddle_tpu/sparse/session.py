@@ -68,6 +68,7 @@ from .. import observability as obs
 from ..core.registry import register_tunable
 from ..observability.tracing import span, start_span
 from ..testing import faultinject as _fi
+from ..testing import lockwatch as _lw
 from .table import PAD_ID, SparseTable
 
 __all__ = ["SparseBinding", "SparseSession", "HotRowCache",
@@ -289,12 +290,12 @@ class SparseSession:
         self._bound_ref = None
         self._bound_version = None
         self._push_gen = 0          # bumped per push; fences cache fills
-        self._lock = threading.Lock()
+        self._lock = _lw.make_lock("sparse.session")
         self._pending: "collections.deque" = collections.deque()
         # async-push worker state (guarded by _push_cv; the worker is
         # spawned on demand and exits after a bounded idle linger, so
         # sessions never leak threads without an explicit close)
-        self._push_cv = threading.Condition()
+        self._push_cv = _lw.make_condition("sparse.session.push")
         self._push_q: "collections.deque" = collections.deque()
         self._push_inflight = 0
         self._push_worker = None

@@ -47,6 +47,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .philox import philox_uniform_rows
+from ..testing import lockwatch as _lw
 
 logger = logging.getLogger("paddle_tpu")
 
@@ -343,7 +344,7 @@ class SparseTable:
         self.epsilon = float(epsilon)
         self.seed = int(seed)
         self._init = self._normalize_init(initializer, init_scale)
-        self._lock = threading.RLock()
+        self._lock = _lw.make_rlock("sparse.table")
         self.slot_names = _OPTIMIZER_SLOTS[optimizer]
         if impl not in ("vectorized", "reference"):
             raise ValueError(

@@ -3,7 +3,13 @@
 :mod:`paddle_tpu.testing.faultinject` is the seed-driven fault-injection
 harness behind ``PADDLE_TPU_FAULT_SPEC`` — see that module for the spec
 grammar and the registered injection sites.
+
+:mod:`paddle_tpu.testing.lockwatch` is the opt-in lock-order watchdog
+behind ``PADDLE_TPU_LOCKWATCH`` — instrumented Lock/RLock/Condition
+factories that turn a would-be deadlock into a deterministic typed
+report (the runtime twin of ``analysis.concurrency``'s PT05x pass).
 """
 from . import faultinject
+from . import lockwatch
 
-__all__ = ["faultinject"]
+__all__ = ["faultinject", "lockwatch"]

@@ -34,6 +34,7 @@ from typing import Dict, Optional
 
 from ..core import compile_cache
 from ..core.registry import get_tunable
+from ..testing import lockwatch as _lw
 from . import tunables as _tn
 
 logger = logging.getLogger("paddle_tpu")
@@ -46,7 +47,7 @@ __all__ = [
 TUNING_FORMAT = 1               # bump to invalidate every stored winner
 _PREFIX = "ptat-"
 
-_lock = threading.Lock()
+_lock = _lw.make_lock("tuning.store")
 #: (name, context) -> record dict or None (negative lookups memoized too:
 #: the zero-search-cost contract means at most ONE probe per call site)
 _memo: Dict[tuple, Optional[dict]] = {}

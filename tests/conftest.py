@@ -93,7 +93,10 @@ def fresh_state():
 
 @pytest.fixture(autouse=True)
 def no_leaked_pipeline_threads():
-    """Fail any test that leaks a live input-pipeline worker thread.
+    """Fail any test that leaks a live input-pipeline worker thread, and
+    assert every live framework (``pt-*``) thread carries a prefix
+    registered in the frozen ``THREAD_NAME_PREFIXES`` table — the
+    runtime twin of the static PT055 rule.
 
     The reader/executor pipeline engine guarantees its workers die with
     their consumer (paddle_tpu/reader/pipeline.py); this enforces the
@@ -105,7 +108,21 @@ def no_leaked_pipeline_threads():
     import threading
     import time
 
+    from paddle_tpu.observability.metrics import THREAD_NAME_PREFIXES
     from paddle_tpu.reader.pipeline import THREAD_NAME_PREFIX
+
+    # PT055's runtime twin: any live thread claiming the framework's
+    # pt- namespace must carry a REGISTERED prefix (a new subsystem
+    # must add its prefix to the frozen table, not invent one ad hoc)
+    registered = tuple(p for p, _help in THREAD_NAME_PREFIXES)
+    rogue = [t.name for t in threading.enumerate()
+             if t.is_alive() and t.name.startswith("pt-")
+             and not any(t.name == p or t.name.startswith(p + "-")
+                         for p in registered)]
+    assert not rogue, (
+        f"live framework thread(s) with unregistered pt- name prefix "
+        f"{rogue}; register the prefix in observability.metrics."
+        f"THREAD_NAME_PREFIXES")
 
     # the sparse session's workers (prefetch join-on-close, async-push
     # bounded idle linger) carry their own prefix; only enforce it when
@@ -134,6 +151,44 @@ def no_leaked_pipeline_threads():
     assert not threads, (
         f"test leaked live input-pipeline worker threads: "
         f"{[t.name for t in threads]}")
+
+
+# Threaded suites run with the lockwatch order watchdog ON: locks these
+# tests create through the lockwatch factories record the process-wide
+# acquisition-order graph, an inversion raises deterministically at the
+# acquire site, and any violation swallowed by a broad except still
+# fails the test here.  ENABLED is flipped directly (not via env):
+# the factories consult it per call, so objects built inside the test
+# get watched primitives while other suites keep plain ones.
+_LOCKWATCH_SUITES = frozenset({
+    "test_serving", "test_serving_chaos", "test_decode",
+    "test_http_front", "test_fleet", "test_fleet_chaos",
+    "test_input_pipeline", "test_master_service", "test_sparse_trainer",
+    "test_checkpoint_delta", "test_checkpoint_sharded", "test_pserver",
+    "test_elastic",
+})
+
+
+@pytest.fixture(autouse=True)
+def lockwatch_for_threaded_suites(request):
+    mod = getattr(request, "module", None)
+    name = getattr(mod, "__name__", "").rsplit(".", 1)[-1]
+    if name not in _LOCKWATCH_SUITES:
+        yield
+        return
+    from paddle_tpu.testing import lockwatch as lw
+    prior = lw.ENABLED
+    lw.ENABLED = True
+    lw.reset()
+    try:
+        yield
+    finally:
+        vs = lw.violations()
+        lw.ENABLED = prior
+        lw.reset()
+    assert not vs, (
+        "lockwatch recorded lock-order violation(s) during this test:\n"
+        + "\n\n".join(v.report() for v in vs))
 
 
 @pytest.fixture

@@ -1,0 +1,45 @@
+"""Control fixture: idiomatic concurrency that every PT05x rule must
+stay silent on — consistent guard discipline, one global lock order,
+timeouts on blocking waits, predicate-loop condition waits, a registered
+thread-name prefix, and no signal-handler lock work.
+"""
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.q = queue.Queue()
+        self.items = []
+        self.stopping = False
+
+    def put(self, item):
+        with self.cond:
+            self.items.append(item)
+            self.cond.notify()
+
+    def take(self):
+        with self.cond:
+            while not self.items and not self.stopping:
+                self.cond.wait(timeout=0.5)
+            return self.items.pop() if self.items else None
+
+    def drain_queue(self):
+        try:
+            return self.q.get(timeout=0.1)
+        except queue.Empty:
+            return None
+
+    def start(self):
+        t = threading.Thread(target=self.take, name="pt-fx-worker",
+                             daemon=True)
+        t.start()
+        return t
+
+    def stop(self, t):
+        with self.cond:
+            self.stopping = True
+            self.cond.notify_all()
+        t.join(timeout=2.0)

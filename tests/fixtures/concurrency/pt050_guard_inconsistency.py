@@ -1,0 +1,23 @@
+"""Seeded defect: PT050 — shared attribute written both under and
+outside a lock.  ``bump`` guards ``self.count``; ``sneak`` writes it
+bare.  Exactly ONE defect: nothing blocks, no ordering, threads named.
+"""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self.lock:
+            self.count = self.count + 1
+
+    def sneak(self):
+        # the defect: no lock around a write bump() guards
+        self.count = 0
+
+    def read_locked(self):
+        with self.lock:
+            return self.count

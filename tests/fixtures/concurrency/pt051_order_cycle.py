@@ -1,0 +1,24 @@
+"""Seeded defect: PT051 — static lock-order cycle.  ``transfer`` nests
+``self.a`` then ``self.b``; ``audit`` nests them in the opposite order.
+Writes stay consistently guarded so PT050 stays silent.
+"""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+        self.debits = 0
+        self.credits = 0
+
+    def transfer(self):
+        with self.a:
+            with self.b:
+                self.debits = self.debits + 1
+
+    def audit(self):
+        # the defect: b -> a reverses transfer()'s a -> b order
+        with self.b:
+            with self.a:
+                self.credits = self.credits + 1

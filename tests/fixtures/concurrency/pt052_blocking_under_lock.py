@@ -1,0 +1,20 @@
+"""Seeded defect: PT052 — blocking call while holding a lock.
+``pop`` calls ``self.q.get()`` (no timeout) inside ``with self.lock``.
+The queue drain stalls every other holder of the lock.
+"""
+import queue
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.q = queue.Queue()
+
+    def push(self, item):
+        self.q.put_nowait(item)
+
+    def pop(self):
+        with self.lock:
+            # the defect: unbounded blocking get under the lock
+            return self.q.get()

@@ -1,0 +1,24 @@
+"""Seeded defect: PT053 — ``Condition.wait`` outside a ``while`` loop.
+A spurious wakeup (or a stolen notify) leaves ``take`` running with the
+predicate still false.
+"""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.item = None
+
+    def put(self, item):
+        with self.cond:
+            self.item = item
+            self.cond.notify()
+
+    def take(self):
+        with self.cond:
+            if self.item is None:
+                # the defect: `if` + bare wait — needs `while not pred`
+                self.cond.wait()
+            item, self.item = self.item, None
+            return item

@@ -1,0 +1,23 @@
+"""Seeded defect: PT054 — lock acquisition reachable from a signal
+handler.  The handler runs on the main thread at an arbitrary bytecode
+boundary; if the interrupted frame already holds ``self.lock`` the
+process self-deadlocks.
+"""
+import signal
+import threading
+
+
+class Daemon:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.stopping = False
+        signal.signal(signal.SIGTERM, self.on_term)
+
+    def on_term(self, signum, frame):
+        # the defect: blocking acquire inside a signal handler
+        with self.lock:
+            self.stopping = True
+
+    def step(self):
+        with self.lock:
+            return self.stopping

@@ -1,0 +1,20 @@
+"""Seeded defect: PT055 — framework thread without a registered ``pt-``
+prefix name.  The leak-check fixture (and any operator reading a thread
+dump) cannot attribute "helper-1" to a subsystem.
+"""
+import threading
+
+
+class Runner:
+    def __init__(self):
+        self.done = False
+
+    def _work(self):
+        self.done = True
+
+    def start(self):
+        # the defect: ad-hoc name outside the frozen prefix table
+        t = threading.Thread(target=self._work, name="helper-1",
+                             daemon=True)
+        t.start()
+        return t

@@ -398,13 +398,25 @@ def test_diagnostic_codes_are_frozen():
     assert set(CODES) == {
         "PT001", "PT002", "PT003", "PT004", "PT005", "PT006", "PT007",
         "PT010", "PT011", "PT012", "PT020", "PT021", "PT022",
-        "PT030", "PT031", "PT040", "PT041", "PT042"}
+        "PT030", "PT031", "PT040", "PT041", "PT042",
+        "PT050", "PT051", "PT052", "PT053", "PT054", "PT055"}
     from paddle_tpu.analysis.diagnostics import ERROR, WARNING
     # the PT04x family's severities are part of the frozen contract:
     # double-booked axes are spec errors, propagation findings advise
     assert CODES["PT040"][0] == ERROR
     assert CODES["PT041"][0] == WARNING
     assert CODES["PT042"][0] == WARNING
+    # PT05x (the host-tree concurrency pass, analysis.concurrency):
+    # guard inconsistency, blocking-under-lock and unnamed threads
+    # advise; order cycles, waits without a predicate loop and
+    # signal-handler lock acquisition are outright errors — the three
+    # shapes that END as deadlocks or lost wakeups, not slowdowns
+    assert CODES["PT050"][0] == WARNING
+    assert CODES["PT051"][0] == ERROR
+    assert CODES["PT052"][0] == WARNING
+    assert CODES["PT053"][0] == ERROR
+    assert CODES["PT054"][0] == ERROR
+    assert CODES["PT055"][0] == WARNING
 
 
 # ---------------------------------------------------------------------------

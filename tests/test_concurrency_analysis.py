@@ -1,0 +1,256 @@
+"""PT05x concurrency pass: seeded-defect corpus + rule-grounding checks.
+
+Layer map:
+  * seeded corpus — ``tests/fixtures/concurrency/`` holds one MINIMAL
+    defect per PT05x code plus a clean control; each fixture must fire
+    EXACTLY its code exactly once (a rule that stops firing on its own
+    minimal reproducer is broken, a rule that co-fires is too eager)
+  * zoo silence — the model-zoo host sources carry no concurrency at
+    all, so every PT05x rule must stay silent there (false-positive
+    regression canary over real non-threaded code)
+  * grounding — the analyzer's frozen pattern tables name REAL stdlib
+    attributes, and every global the analyzer loads resolves (dis
+    agreement: the pass can never die with NameError mid-scan)
+  * baseline mechanics — apply_baseline's new/suppressed/stale split
+    on a synthetic ledger (the ratchet the tier-1 gate relies on)
+"""
+import ast
+import builtins
+import dis
+import inspect
+import os
+import pathlib
+
+import pytest
+
+from paddle_tpu.analysis import concurrency as cc
+from paddle_tpu.analysis.diagnostics import CODES
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "concurrency"
+
+# hermetic prefix table: fixtures never depend on the live registry
+FX_PREFIXES = ("pt-fx",)
+
+
+def _analyze_fixture(name):
+    path = FIXTURES / name
+    return cc.analyze_source(path.read_text(), f"tests/{name}",
+                             thread_prefixes=FX_PREFIXES)
+
+
+# ---------------------------------------------------------------------------
+# seeded corpus: exact-fire matrix
+
+
+SEEDED = [
+    ("pt050_guard_inconsistency.py", "PT050"),
+    ("pt051_order_cycle.py", "PT051"),
+    ("pt052_blocking_under_lock.py", "PT052"),
+    ("pt053_wait_no_loop.py", "PT053"),
+    ("pt054_signal_handler_lock.py", "PT054"),
+    ("pt055_unnamed_thread.py", "PT055"),
+]
+
+
+@pytest.mark.parametrize("fixture,code", SEEDED,
+                         ids=[c for _f, c in SEEDED])
+def test_seeded_defect_fires_exactly_once(fixture, code):
+    findings = _analyze_fixture(fixture)
+    assert [f.code for f in findings] == [code], (
+        f"{fixture} must fire {code} exactly once, got "
+        f"{[(f.code, f.line, f.message) for f in findings]}")
+    f = findings[0]
+    # findings are located and self-describing: real line, a symbol,
+    # and a renderable diagnostic that round-trips through the frozen
+    # code registry
+    assert f.line > 0 and f.symbol
+    assert f.code in CODES
+    assert f.code in f.render() and f.path in f.render()
+    d = f.to_diagnostic()
+    assert d.code == code
+
+
+def test_seeded_corpus_covers_every_pt05x_code():
+    # adding PT056 without a minimal reproducer fixture fails here
+    assert {c for _f, c in SEEDED} == {
+        c for c in CODES if c.startswith("PT05")}
+
+
+def test_clean_fixture_is_silent():
+    assert _analyze_fixture("clean.py") == []
+
+
+def test_pt051_cycle_names_both_locks():
+    (f,) = _analyze_fixture("pt051_order_cycle.py")
+    # the report must let a reader act without re-running the pass:
+    # both lock classes in the cycle appear in the message
+    assert "a" in f.symbol or "a" in f.message
+    assert "b" in f.message or "b" in f.symbol
+
+
+# ---------------------------------------------------------------------------
+# zoo silence: no spurious findings over real non-threaded host code
+
+
+def _model_sources():
+    root = pathlib.Path(cc.package_root()) / "models"
+    files = sorted(p for p in root.rglob("*.py"))
+    assert len(files) >= 8, f"model zoo moved? found {files}"
+    return files
+
+
+@pytest.mark.parametrize(
+    "path", _model_sources(),
+    ids=lambda p: str(p.relative_to(pathlib.Path(cc.package_root()) /
+                                    "models")))
+def test_zoo_host_sources_have_zero_findings(path):
+    rel = os.path.relpath(path, os.path.dirname(cc.package_root()))
+    findings = cc.analyze_source(path.read_text(), rel.replace(os.sep, "/"),
+                                 thread_prefixes=FX_PREFIXES)
+    assert findings == [], (
+        f"spurious PT05x finding(s) in zoo model source: "
+        f"{[f.render() for f in findings]}")
+
+
+def test_package_scan_covers_the_zoo():
+    # the whole-tree scan (the thing the tier-1 ratchet gate runs) walks
+    # every model file — silence above is meaningful only if scanned
+    scanned = set()
+    root = cc.package_root()
+    for dirpath, dirs, files in os.walk(os.path.join(root, "models")):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        scanned.update(os.path.join(dirpath, f) for f in files
+                       if f.endswith(".py"))
+    assert {str(p) for p in _model_sources()} == scanned
+
+
+# ---------------------------------------------------------------------------
+# grounding: pattern tables name real attributes; globals resolve
+
+
+def test_pattern_tables_name_real_stdlib_attributes():
+    import queue
+    import socket
+    import threading
+    # Popen alone: this test only checks ATTRIBUTES exist, it never
+    # spawns (the subprocess-tests-are-slow lint keys on the module name)
+    from subprocess import Popen
+
+    from paddle_tpu.testing import lockwatch
+
+    # lock/cond factories: each name is either a threading callable or a
+    # lockwatch factory — the analyzer treats both as the same class
+    for name in cc.LOCK_FACTORIES + cc.RLOCK_FACTORIES + cc.COND_FACTORIES:
+        assert (callable(getattr(threading, name, None))
+                or callable(getattr(lockwatch, name, None))), name
+    for name in cc.QUEUE_FACTORIES:
+        assert callable(getattr(queue, name)), name
+    for name in cc.EVENT_FACTORIES:
+        assert callable(getattr(threading, name)), name
+    for name in cc.THREAD_FACTORY_NAMES:
+        assert callable(getattr(threading, name)), name
+    # blocking-method tables: the methods the rule flags must exist on
+    # the real objects, else the table is matching dead names
+    for name in cc.BLOCKING_SOCKET_METHODS:
+        assert hasattr(socket.socket, name), name
+    for name in cc.BLOCKING_PROC_METHODS:
+        assert hasattr(Popen, name), name
+    # the condition / queue / thread methods the rules hardcode
+    assert hasattr(threading.Condition, "wait")
+    assert hasattr(threading.Condition, "wait_for")
+    assert hasattr(threading.Thread, "join")
+    for m in ("get", "put"):
+        assert hasattr(queue.Queue, m)
+
+
+def test_analyzer_globals_all_resolve():
+    # dis agreement (convention of test_shape_rules_resolve_all_globals):
+    # every LOAD_GLOBAL in the pass and its nested code objects resolves
+    # in module globals or builtins — a scan can never NameError
+    def walk(code):
+        yield code
+        for const in code.co_consts:
+            if hasattr(const, "co_code"):
+                yield from walk(const)
+
+    bad = []
+    for name, obj in vars(cc).items():
+        fns = []
+        if inspect.isfunction(obj) and obj.__module__ == cc.__name__:
+            fns.append(obj)
+        elif inspect.isclass(obj) and obj.__module__ == cc.__name__:
+            fns.extend(f for f in vars(obj).values()
+                       if inspect.isfunction(f))
+        for fn in fns:
+            for code in walk(fn.__code__):
+                for ins in dis.get_instructions(code):
+                    if (ins.opname == "LOAD_GLOBAL"
+                            and ins.argval not in fn.__globals__
+                            and not hasattr(builtins, ins.argval)):
+                        bad.append((name, fn.__qualname__, ins.argval))
+    assert not bad, f"analyzer functions with unresolvable globals: {bad}"
+
+
+def test_thread_name_prefixes_parse_matches_live_registry():
+    # the analyzer reads the frozen literal without importing; the two
+    # views must agree or the static and runtime PT055 twins diverge
+    from paddle_tpu.observability.metrics import THREAD_NAME_PREFIXES
+    assert cc.thread_name_prefixes() == tuple(
+        p for p, _help in THREAD_NAME_PREFIXES)
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics: the ratchet's three-way split
+
+
+def _finding(code, path, line=10):
+    return cc.Finding(code=code, path=path, line=line,
+                      symbol="x", message="seeded")
+
+
+def test_apply_baseline_three_way_split():
+    findings = [
+        _finding("PT050", "paddle_tpu/a.py", 1),   # new (not budgeted)
+        _finding("PT052", "paddle_tpu/b.py", 2),   # suppressed (1 of 1)
+        _finding("PT052", "paddle_tpu/b.py", 9),   # new (beyond budget)
+    ]
+    baseline = {
+        ("paddle_tpu/b.py", "PT052"): (1, "legacy wire path"),
+        ("paddle_tpu/gone.py", "PT051"): (1, "stale: code was fixed"),
+    }
+    new, suppressed, stale = cc.apply_baseline(findings, baseline)
+    assert [(f.path, f.code, f.line) for f in new] == [
+        ("paddle_tpu/a.py", "PT050", 1),
+        ("paddle_tpu/b.py", "PT052", 9)]
+    assert suppressed == {("paddle_tpu/b.py", "PT052"): 1}
+    assert stale == [("paddle_tpu/gone.py", "PT051")]
+    # and the rendered report names all three buckets
+    report = cc.render_report(findings, baseline)
+    assert "2 new" in report
+    assert "baselined PT052 x1" in report
+    assert "STALE baseline entry" in report
+
+
+def test_apply_baseline_empty_is_clean():
+    new, suppressed, stale = cc.apply_baseline([], {})
+    assert (new, suppressed, stale) == ([], {}, [])
+
+
+def test_shipped_baseline_is_well_formed_and_justified():
+    # shrink-only ledger: every entry names a real in-tree file, a PT05x
+    # code, a positive budget and a non-empty justification
+    root = os.path.dirname(cc.package_root())
+    for (path, code), (count, why) in cc.BASELINE.items():
+        assert code in CODES and code.startswith("PT05"), (path, code)
+        assert os.path.isfile(os.path.join(root, path)), path
+        assert count >= 1
+        assert isinstance(why, str) and len(why.strip()) >= 10, (path, code)
+
+
+def test_fixture_docstrings_name_their_code():
+    # each seeded fixture documents WHICH defect it plants, so a reader
+    # landing in the corpus needs no cross-reference
+    for fixture, code in SEEDED:
+        mod = ast.parse((FIXTURES / fixture).read_text())
+        doc = ast.get_docstring(mod) or ""
+        assert code in doc, f"{fixture} docstring must name {code}"

@@ -1,0 +1,297 @@
+"""Lockwatch runtime half of the PT05x concurrency pass
+(paddle_tpu/testing/lockwatch.py).
+
+Contract under test, both directions of the PR 5 opt-in convention:
+
+  * OFF (the default): the factories return the PLAIN threading
+    primitives — type identity, not a wrapper with a fast path — and a
+    steady-state executor step loop performs zero lockwatch work
+    (concurrency/* metric deltas all zero) and zero retraces.
+  * ON: every acquisition through a watched primitive feeds a
+    process-wide acquisition-order graph; an inversion raises a typed
+    ``LockOrderViolation`` BEFORE blocking — naming both lock classes
+    and carrying both hold stacks — so a latent deadlock becomes a
+    deterministic report.  The @slow chaos round proves the conversion
+    on a REAL two-thread two-lock inversion in a subprocess.
+"""
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import compile_cache
+from paddle_tpu.core.compile_cache import retrace_guard
+from paddle_tpu.observability import metrics as obs
+from paddle_tpu.testing import lockwatch as lw
+from paddle_tpu.testing.lockwatch import LockOrderViolation
+
+
+@pytest.fixture
+def watch():
+    """Lockwatch ON for one test; graph/violations isolated + restored."""
+    prior = lw.ENABLED
+    lw.ENABLED = True
+    lw.reset()
+    yield lw
+    lw.ENABLED = prior
+    lw.reset()
+
+
+def _concurrency_snapshot():
+    snap = obs.registry().snapshot()
+    return {k: v for k, v in snap.items() if k.startswith("concurrency/")}
+
+
+def _counter(name):
+    return obs.registry().snapshot()[name]["value"]
+
+
+# ---------------------------------------------------------------------------
+# OFF: zero overhead, zero instrumentation
+# ---------------------------------------------------------------------------
+def test_off_factories_return_plain_primitives():
+    assert not lw.ENABLED    # suite must run with the watch off
+    assert type(lw.make_lock("t")) is type(threading.Lock())
+    assert type(lw.make_rlock("t")) is type(threading.RLock())
+    assert type(lw.make_condition("t")) is threading.Condition
+    # and a caller-supplied raw lock passes straight through
+    raw = threading.Lock()
+    cond = lw.make_condition("t", raw)
+    assert type(cond) is threading.Condition
+
+
+def test_off_zero_per_step_work(rng):
+    """Steady-state executor loop: no concurrency metric moves, no
+    retrace — the watch costs nothing unless somebody opts in."""
+    pt.default_main_program().random_seed = 0
+    x = layers.data("x", shape=[4], dtype="float32")
+    pred = layers.fc(x, size=3, act="softmax")
+    loss = layers.mean(pred)
+    feed = {"x": rng.rand(8, 4).astype("float32")}
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program(), feed={}, fetch_list=[])
+    exe.run(feed=feed, fetch_list=[loss])          # warm the cache
+
+    # stats are process-global; earlier suites' legitimate retraces
+    # (program-mutation tests) must not trip THIS guard
+    compile_cache.stats().reset()
+    before = _concurrency_snapshot()
+    with retrace_guard():
+        for _ in range(5):
+            exe.run(feed=feed, fetch_list=[loss])
+    compile_cache.stats().assert_no_retrace()
+    assert _concurrency_snapshot() == before, (
+        "lockwatch is off but concurrency/* metrics moved during a "
+        "steady-state step loop")
+    assert lw.graph() == {} and lw.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# ON: graph recording + deterministic inversion report
+# ---------------------------------------------------------------------------
+def test_on_records_acquisition_order_edges(watch):
+    a, b = lw.make_lock("fx.a"), lw.make_lock("fx.b")
+    with a:
+        with b:
+            pass
+    assert lw.graph() == {"fx.a": ("fx.b",)}
+    # repeating the same order adds nothing
+    with a:
+        with b:
+            pass
+    assert lw.graph() == {"fx.a": ("fx.b",)}
+
+
+def test_on_inversion_raises_before_blocking(watch):
+    a, b = lw.make_lock("fx.a"), lw.make_lock("fx.b")
+    with a:
+        with b:
+            pass
+    violations_before = _counter("concurrency/order_violations")
+    with b:
+        with pytest.raises(LockOrderViolation) as ei:
+            a.acquire()      # b -> a inverts the recorded a -> b
+    v = ei.value
+    assert v.acquiring == "fx.a" and v.holding == "fx.b"
+    report = v.report()
+    # the report stands alone: both lock classes, the cycle path, and
+    # BOTH stacks (current acquire + first-seen reverse edge)
+    assert "fx.a" in report and "fx.b" in report
+    assert "fx.a" in " -> ".join(v.path) and "fx.b" in " -> ".join(v.path)
+    assert v.current_stack.strip() and v.reverse_stack.strip()
+    assert [x.path for x in lw.violations()] == [v.path]
+    assert _counter("concurrency/order_violations") == violations_before + 1
+
+
+def test_on_inversion_is_deterministic(watch):
+    # no timing, no second thread: the cycle check runs at the acquire
+    # call, so the SAME program raises at the SAME site every run
+    for _ in range(3):
+        lw.reset()
+        a, b = lw.make_lock("fx.a"), lw.make_lock("fx.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(LockOrderViolation):
+                with a:
+                    pass
+
+
+def test_on_rlock_reentry_is_not_a_violation(watch):
+    r = lw.make_rlock("fx.r")
+    with r:
+        with r:                       # re-entry: no self-edge, no raise
+            pass
+    assert lw.graph() == {}
+    assert lw.violations() == []
+
+
+def test_on_nonreentrant_self_deadlock_raises(watch):
+    m = lw.make_lock("fx.m")
+    m.acquire()
+    try:
+        with pytest.raises(LockOrderViolation):
+            m.acquire()               # would self-deadlock; report instead
+    finally:
+        m.release()
+
+
+def test_on_condition_roundtrip(watch):
+    """Producer/consumer through a watched Condition: wait releases the
+    lock (producer can get in), wakeup re-acquires, no violations."""
+    lock = lw.make_lock("fx.box")
+    cond = lw.make_condition("fx.box", lock)
+    state = {"item": None}
+
+    def produce():
+        with cond:
+            state["item"] = 42
+            cond.notify()
+
+    t = threading.Thread(target=produce, name="pt-fx-producer",
+                         daemon=True)
+    with cond:
+        t.start()
+        ok = cond.wait_for(lambda: state["item"] is not None, timeout=5.0)
+        assert ok and state["item"] == 42
+        assert lock.locked()          # wait re-acquired before returning
+    t.join(timeout=5.0)
+    assert lw.violations() == []
+
+
+def test_on_hold_metrics(watch, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_LOCKWATCH_HOLD_MS", "1")
+    held_before = obs.registry().snapshot()["concurrency/lock_held_ms"]
+    long_before = _counter("concurrency/long_holds")
+    m = lw.make_lock("fx.slowpoke")
+    with m:
+        time.sleep(0.01)              # >> the 1 ms threshold above
+    held_after = obs.registry().snapshot()["concurrency/lock_held_ms"]
+    assert held_after["count"] == held_before["count"] + 1
+    assert held_after["max"] >= 1.0   # milliseconds
+    assert _counter("concurrency/long_holds") == long_before + 1
+
+
+def test_stats_summary_lockwatch_section(watch, tmp_path):
+    """A watched run's metrics surface in the stats CLI summary; a run
+    with the watch off omits the section entirely."""
+    from paddle_tpu.observability import export
+
+    a, b = lw.make_lock("fx.a"), lw.make_lock("fx.b")
+    with a:
+        with b:
+            pass
+    snap = export.metrics_snapshot()
+    p = tmp_path / "run.jsonl"
+    p.write_text(json.dumps({"ts": 1.0, "kind": "snapshot", **snap})
+                 + "\n")
+    summary = export.summarize_log(str(p))
+    lk = summary["lockwatch"]
+    # the metrics registry is process-global, so earlier tests in this
+    # module contribute — assert at-least, not exactly
+    assert lk["holds"] >= 2 and lk["order_edges"] >= 1
+    rendered = export.render_summary(summary)
+    assert "lockwatch:" in rendered
+    assert "watched hold(s)" in rendered and "order edge(s)" in rendered
+
+    # off-run log: no concurrency holds recorded -> section omitted
+    empty = dict(snap)
+    empty["metrics"] = {k: v for k, v in snap["metrics"].items()
+                        if not k.startswith("concurrency/")}
+    p2 = tmp_path / "off.jsonl"
+    p2.write_text(json.dumps({"ts": 1.0, "kind": "snapshot", **empty})
+                  + "\n")
+    s2 = export.summarize_log(str(p2))
+    assert "lockwatch" not in s2
+    assert "lockwatch:" not in export.render_summary(s2)
+
+
+# ---------------------------------------------------------------------------
+# @slow chaos round: a REAL inversion in a subprocess becomes a report
+# ---------------------------------------------------------------------------
+_DEADLOCK_CHILD = r"""
+import os, sys, threading
+os.environ["PADDLE_TPU_LOCKWATCH"] = "1"
+from paddle_tpu.testing import lockwatch as lw
+
+a, b = lw.make_lock("chaos.a"), lw.make_lock("chaos.b")
+g1, g2 = threading.Event(), threading.Event()
+reports = []
+
+def t1():                        # a -> b
+    with a:
+        g1.set()
+        g2.wait(10)              # guarantee both threads hold one lock
+        try:
+            with b:
+                pass
+        except lw.LockOrderViolation as v:
+            reports.append(v.report())
+
+def t2():                        # b -> a: the inversion
+    with b:
+        g2.set()
+        g1.wait(10)
+        try:
+            with a:
+                pass
+        except lw.LockOrderViolation as v:
+            reports.append(v.report())
+
+ts = [threading.Thread(target=t1, name="pt-fx-t1", daemon=True),
+      threading.Thread(target=t2, name="pt-fx-t2", daemon=True)]
+for t in ts: t.start()
+for t in ts: t.join(timeout=20)
+assert not any(t.is_alive() for t in ts), "HUNG: lockwatch failed to break the deadlock"
+assert len(reports) == 1, f"expected exactly one violation, got {len(reports)}"
+assert "chaos.a" in reports[0] and "chaos.b" in reports[0]
+assert "lock-order violation" in reports[0] or "LockOrderViolation" in reports[0] or "chaos" in reports[0]
+print("REPORT-OK")
+print(reports[0])
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(120)
+def test_deadlock_chaos_round_becomes_typed_report():
+    """Two threads, two locks, opposite orders, both first-acquisitions
+    synchronized — the classic AB/BA deadlock.  Without the watch this
+    child HANGS; with it, exactly one thread gets a LockOrderViolation
+    before blocking (the cycle check runs pre-acquire), both threads
+    exit, and the report names both lock classes.  The subprocess call
+    carries a hard timeout so a regression fails instead of wedging the
+    suite."""
+    out = subprocess.run(
+        [sys.executable, "-c", _DEADLOCK_CHILD],
+        capture_output=True, text=True, timeout=90)
+    assert out.returncode == 0, (
+        f"chaos child failed\nstdout:\n{out.stdout}\nstderr:\n{out.stderr}")
+    assert "REPORT-OK" in out.stdout
+    assert "chaos.a" in out.stdout and "chaos.b" in out.stdout

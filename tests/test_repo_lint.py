@@ -1059,3 +1059,150 @@ def test_shard_fn_registry_matches_ast_scan():
     assert not stale, (
         f"shard fns for unregistered ops (dead rules): {sorted(stale)}")
     assert live, "no shard fns registered — the planner has no rules"
+
+# ---------------------------------------------------------------------------
+# Thread-name-prefix gate (observability.metrics.THREAD_NAME_PREFIXES)
+# ---------------------------------------------------------------------------
+def _thread_prefix_table():
+    """(prefix, help) rows parsed from the THREAD_NAME_PREFIXES literal —
+    no import, same contract as the metric-name gate."""
+    path = os.path.join(ROOT, "observability", "metrics.py")
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "THREAD_NAME_PREFIXES"
+                for t in node.targets):
+            return list(ast.literal_eval(node.value))
+    raise AssertionError(
+        "THREAD_NAME_PREFIXES literal not found in metrics.py")
+
+
+def test_thread_prefix_table_well_formed():
+    rows = _thread_prefix_table()
+    assert rows, "THREAD_NAME_PREFIXES is empty — PT055 has no registry"
+    prefixes = [p for p, _help in rows]
+    dupes = {p for p in prefixes if prefixes.count(p) > 1}
+    assert not dupes, f"duplicate thread prefixes: {sorted(dupes)}"
+    for p, help_ in rows:
+        assert p.startswith("pt-"), (
+            f"thread prefix {p!r} must claim the framework's pt- "
+            f"namespace")
+        assert len(p) > len("pt-"), f"thread prefix {p!r} is bare"
+        assert help_.strip(), f"thread prefix {p!r} has no help text"
+    # no prefix may shadow another (pt-a and pt-a-b would make the
+    # runtime attribution of a pt-a-b-* thread ambiguous)
+    for a in prefixes:
+        for b in prefixes:
+            assert a == b or not b.startswith(a + "-"), (
+                f"thread prefix {b!r} is shadowed by {a!r}")
+
+
+def test_thread_prefix_gate_matches_live_registry():
+    from paddle_tpu.observability.metrics import THREAD_NAME_PREFIXES
+    assert list(THREAD_NAME_PREFIXES) == _thread_prefix_table()
+
+
+# ---------------------------------------------------------------------------
+# Concurrency verifier gate (analysis.concurrency, PT05x):
+# the current tree must be clean modulo the FROZEN baseline, and the
+# baseline can only shrink (the except-swallow ratchet convention).
+# ---------------------------------------------------------------------------
+def test_concurrency_baseline_well_formed():
+    from paddle_tpu.analysis.concurrency import BASELINE
+    for (rel, code), (count, why) in BASELINE.items():
+        assert rel.startswith("paddle_tpu/"), (rel, code)
+        assert code.startswith("PT05"), (
+            f"baseline key {code!r} is not a PT05x concurrency code")
+        assert count >= 1, (
+            f"baseline entry {(rel, code)} permits {count} findings — "
+            f"zero-count entries are dead weight; delete them")
+        assert why.strip(), (
+            f"baseline entry {(rel, code)} has no justification — every "
+            f"accepted finding carries a one-line why")
+
+
+def test_concurrency_tree_clean_vs_baseline():
+    """Tier-1 ratchet: the PT05x pass over today's tree yields NO findings
+    beyond the frozen baseline, and no baseline entry budgets MORE
+    findings than remain (fix-or-justify, count-can-only-shrink)."""
+    from paddle_tpu.analysis import concurrency as cc
+
+    findings = cc.analyze_package()
+    new, _suppressed, stale = cc.apply_baseline(findings)
+    assert not new, (
+        "new concurrency findings (fix them or — only for accepted-by-"
+        "design sites — add a justified BASELINE entry):\n"
+        + "\n".join(f.render() for f in new))
+    assert not stale, (
+        f"stale BASELINE entries budget more findings than remain — "
+        f"ratchet them down so the count can only shrink: {stale}")
+
+
+def test_concurrency_pass_covers_threaded_modules():
+    """The analyzer's scan set is the same walk as every other lint —
+    pin that the threaded modules it exists for are actually inside it,
+    and that the pass sees their locks (a lock-model regression that
+    finds NO locks would pass the ratchet vacuously)."""
+    from paddle_tpu.analysis import concurrency as cc
+
+    rels = {rel for rel, _ in _iter_sources()}
+    for mod in ("paddle_tpu/serving/server.py",
+                "paddle_tpu/serving/decode.py",
+                "paddle_tpu/serving/fleet.py",
+                "paddle_tpu/sparse/session.py",
+                "paddle_tpu/distributed/master.py",
+                "paddle_tpu/distributed/checkpoint.py",
+                "paddle_tpu/reader/pipeline.py",
+                "paddle_tpu/observability/export.py"):
+        assert mod in rels, f"{mod} missing from the lint scan set"
+    # the model sees the watched-factory idiom as locks: server.py's
+    # runtime condition + state locks must resolve, else PT050-053
+    # silently cover nothing
+    path = os.path.join(ROOT, "serving", "server.py")
+    with open(path) as fh:
+        src = fh.read()
+    import paddle_tpu.analysis.concurrency as ccmod
+    tree = ast.parse(src)
+    mm = ccmod._ModuleModel(tree, "paddle_tpu/serving/server.py")
+    kinds = set(mm.attr_kind_index.values())
+    assert {"lock", "cond"} <= kinds, (
+        f"concurrency model no longer resolves server.py's locks/"
+        f"conditions (saw kinds {sorted(kinds)}) — the PT05x rules "
+        f"would run vacuously")
+
+
+def test_lockwatch_factories_adopted_in_threaded_modules():
+    """The serving/sparse/distributed lock creation sites route through
+    testing.lockwatch factories (make_lock/make_rlock/make_condition),
+    so enabling PADDLE_TPU_LOCKWATCH actually watches them; raw
+    threading.Lock() in these modules would silently escape the
+    watchdog.  Infrastructure locks are exempt BY DESIGN: the metrics
+    registry's own lock (lockwatch writes metrics — recursion), the
+    compile cache and profiler (leaf locks on paths the watchdog
+    traverses), and lockwatch itself."""
+    exempt = {
+        "paddle_tpu/observability/metrics.py",
+        "paddle_tpu/core/compile_cache.py",
+        "paddle_tpu/profiler.py",
+        "paddle_tpu/testing/lockwatch.py",
+        "paddle_tpu/testing/faultinject.py",
+    }
+    offenders = []
+    for rel, tree in _iter_sources():
+        if rel in exempt:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "threading" \
+                    and fn.attr in ("Lock", "RLock", "Condition"):
+                offenders.append(f"{rel}:{node.lineno}: threading."
+                                 f"{fn.attr}()")
+    assert not offenders, (
+        "raw threading primitives outside the exempt infrastructure "
+        "set — route them through testing.lockwatch factories so the "
+        "order watchdog can see them:\n" + "\n".join(offenders))
