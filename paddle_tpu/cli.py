@@ -712,8 +712,11 @@ def job_trace(argv):
           f" span(s)", flush=True)
     if len(files) > 1:
         for f in files:
-            print(f"  restart boundary: {f['file']} ({f['events']} "
-                  f"event(s), from ts={f['t_first']})", flush=True)
+            # [role:index] when the log stamped identity — a merged
+            # fleet trace names which process each file came from
+            print(f"  restart boundary: [{export.source_label(f)}] "
+                  f"{f['file']} ({f['events']} event(s), from "
+                  f"ts={f['t_first']})", flush=True)
     print("\nby span name:", flush=True)
     for name, s in stats.items():
         print(f"  {name}: count={s['count']} p50={s['p50_ms']}ms "
@@ -913,6 +916,12 @@ def main(argv=None):
         return job_stats(argv[1:])
     if argv and argv[0] == "trace":
         return job_trace(argv[1:])
+    if argv and argv[0] == "fleet-stats":
+        # lazy: the fleet collector can dial sockets and pull the sparse
+        # wire stack — only this subcommand pays for it (repo-lint
+        # enforced, like the doctor's attribution engine)
+        from paddle_tpu.observability import collector
+        return collector.fleet_stats_main(argv[1:])
     if argv and argv[0] == "doctor":
         # lazy: the attribution engine pulls analysis.cost_model — only
         # the doctor pays for it
@@ -956,7 +965,10 @@ def main(argv=None):
                     "proposes auto-sharding specs with a static cost "
                     "breakdown, `paddle_tpu stats run.jsonl...` "
                     "summarizes observability metrics logs (--prom for "
-                    "Prometheus exposition), `paddle_tpu trace "
+                    "Prometheus exposition), `paddle_tpu fleet-stats "
+                    "<logs|dir|host:port...>` merges per-process metrics "
+                    "snapshots into one labeled fleet view, `paddle_tpu "
+                    "trace "
                     "run.jsonl...` renders span timelines and critical "
                     "paths, `paddle_tpu doctor run.jsonl... [--program "
                     "prog.json] [--per-op]` explains where the "
@@ -976,8 +988,9 @@ def main(argv=None):
                     "mesh resize, and `paddle_tpu pserver --shard k/N "
                     "--dir dir` runs one sparse parameter-server shard "
                     "behind the batched binary wire protocol (see "
-                    "`paddle_tpu check|plan|stats|trace|doctor|profile|"
-                    "tune|serve|fleet|elastic|pserver --help`).")
+                    "`paddle_tpu check|plan|stats|fleet-stats|trace|"
+                    "doctor|profile|tune|serve|fleet|elastic|pserver "
+                    "--help`).")
     ap.add_argument("--config", required=True, help="v1 config file")
     ap.add_argument("--job", default="train",
                     choices=["train", "test", "time", "checkgrad"])

@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
 import socket
 import socketserver
 import threading
@@ -25,6 +26,7 @@ import time
 from typing import Callable, List, Optional
 
 from ..faults import RetryPolicy, classify
+from ..observability import tracing as _tracing
 from ..testing import faultinject as _fi
 from ..testing import lockwatch as _lw
 
@@ -233,9 +235,12 @@ class Master:
             return {"ok": True, "world": self.world, "slot": slot,
                     "shard_done": shard_done}
 
-    def heartbeat(self, slot: int) -> dict:
+    def heartbeat(self, slot: int, metrics: bool = False) -> dict:
         """Refresh ``slot``'s lease; the reply carries the coordinator's
-        pending command for this slot (the drain channel)."""
+        pending command for this slot (the drain channel).  With
+        ``metrics=True`` the reply piggybacks this process's metrics
+        snapshot + identity for the fleet collector (opt-in per call:
+        the default reply stays byte-stable)."""
         slot = int(slot)
         with self._lock:
             m = self._members.get(slot)
@@ -248,7 +253,12 @@ class Master:
             cmd = self._commands.get(slot)
         from ..observability import inc_counter
         inc_counter("elastic/heartbeats")
-        return {"ok": True, "cmd": cmd}
+        out = {"ok": True, "cmd": cmd}
+        if metrics:
+            from ..observability import metrics_snapshot
+            out["metrics"] = metrics_snapshot()
+            out["identity"] = {"role": "master", "pid": os.getpid()}
+        return out
 
     def members(self) -> dict:
         """{slot: {age_s, stale, cursor, pid}} — staleness is lease-style
@@ -465,9 +475,21 @@ class MasterServer:
                 for line in self.rfile:
                     try:
                         req = json.loads(line)
-                        resp = {"result": outer._dispatch(
-                            req.get("method"), req.get("params") or {})}
-                        payload = json.dumps(resp)
+                        # ctx rides the envelope only when the caller
+                        # observes; malformed ctx is rejected-and-counted
+                        # in extract() and the call still serves
+                        parent = _tracing.extract(req.get("ctx")) \
+                            if "ctx" in req else None
+                        if parent is not None:
+                            with _tracing.span("master/rpc", parent=parent,
+                                               method=req.get("method")):
+                                result = outer._dispatch(
+                                    req.get("method"),
+                                    req.get("params") or {})
+                        else:
+                            result = outer._dispatch(
+                                req.get("method"), req.get("params") or {})
+                        payload = json.dumps({"result": result})
                     except Exception as e:  # noqa: BLE001 — report to client
                         # includes result-serialization failures (chunks
                         # must be JSON-encodable: paths/ids, not payloads)
@@ -498,7 +520,8 @@ class MasterServer:
                 params["slot"], cursor=params.get("cursor"),
                 pid=params.get("pid"))
         if method == "heartbeat":
-            return self.master.heartbeat(params["slot"])
+            return self.master.heartbeat(
+                params["slot"], metrics=bool(params.get("metrics")))
         if method == "members":
             return self.master.members()
         if method == "deregister_worker":
@@ -552,6 +575,11 @@ class MasterClient:
         self._sock = None
         self._file = None
         self._lock = _lw.make_lock("master.client")
+        # observe resolved ONCE at construction (the PR 10 discipline):
+        # off -> no ctx key ever enters the envelope, the wire is
+        # byte-identical to the pre-tracing protocol
+        from ..observability import enabled as _obs_enabled
+        self._observe = _obs_enabled()
 
     def _connect(self, timeout=None):
         self._sock = socket.create_connection(
@@ -585,9 +613,13 @@ class MasterClient:
                                 _fi.raise_for(action, "master.call")
                         if self._file is None:
                             self._connect(_timeout)
-                        self._file.write((json.dumps(
-                            {"method": method, "params": params}) +
-                            "\n").encode())
+                        req = {"method": method, "params": params}
+                        if self._observe:
+                            ctx = _tracing.inject()
+                            if ctx is not None:
+                                req["ctx"] = ctx
+                        self._file.write((json.dumps(req) +
+                                          "\n").encode())
                         self._file.flush()
                         line = self._file.readline()
                         if not line:
@@ -638,13 +670,17 @@ class MasterClient:
         return self._call("register_worker", slot=int(slot), cursor=cursor,
                           pid=pid)
 
-    def heartbeat(self, slot: int) -> dict:
+    def heartbeat(self, slot: int, metrics: bool = False) -> dict:
         """Single-attempt, <=2 s best-effort lease refresh: a heartbeat
         that cannot reach the master is LOST, not retried — the
         coordinator reads the resulting staleness, which is the signal
-        heartbeats exist to carry."""
+        heartbeats exist to carry.  ``metrics=True`` asks the master to
+        piggyback its metrics snapshot on the reply (fleet collector)."""
+        params = {"slot": int(slot)}
+        if metrics:
+            params["metrics"] = True
         return self._call("heartbeat", _retries=1, _timeout=2.0,
-                          _sock_deadline=2.0, slot=int(slot))
+                          _sock_deadline=2.0, **params)
 
     def members(self) -> dict:
         m = self._call("members")

@@ -37,8 +37,10 @@ from .metrics import (METRIC_NAMES, MetricsRegistry, enabled, inc_counter,
                       observe_hist, registry, set_gauge)
 from .export import (emit_event, iter_log_events, log_path,
                      maybe_periodic_report, metrics_snapshot,
-                     periodic_report, sample_device_memory, summarize_log,
-                     summarize_logs, to_prometheus)
+                     periodic_report, process_identity,
+                     sample_device_memory, set_process_identity,
+                     source_label, summarize_log, summarize_logs,
+                     to_prometheus)
 from . import tracing
 from .tracing import SPAN_NAMES
 
@@ -48,6 +50,7 @@ __all__ = [
     "emit_event", "log_path", "metrics_snapshot", "sample_device_memory",
     "periodic_report", "maybe_periodic_report", "summarize_log",
     "summarize_logs", "iter_log_events", "to_prometheus",
+    "set_process_identity", "process_identity", "source_label",
     "tracing", "SPAN_NAMES",
     "report",
 ]

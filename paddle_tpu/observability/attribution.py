@@ -38,9 +38,9 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = [
     "BUDGET_TOLERANCE", "step_budget", "serving_budget", "decode_budget",
-    "executable_facts", "calibration_row", "save_calibration",
-    "save_op_class_calibration", "load_op_class_ratios",
-    "doctor_report", "render_doctor",
+    "remote_budget", "executable_facts", "calibration_row",
+    "save_calibration", "save_op_class_calibration",
+    "load_op_class_ratios", "doctor_report", "render_doctor",
 ]
 
 # Budget components must reconcile with the measured wall within this
@@ -174,13 +174,14 @@ _HINTS = {
 }
 
 
-def _hints(report: dict):
+def _hints(report: dict, table: Optional[Dict[str, str]] = None):
     shares = report["shares"]
+    table = table if table is not None else _HINTS
     top = max(shares, key=lambda k: shares[k])
     hints = []
     for k, share in sorted(shares.items(), key=lambda kv: -kv[1]):
         if share >= 0.15 or k == top:
-            hints.append(_HINTS[k].format(pct=round(share * 100)))
+            hints.append(table[k].format(pct=round(share * 100)))
     return top, hints
 
 
@@ -308,6 +309,87 @@ def decode_budget(events) -> Optional[dict]:
             "dominates — lower step_wait_ms or batch admissions".format(
                 p=round(100 - dispatch_share * 100))
         ]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# remote sparse budget (pserver wire path)
+# ---------------------------------------------------------------------------
+_REMOTE_HINTS = {
+    "client_wire_ms": "client-wire {pct}%: serialization + network + "
+                      "pipelining dominate the remote sparse rounds — "
+                      "batch more ids per round (dedup, bigger batches), "
+                      "keep wire_mode='binary', and overlap rounds with "
+                      "compute (SparseSession prefetch)",
+    "server_queue_ms": "server-queue {pct}%: requests wait in a shard's "
+                       "single-threaded serve loop before dispatch — the "
+                       "shard is saturated: add pserver shards "
+                       "(re-shard the id space) or split hot tables "
+                       "across fleets",
+    "server_kernel_ms": "server-kernel {pct}%: the shard's pull/push "
+                        "kernels dominate — shrink the embedding dim, "
+                        "use a cheaper optimizer slot layout, or spread "
+                        "rows over more shards so each kernel touches "
+                        "fewer",
+}
+
+
+def remote_budget(events) -> Optional[dict]:
+    """Remote sparse pull/push budget over the client-side
+    ``pserver/rpc`` spans: splits the measured client wall into
+    **client-wire** (serialize + network + pipelined wait), **server-
+    queue** (time a frame sat in a shard's serve loop before dispatch)
+    and **server-kernel** (the shard's pull/push kernel), using the
+    server timings each reply piggybacks (``srv_queue_ms`` /
+    ``srv_kernel_ms`` labels — the slowest shard of the pipelined
+    round, which is what the client actually waited on).  Components
+    sum to the measured wall by construction (wire is the residual);
+    None when the log carries no client rpc spans.
+
+    Works from the TRAINER's log alone — the piggyback travels in the
+    reply, so no shard log is needed for the split."""
+    rpcs = [e for e in events if e.get("kind") == "span"
+            and e.get("name") == "pserver/rpc"]
+    client = [e for e in rpcs
+              if (e.get("labels") or {}).get("side") != "server"]
+    if not client:
+        return None
+    wall_ms = sum(float(e.get("dur_ms") or 0.0) for e in client)
+    queue_ms = kernel_ms = 0.0
+    attributed = 0
+    by_op: Dict[str, int] = {}
+    for e in client:
+        labels = e.get("labels") or {}
+        op = str(labels.get("op", "?"))
+        by_op[op] = by_op.get(op, 0) + 1
+        q, k = labels.get("srv_queue_ms"), labels.get("srv_kernel_ms")
+        if q is None and k is None:
+            continue
+        attributed += 1
+        queue_ms += float(q or 0.0)
+        kernel_ms += float(k or 0.0)
+    budget = {
+        "client_wire_ms": round(max(0.0, wall_ms - queue_ms - kernel_ms),
+                                3),
+        "server_queue_ms": round(queue_ms, 3),
+        "server_kernel_ms": round(kernel_ms, 3),
+    }
+    total = sum(budget.values())
+    out = {
+        "measured_wall_ms": round(wall_ms, 3),
+        "budget": budget,
+        "budget_sum_ms": round(total, 3),
+        "budget_gap_frac": round(abs(total - wall_ms) / wall_ms, 4)
+        if wall_ms else 0.0,
+        "within_tolerance": bool(
+            wall_ms and abs(total - wall_ms) <= BUDGET_TOLERANCE * wall_ms),
+        "shares": {k: round(v / wall_ms, 4) if wall_ms else 0.0
+                   for k, v in budget.items()},
+        "rounds": len(client),
+        "attributed_rounds": attributed,
+        "by_op": by_op,
+    }
+    out["top"], out["hints"] = _hints(out, table=_REMOTE_HINTS)
     return out
 
 
@@ -475,6 +557,9 @@ def doctor_report(paths, program=None, assume_batch: int = 64,
     db = decode_budget(events)
     if db is not None:
         out["decode"] = db
+    rb = remote_budget(events)
+    if rb is not None:
+        out["remote"] = rb
     stats = tracing.span_stats(events)
     if stats:
         out["span_stats"] = stats
@@ -484,7 +569,8 @@ def doctor_report(paths, program=None, assume_batch: int = 64,
             program, tb["step_ms_warm_mean"], mesh_axes=mesh_axes,
             assume_batch=assume_batch)
     tops = [s.get("top") for s in (out.get("training"),
-                                   out.get("serving")) if s]
+                                   out.get("serving"),
+                                   out.get("remote")) if s]
     if tops:
         out["top_bottleneck"] = tops[0]
     return out
@@ -492,7 +578,14 @@ def doctor_report(paths, program=None, assume_batch: int = 64,
 
 def render_doctor(report: dict) -> str:
     """Human-readable doctor rendering."""
+    from .export import source_label
     lines: List[str] = []
+    files = report.get("files") or []
+    if len(files) > 1:
+        # a merged fleet log: name which process each file came from
+        for f in files:
+            lines.append(f"source [{source_label(f)}]: {f['file']} "
+                         f"({f['events']} event(s))")
     tb = report.get("training")
     if tb:
         lines.append(
@@ -537,6 +630,21 @@ def render_doctor(report: dict) -> str:
                          f"{db['dispatch_ms_mean']} ms")
         for h in db.get("hints", []):
             lines.append(f"  hint: {h}")
+    rb = report.get("remote")
+    if rb:
+        lines.append(
+            f"remote sparse: {rb['rounds']} rpc round(s) "
+            f"({rb['attributed_rounds']} with server timings), measured "
+            f"wall {rb['measured_wall_ms']} ms (budget sum "
+            f"{rb['budget_sum_ms']} ms, gap "
+            f"{round(rb['budget_gap_frac'] * 100, 2)}%"
+            + ("" if rb["within_tolerance"] else " — OVER TOLERANCE")
+            + ")")
+        for k, v in sorted(rb["budget"].items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {k[:-3]:>16}: {v:12.3f} ms  "
+                         f"({round(rb['shares'][k] * 100, 1)}%)")
+        for h in rb["hints"]:
+            lines.append(f"  hint: {h}")
     cal = report.get("calibration")
     if cal:
         lines.append(
@@ -544,7 +652,8 @@ def render_doctor(report: dict) -> str:
             f"{cal['predicted_ms']} ms vs measured {cal['measured_ms']} "
             f"ms -> ratio {cal['ratio']} (static-model correction "
             f"factor; stored per program digest)")
-    if not lines:
+    if not any(report.get(k) for k in
+               ("training", "serving", "decode", "remote", "calibration")):
         lines.append("doctor: no step events or request spans in this "
                      "log — run with observe on and a metrics_log set")
     elif report.get("top_bottleneck"):

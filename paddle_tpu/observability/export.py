@@ -32,8 +32,44 @@ __all__ = [
     "log_path", "emit_event", "metrics_snapshot", "sample_device_memory",
     "periodic_report", "maybe_periodic_report", "summarize_log",
     "summarize_logs", "iter_log_events", "to_prometheus", "prom_name",
-    "metric_name_from_prom",
+    "metric_name_from_prom", "set_process_identity", "process_identity",
+    "source_label",
 ]
+
+# Who this process is, stamped into every (re)opened JSONL log as the
+# first event the writer appends — multi-file merges then label sources
+# "pserver:1" instead of a bare argument index.  Process mains
+# (pserver/serve/fleet/master CLIs) set this before their first emit.
+_identity = {"role": None, "index": None}
+
+
+def set_process_identity(role: Optional[str],
+                         index: Optional[int] = None):
+    """Declare this process's role (``trainer``/``pserver``/``serve``/
+    ``fleet``/...) and optional shard/replica index for JSONL identity
+    stamping.  ``None`` resets to the default (``main``)."""
+    _identity["role"] = None if role is None else str(role)
+    _identity["index"] = None if index is None else int(index)
+
+
+def process_identity() -> dict:
+    """This process's stamped identity — ``{"role", "pid"[, "index"]}``
+    (role defaults to ``main``); what wire-metrics piggybacks attach so
+    the fleet collector labels each snapshot's source."""
+    out = {"role": _identity["role"] or "main", "pid": os.getpid()}
+    if _identity["index"] is not None:
+        out["index"] = _identity["index"]
+    return out
+
+
+def source_label(f: dict) -> str:
+    """Human label for one merged-log source: ``role`` or ``role:index``
+    when the log stamped identity, else the bare argument position."""
+    role = f.get("role")
+    if role:
+        idx = f.get("proc_index")
+        return f"{role}:{idx}" if idx is not None else str(role)
+    return str(f.get("index", "?"))
 
 
 def log_path() -> str:
@@ -67,10 +103,27 @@ class _Writer:
                 self._fh, self._path = None, path
                 try:
                     self._fh = open(path, "a")
+                    # identity header: first line this process appends
+                    # to a (re)opened log — role/pid/index label every
+                    # event that follows in multi-file merges
+                    ident = {"ts": round(time.time(), 6),
+                             "kind": "identity",
+                             "role": _identity["role"] or "main",
+                             "pid": os.getpid()}
+                    if _identity["index"] is not None:
+                        ident["index"] = _identity["index"]
+                    self._fh.write(json.dumps(ident) + "\n")
+                    self._fh.flush()
                 except OSError as e:
                     logger.warning("metrics log %r unwritable (%s); "
                                    "disabling until the path changes",
                                    path, e)
+                    if self._fh is not None:
+                        try:
+                            self._fh.close()
+                        except OSError:
+                            pass
+                    self._fh = None
             if self._fh is None:       # disabled: an earlier open/write
                 return                 # on this path failed
             try:
@@ -219,6 +272,7 @@ def iter_log_events(paths) -> "tuple[List[dict], List[dict]]":
     for src, path in enumerate(paths):
         n = corrupt = 0
         t_first = t_last = None
+        role = pid = proc_index = None
         with open(path, errors="replace") as fh:
             for line in fh:
                 line = line.strip()
@@ -232,6 +286,13 @@ def iter_log_events(paths) -> "tuple[List[dict], List[dict]]":
                 except json.JSONDecodeError:
                     corrupt += 1
                     continue
+                if ev.get("kind") == "identity" and role is None:
+                    # the writer's open-time stamp: the FIRST one names
+                    # the process this file belongs to (appends from a
+                    # relaunch re-stamp, but the role stays the same)
+                    role = ev.get("role")
+                    pid = ev.get("pid")
+                    proc_index = ev.get("index")
                 ts = ev.get("ts")
                 if isinstance(ts, (int, float)) \
                         and not isinstance(ts, bool):
@@ -257,7 +318,9 @@ def iter_log_events(paths) -> "tuple[List[dict], List[dict]]":
                            str(path), corrupt)
         files.append({"file": str(path), "index": src, "events": n,
                       "corrupt_lines": corrupt,
-                      "t_first": t_first, "t_last": t_last})
+                      "t_first": t_first, "t_last": t_last,
+                      "role": role, "pid": pid,
+                      "proc_index": proc_index})
     if len(files) > 1:
         files.sort(key=lambda f: (f["t_first"] is None,
                                   f["t_first"] or 0.0))
@@ -329,10 +392,12 @@ def summarize_logs(paths) -> dict:
     if len(files) > 1:
         # restart boundaries: where each relaunch's log begins; "source"
         # is the index fault-timeline rows carry (the original argument
-        # position, stable across the time-order sort)
+        # position, stable across the time-order sort); "role" labels
+        # it by process identity when the log stamped one
         summary["restarts"] = [
             {"file": f["file"], "source": f["index"], "ts": f["t_first"],
-             "events": f["events"]}
+             "events": f["events"],
+             **({"role": source_label(f)} if f.get("role") else {})}
             for f in files]
     if steps:
         n_steps = sum(int(e.get("steps", 1)) for e in steps)
@@ -389,6 +454,8 @@ def summarize_logs(paths) -> dict:
             key = str(e.get("event", "unknown"))
             by_event[key] = by_event.get(key, 0) + 1
         multi = len(files) > 1
+        roles = {f["index"]: source_label(f) for f in files
+                 if f.get("role")}
 
         def _fault_row(e):
             row = {k: e.get(k) for k in
@@ -398,8 +465,11 @@ def summarize_logs(paths) -> dict:
             if multi:
                 # a merged timeline interleaves relaunch logs by ts
                 # only; the source-file index makes each row
-                # attributable to the right attempt
+                # attributable to the right attempt (plus the role
+                # label when that file stamped identity)
                 row["source"] = e.get("_src")
+                if e.get("_src") in roles:
+                    row["role"] = roles[e["_src"]]
             return row
 
         summary["faults"] = {
@@ -557,7 +627,8 @@ def render_summary(summary: dict) -> str:
              + (f" wall_s={summary['wall_s']}"
                 if summary.get("wall_s") is not None else "")]
     for r in summary.get("restarts", []):
-        lines.append(f"  restart boundary: [{r.get('source', '?')}] "
+        tag = r.get("role") or r.get("source", "?")
+        lines.append(f"  restart boundary: [{tag}] "
                      f"{r['file']} "
                      f"({r['events']} event(s), from ts={r['ts']})")
     st = summary.get("steps")
@@ -589,9 +660,9 @@ def render_summary(summary: dict) -> str:
         lines.append(f"faults: {fl['events']} event(s): {kinds}")
         for e in fl["timeline"]:
             lines.append("  fault: " + " ".join(
-                f"{k}={e[k]}" for k in ("source", "event", "site",
-                                        "index", "action", "step",
-                                        "attempt", "delay_s",
+                f"{k}={e[k]}" for k in ("role", "source", "event",
+                                        "site", "index", "action",
+                                        "step", "attempt", "delay_s",
                                         "error") if k in e))
     sv = summary.get("serving")
     if sv:

@@ -296,6 +296,21 @@ METRIC_NAMES = (
      "writer wall time of one durable commit, serialize to fsync'd "
      "meta (full and delta alike; the trainer only pays this when a "
      "hard barrier drains the queue)"),
+    # distributed tracing wire rim (observability.tracing inject/extract):
+    # context_rejected is an anomaly counter like fault/* — a malformed
+    # context only exists when a peer SENT one, so counting it is never
+    # on a zero-overhead-off path
+    ("trace/context_rejected", "counter",
+     "malformed/truncated/unknown-version trace contexts rejected at a "
+     "wire rim (ignored-and-counted: the request still serves)"),
+    # fleet metrics collector (observability.collector, lazy-import
+    # gated like attribution/opprof): only written inside collector
+    # merges — a fleet-stats run IS the workload
+    ("collector/merges", "counter",
+     "fleet snapshot merges executed by the metrics collector"),
+    ("collector/sources", "gauge",
+     "per-process sources folded into the most recent fleet snapshot "
+     "(labels: source kind — log/pserver/master/replica)"),
     # lock-order watchdog (testing.lockwatch): writes only happen when
     # PADDLE_TPU_LOCKWATCH is on — the factories return PLAIN threading
     # primitives when off, so production paths never reach these helpers

@@ -52,6 +52,8 @@ from . import export as _export
 __all__ = [
     "SPAN_NAMES", "Span", "span", "start_span", "current_span",
     "add_event", "attach", "ROOT",
+    "CTX_VERSION", "RemoteParent", "inject", "extract",
+    "extract_traceparent",
     "build_traces", "span_stats", "critical_path", "render_trace",
 ]
 
@@ -107,7 +109,13 @@ SPAN_NAMES = (
     ("pserver/rpc", "one client round against the pserver fleet: "
      "partition ids by shard -> write every shard's batched frame -> "
      "read every reply (pipelined, so N-shard latency is max not sum); "
-     "retry attempts attach as span events; labels: op, table, shards"),
+     "retry attempts attach as span events; labels: op, table, shards — "
+     "and, parented onto the remote caller via the wire ctx field, one "
+     "server-side frame (labels: side=server, op, shard, queue_ms, "
+     "kernel_ms)"),
+    ("master/rpc", "server-side handling of one master RPC, parented "
+     "onto the remote caller via the envelope ctx field (only emitted "
+     "when the caller propagated a context); labels: method"),
 )
 
 _REGISTERED = tuple(n for n, _ in SPAN_NAMES)
@@ -268,6 +276,92 @@ def add_event(name: str, **fields):
     sp = current_span()
     if sp is not None:
         sp.event(name, **fields)
+
+
+# ---------------------------------------------------------------------------
+# Cross-process context propagation (the Dapper-style wire rim)
+# ---------------------------------------------------------------------------
+# Compact versioned encoding "1:<trace>:<span>".  ":" because span ids
+# already contain "-" (pid-prefix-counter); a future format bump changes
+# the leading version and old receivers reject-and-count, never crash.
+CTX_VERSION = 1
+
+
+class RemoteParent:
+    """Parent carrier extracted from a wire context: just the two ids a
+    child span needs.  Duck-types the ``parent=`` argument of
+    :func:`start_span` (which reads only ``trace_id``/``span_id``), so a
+    server-side span parents onto its remote caller exactly like a
+    cross-thread one."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self):
+        return (f"RemoteParent(trace={self.trace_id}, "
+                f"span={self.span_id})")
+
+
+def inject(sp: Optional[Span] = None) -> Optional[str]:
+    """Wire encoding of ``sp`` (default: the calling thread's current
+    span); None when there is nothing to propagate — callers add no wire
+    field in that case, keeping frames byte-identical when not observing."""
+    if sp is None:
+        sp = current_span()
+    if sp is None:
+        return None
+    return f"{CTX_VERSION}:{sp.trace_id}:{sp.span_id}"
+
+
+def _reject_ctx():
+    from . import metrics as _metrics
+    _metrics.inc_counter("trace/context_rejected")
+    return None
+
+
+def extract(ctx) -> Optional[RemoteParent]:
+    """Decode a wire context produced by :func:`inject`.  An ABSENT
+    context (None) is normal and returns None silently; a PRESENT but
+    malformed/unknown-version one is ignored-and-counted
+    (``trace/context_rejected``) — propagation failures degrade to a
+    fresh trace, never to a failed request."""
+    if ctx is None:
+        return None
+    if not isinstance(ctx, str):
+        return _reject_ctx()
+    parts = ctx.split(":")
+    if len(parts) != 3 or parts[0] != str(CTX_VERSION) \
+            or not parts[1] or not parts[2]:
+        return _reject_ctx()
+    return RemoteParent(parts[1], parts[2])
+
+
+def extract_traceparent(header) -> Optional[RemoteParent]:
+    """Decode a W3C ``traceparent`` request header
+    (``<2 hex version>-<32 hex trace-id>-<16 hex parent-id>-<2 hex
+    flags>``) into a parent carrier.  The foreign ids are adopted
+    verbatim (trace id prefixed ``t`` like locally-minted ones), so an
+    edge client's trace id groups our server-side spans with its own.
+    Same reject contract as :func:`extract`: absent -> None silently,
+    malformed/all-zero/unsupported-version -> ignored-and-counted."""
+    if header is None:
+        return None
+    if not isinstance(header, str):
+        return _reject_ctx()
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return _reject_ctx()
+    version, trace, parent = parts[0], parts[1], parts[2]
+    hexdigits = "0123456789abcdef"
+    if (len(version) != 2 or len(trace) != 32 or len(parent) != 16
+            or any(c not in hexdigits for c in version + trace + parent)
+            or version == "ff"
+            or trace == "0" * 32 or parent == "0" * 16):
+        return _reject_ctx()
+    return RemoteParent("t" + trace, parent)
 
 
 # ---------------------------------------------------------------------------

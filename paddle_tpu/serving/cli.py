@@ -43,6 +43,8 @@ import time
 from typing import Dict, Optional
 
 from .. import faults as _faults
+from ..observability import (metrics_snapshot, process_identity,
+                             set_process_identity, tracing as _tracing)
 from ..testing import lockwatch as _lw
 from .model import Model
 from .server import Server
@@ -163,7 +165,13 @@ def serve_main(argv=None) -> int:
     ap.add_argument("--token", action="append", metavar="TOKEN[=MODEL]",
                     help="HTTP auth token, optionally bound to one "
                          "model (repeatable; only with --http)")
+    ap.add_argument("--replica-index", type=int, default=None,
+                    help="this replica's index in a fleet (stamps the "
+                         "JSONL identity line so multi-file merges "
+                         "label events serve:<index>)")
     args = ap.parse_args(argv)
+
+    set_process_identity("serve", args.replica_index)
 
     srv = Server(
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -298,7 +306,13 @@ def _handle_line(srv: Server, emitter: _Emitter, cb, line: str) -> int:
             # control-plane poll (the fleet router's routing signal):
             # answered inline on the reader loop — queue depth must stay
             # fresh even when every dispatcher is saturated
-            emitter.emit({"id": msg.get("id"), "health": srv.health()})
+            reply = {"id": msg.get("id"), "health": srv.health()}
+            if msg.get("metrics"):
+                # opt-in fleet-collector piggyback: the default health
+                # reply stays byte-stable
+                reply["metrics"] = metrics_snapshot()
+                reply["identity"] = process_identity()
+            emitter.emit(reply)
             return 0
         if not isinstance(msg, dict) or "feeds" not in msg:
             raise ValueError("want {'id', 'feeds': {...}} or "
@@ -311,7 +325,9 @@ def _handle_line(srv: Server, emitter: _Emitter, cb, line: str) -> int:
     feeds: Dict[str, object] = msg["feeds"]
     try:
         pending = srv.submit(feeds, model=msg.get("model"),
-                             deadline_ms=deadline_ms, req_id=req_id)
+                             deadline_ms=deadline_ms, req_id=req_id,
+                             trace_parent=(_tracing.extract(msg["ctx"])
+                                           if "ctx" in msg else None))
     except (_faults.Overloaded, _faults.ServerClosed,
             _faults.ModelUnavailable, ConnectionError, ValueError) as e:
         emitter.emit({"id": req_id, "error": type(e).__name__,

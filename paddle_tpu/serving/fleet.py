@@ -119,14 +119,18 @@ class FleetPending:
 
     __slots__ = ("id", "model", "feeds", "deadline_ms", "outputs", "error",
                  "dispatch_ms", "t_admit", "attempts", "_event",
-                 "_callbacks", "_lock")
+                 "_callbacks", "_lock", "ctx")
 
     def __init__(self, req_id, model: Optional[str], feeds,
-                 deadline_ms):
+                 deadline_ms, ctx: Optional[str] = None):
         self.id = req_id
         self.model = model
         self.feeds = feeds
         self.deadline_ms = deadline_ms
+        # wire trace context captured at admission (None when the router
+        # is not observing): survives failover, so a request re-routed
+        # to a second replica still joins the same trace
+        self.ctx = ctx
         self.outputs = None
         self.error: Optional[BaseException] = None
         self.dispatch_ms: Optional[float] = None
@@ -184,6 +188,9 @@ class LocalReplica:
         self.routed_since_poll = 0
         self.last_health: dict = {}
         self.last_health_ts = time.monotonic()
+        self.last_metrics: Optional[dict] = None
+        self.last_metrics_ts = 0.0
+        self.last_identity: Optional[dict] = None
         self.restarts = 0
         self.cordoned = False
 
@@ -196,10 +203,15 @@ class LocalReplica:
     def state(self) -> str:
         return self.server.state
 
-    def poll_health(self):
+    def poll_health(self, metrics: bool = False):
         self.last_health = self.server.health()
         self.last_health_ts = time.monotonic()
         self.routed_since_poll = 0
+        if metrics:
+            # in-process member: its registry IS this process's registry
+            self.last_metrics = obs.metrics_snapshot()
+            self.last_metrics_ts = self.last_health_ts
+            self.last_identity = {"role": "local", "pid": os.getpid()}
 
     def queue_depth(self) -> int:
         models = (self.last_health or {}).get("models", {})
@@ -214,9 +226,11 @@ class LocalReplica:
     def submit(self, fp: FleetPending):
         """Admit ``fp``; terminal results (or typed errors raised here at
         admission) propagate through the router's completion path."""
-        pending = self.server.submit(fp.feeds, model=fp.model,
-                                     deadline_ms=fp.deadline_ms,
-                                     req_id=fp.id)
+        pending = self.server.submit(
+            fp.feeds, model=fp.model, deadline_ms=fp.deadline_ms,
+            req_id=fp.id,
+            trace_parent=obs.tracing.extract(fp.ctx)
+            if fp.ctx is not None else None)
         self.routed_since_poll += 1
 
         def relay(p):
@@ -286,6 +300,9 @@ class ProcessReplica:
         self.state = "warming"
         self.last_health: dict = {}
         self.last_health_ts = 0.0
+        self.last_metrics: Optional[dict] = None
+        self.last_metrics_ts = 0.0
+        self.last_identity: Optional[dict] = None
         self.routed_since_poll = 0
         self.restarts = 0
         self.deliberate_stop = False
@@ -406,6 +423,11 @@ class ProcessReplica:
             self.last_health = msg["health"]
             self.last_health_ts = time.monotonic()
             self.routed_since_poll = 0
+            if isinstance(msg.get("metrics"), dict):
+                # opt-in piggyback answered by serve's health handler
+                self.last_metrics = msg["metrics"]
+                self.last_metrics_ts = self.last_health_ts
+                self.last_identity = msg.get("identity")
             st = msg["health"].get("state")
             if st and self.state not in (DEAD,):
                 self.state = st
@@ -448,8 +470,11 @@ class ProcessReplica:
         outq = self._outq
         return outq.qsize() if outq is not None else 0
 
-    def poll_health(self):
-        if not self._send({"cmd": "health"}):
+    def poll_health(self, metrics: bool = False):
+        msg = {"cmd": "health"}
+        if metrics:
+            msg["metrics"] = True
+        if not self._send(msg):
             return
         # answer arrives asynchronously on the reader thread
 
@@ -471,6 +496,8 @@ class ProcessReplica:
             msg["model"] = fp.model
         if fp.deadline_ms != -1.0:      # -1 = replica default, omit
             msg["deadline_ms"] = fp.deadline_ms
+        if fp.ctx is not None:          # observing caller: propagate
+            msg["ctx"] = fp.ctx
         with self._lock:
             self._pending[wire_id] = fp
         if not self._send(msg):
@@ -667,6 +694,10 @@ class FleetRouter:
         self._last_decision_ts = 0.0
         self._idle_since: Optional[float] = None
         self._req_counter = 0
+        # observe resolved ONCE at construction (the PR 10 discipline):
+        # off -> no ctx is captured at admission and no ctx field ever
+        # reaches a replica's stdio wire
+        self._observe = obs.enabled()
 
     # -- lifecycle -----------------------------------------------------------
     @property
@@ -850,6 +881,38 @@ class FleetRouter:
         return {"state": self._state, "ready": ready,
                 "queue_depth": depth, "replicas": out_reps}
 
+    def metrics_snapshots(self, timeout_s: float = 2.0) -> Dict[str, dict]:
+        """One metrics-piggybacked health poll of every replica, gathered:
+        ``{replica_name: {"metrics": snapshot, "identity": {...}|None}}``.
+        Process replicas answer asynchronously on their reader threads,
+        so this waits (bounded) for replies newer than the ask; members
+        that don't answer in time are simply absent — the fleet
+        collector labels what it got, it never blocks on a wedged
+        replica."""
+        with self._lock:
+            reps = list(self.replicas)
+        t_ask = time.monotonic()
+        for r in reps:
+            try:
+                r.poll_health(metrics=True)
+            except Exception:
+                logger.exception("fleet: metrics poll of %s failed",
+                                 r.name)
+        deadline = t_ask + timeout_s
+        while time.monotonic() < deadline:
+            if all(getattr(r, "last_metrics_ts", 0.0) >= t_ask
+                   or not r.alive for r in reps):
+                break
+            time.sleep(0.02)
+        out = {}
+        for r in reps:
+            if getattr(r, "last_metrics", None) is not None \
+                    and getattr(r, "last_metrics_ts", 0.0) >= t_ask:
+                out[r.name] = {"metrics": r.last_metrics,
+                               "identity": getattr(r, "last_identity",
+                                                   None)}
+        return out
+
     # -- submission ----------------------------------------------------------
     def submit(self, feeds, model: Optional[str] = None,
                deadline_ms: Optional[float] = -1.0,
@@ -865,7 +928,9 @@ class FleetRouter:
             with self._lock:
                 self._req_counter += 1
                 req_id = self._req_counter
-        fp = FleetPending(req_id, model, feeds, deadline_ms)
+        fp = FleetPending(
+            req_id, model, feeds, deadline_ms,
+            ctx=obs.tracing.inject() if self._observe else None)
         obs.inc_counter("fleet/requests")
         self._route(fp, exclude=())
         return fp
@@ -1155,12 +1220,16 @@ def fleet_main(argv=None) -> int:
     ap.add_argument("--cooldown-s", type=float, default=10.0)
     args = ap.parse_args(argv)
 
+    obs.set_process_identity("fleet")
     argv_tpl = serve_argv(args.model, max_batch=args.max_batch,
                           max_wait_ms=args.max_wait_ms,
                           deadline_ms=args.deadline_ms, queue=args.queue)
 
     def factory(i):
-        return ProcessReplica(argv_tpl, name=f"replica{i}")
+        # --replica-index stamps the child's JSONL identity line, so a
+        # multi-file trace/stats merge labels its events "serve:i"
+        return ProcessReplica(argv_tpl + ["--replica-index", str(i)],
+                              name=f"replica{i}")
 
     policy = None
     if args.autoscale:

@@ -141,7 +141,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- GET -----------------------------------------------------------------
     def do_GET(self):
-        sp = obs.tracing.start_span("http/request", parent=obs.tracing.ROOT,
+        # W3C traceparent: a valid header parents this request's span
+        # onto the edge caller's trace; absent/garbage degrades to a
+        # fresh per-request trace (garbage is counted, never fatal)
+        parent = obs.tracing.extract_traceparent(
+            self.headers.get("traceparent")) or obs.tracing.ROOT
+        sp = obs.tracing.start_span("http/request", parent=parent,
                                     method="GET", path=self.path)
         t0 = time.monotonic()
         try:
@@ -181,7 +186,9 @@ class _Handler(BaseHTTPRequestHandler):
     # -- POST /v1/infer ------------------------------------------------------
     def do_POST(self):
         obs.inc_counter("http/requests")
-        sp = obs.tracing.start_span("http/request", parent=obs.tracing.ROOT,
+        parent = obs.tracing.extract_traceparent(
+            self.headers.get("traceparent")) or obs.tracing.ROOT
+        sp = obs.tracing.start_span("http/request", parent=parent,
                                     method="POST", path=self.path)
         t0 = time.monotonic()
         try:

@@ -479,7 +479,7 @@ class Server:
 
     def submit(self, feeds: Dict[str, object], model: Optional[str] = None,
                deadline_ms: Optional[float] = -1.0,
-               req_id=None) -> PendingResponse:
+               req_id=None, trace_parent=None) -> PendingResponse:
         """Admit one single-example request (feeds carry NO batch axis).
 
         Returns a :class:`PendingResponse` once admitted.  Admission
@@ -488,7 +488,10 @@ class Server:
         ``Overloaded`` (queue full and this request had the soonest
         deadline).  ``deadline_ms``: per-request override; the default
         sentinel (-1) means the server default, ``None`` means no
-        deadline.
+        deadline.  ``trace_parent``: a remote caller's extracted trace
+        context (``tracing.RemoteParent``) — the request span parents
+        onto it instead of starting a fresh trace, joining this
+        replica's work to the submitting process's trace.
         """
         rt = self._resolve_model(model)
         if _fi.ENABLED:
@@ -504,7 +507,8 @@ class Server:
                 self._req_counter += 1
                 req_id = self._req_counter
         # one trace per request (ROOT forces it even if the submitting
-        # thread is inside some other traced region), started BEFORE the
+        # thread is inside some other traced region — unless a remote
+        # caller propagated its own context), started BEFORE the
         # admission checks so every typed rejection — ServerClosed,
         # breaker-open ModelUnavailable, feed-validation errors,
         # Overloaded shedding — reaches the log with its status; those
@@ -512,7 +516,9 @@ class Server:
         # The span ends at the terminal completion, or here on a
         # rejection raise.
         sp = obs.tracing.start_span(
-            "serving/request", parent=obs.tracing.ROOT,
+            "serving/request",
+            parent=trace_parent if trace_parent is not None
+            else obs.tracing.ROOT,
             model=rt.model.name, id=req_id)
         try:
             if self._state != READY:

@@ -42,6 +42,7 @@ import os
 import socket
 import threading
 import uuid
+from contextlib import nullcontext as _nullcontext
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -49,6 +50,7 @@ import numpy as np
 from .. import faults as _faults
 from ..testing import lockwatch as _lw
 from .. import observability as obs
+from ..observability import tracing as _tracing
 from ..observability.tracing import span
 from . import wire
 from .table import PAD_ID, _OPTIMIZER_SLOTS, _STATE_PREFIX, _STATE_VERSION
@@ -230,6 +232,16 @@ class RemoteSparseTable:
         self.close()
         return False
 
+    # -- spans --------------------------------------------------------------
+    def _span(self, op: str, **labels):
+        """Client span around one fleet round, gated HERE per the PR 5
+        caller-gating discipline: an observe-off client constructs no
+        Span objects and emits nothing even when a metrics_log sink is
+        set (the same client whose rounds carry no ctx field)."""
+        if not self._observe:
+            return _nullcontext()
+        return span("pserver/rpc", op=op, table=self.name, **labels)
+
     # -- the round ----------------------------------------------------------
     def _round(self, per_shard: Dict[int, Tuple[Dict, tuple]], *,
                what: str) -> Dict[int, Tuple[Dict, List[np.ndarray]]]:
@@ -237,11 +249,19 @@ class RemoteSparseTable:
         then read every reply (N-shard latency = max, not sum), inside
         the retry rim.  Returns {shard: (reply_header, arrays)}."""
         shards = sorted(per_shard)
+        # Trace context rides the JSON header ONLY when this client is
+        # observing: off -> no ctx key -> the frame is byte-identical
+        # to the pre-tracing wire (pinned by test), and the server —
+        # which keys its span + reply piggyback on ctx presence — adds
+        # nothing either.  Ctx presence IS the propagated observe bit.
+        ctx = _tracing.inject() if self._observe else None
 
         def attempt():
             try:
                 for k in shards:
                     header, arrays = per_shard[k]
+                    if ctx is not None:
+                        header = dict(header, ctx=ctx)
                     if self.wire_mode == "naive":
                         wire.write_frame_json(self._conn(k), header,
                                               arrays)
@@ -285,7 +305,33 @@ class RemoteSparseTable:
                 attempt, self.retry, what=f"pserver {what} {self.name}",
                 on_retry=on_retry)
         self._absorb_stats(replies)
+        if ctx is not None:
+            self._absorb_srv(replies)
         return replies
+
+    def _absorb_srv(self, replies: Dict[int, Tuple[Dict, list]]):
+        """Reply-piggybacked server-side timings -> labels on the
+        enclosing ``pserver/rpc`` client span.  The round is pipelined
+        (waits on the slowest shard), so the shard with the largest
+        queue+kernel total is the one that bounded the wall — its
+        timings label the span; ``doctor`` subtracts them from span
+        wall to get the client-wire residual."""
+        best = None
+        for reply, _ in replies.values():
+            srv = reply.get("srv")
+            if isinstance(srv, dict):
+                tot = (float(srv.get("queue_ms", 0.0))
+                       + float(srv.get("kernel_ms", 0.0)))
+                if best is None or tot > best[0]:
+                    best = (tot, srv)
+        if best is None:
+            return
+        sp = _tracing.current_span()
+        if sp is not None and sp.name == "pserver/rpc":
+            sp.labels["srv_queue_ms"] = round(
+                float(best[1].get("queue_ms", 0.0)), 3)
+            sp.labels["srv_kernel_ms"] = round(
+                float(best[1].get("kernel_ms", 0.0)), 3)
 
     def _absorb_stats(self, replies: Dict[int, Tuple[Dict, list]]):
         for k, (reply, _) in replies.items():
@@ -339,8 +385,7 @@ class RemoteSparseTable:
         per_shard = {k: ({"op": "pull", "table": self.name}, (sids,))
                      for k, _sel, sids in parts}
         sels = {k: sel for k, sel, _ in parts}
-        with span("pserver/rpc", op="pull", table=self.name,
-                  shards=len(per_shard)):
+        with self._span("pull", shards=len(per_shard)):
             replies = self._round(per_shard, what="pull")
         for k, (_reply, arrays) in replies.items():
             out[live_sel[sels[k]]] = arrays[0].astype(self.dtype,
@@ -350,8 +395,7 @@ class RemoteSparseTable:
     def _naive_pull(self, out, live_sel, live):
         """The control arm: one JSON frame per ROW (the per-row RPC
         cost shape the batched path is benchmarked against)."""
-        with span("pserver/rpc", op="pull", table=self.name,
-                  shards=self.n_shards, mode="naive"):
+        with self._span("pull", shards=self.n_shards, mode="naive"):
             for j, i in zip(live_sel.tolist(), live.tolist()):
                 k = i % self.n_shards
                 replies = self._round(
@@ -374,8 +418,7 @@ class RemoteSparseTable:
                 (sids,))
             for k, _sel, sids in parts}
         sels = {k: sel for k, sel, _ in parts}
-        with span("pserver/rpc", op="pull_slot", table=self.name,
-                  shards=len(per_shard)):
+        with self._span("pull_slot", shards=len(per_shard)):
             replies = self._round(per_shard, what="pull_slot")
         for k, (_reply, arrays) in replies.items():
             out[live_sel[sels[k]]] = arrays[0].astype(self.dtype,
@@ -410,16 +453,14 @@ class RemoteSparseTable:
                      "seq": seq, "lr": learning_rate},
                     (sids, grads[sel]))
                 for k, sel, sids in self._partition(live)}
-            with span("pserver/rpc", op="push", table=self.name,
-                      shards=len(per_shard)):
+            with self._span("push", shards=len(per_shard)):
                 replies = self._round(per_shard, what="push")
         return sum(reply.get("updated", 0)
                    for reply, _ in replies.values())
 
     def _naive_push(self, live, grads, learning_rate) -> int:
         updated = 0
-        with span("pserver/rpc", op="push", table=self.name,
-                  shards=self.n_shards, mode="naive"):
+        with self._span("push", shards=self.n_shards, mode="naive"):
             for j, i in enumerate(live.tolist()):
                 k = i % self.n_shards
                 # same single lock hold over seq + round as push()
@@ -461,8 +502,7 @@ class RemoteSparseTable:
                 dtype=np.uint8).copy()}
         per_shard = {k: ({"op": "export", "table": self.name}, ())
                      for k in range(self.n_shards)}
-        with span("pserver/rpc", op="export", table=self.name,
-                  shards=self.n_shards):
+        with self._span("export", shards=self.n_shards):
             replies = self._round(per_shard, what="export")
         for k in range(self.n_shards):
             reply, arrays = replies[k]
@@ -530,8 +570,7 @@ class RemoteSparseTable:
                 slots[s][sel] for s in self.slot_names)
             per_shard[k] = ({"op": "restore", "table": self.name,
                              "slots": list(self.slot_names)}, arrays)
-        with span("pserver/rpc", op="restore", table=self.name,
-                  shards=self.n_shards):
+        with self._span("restore", shards=self.n_shards):
             self._round(per_shard, what="restore")
 
     # -- fleet ops ----------------------------------------------------------
@@ -539,8 +578,7 @@ class RemoteSparseTable:
         """Ask every shard to commit a durable checkpoint now."""
         per_shard = {k: ({"op": "checkpoint"}, ())
                      for k in range(self.n_shards)}
-        with span("pserver/rpc", op="checkpoint", table=self.name,
-                  shards=self.n_shards):
+        with self._span("checkpoint", shards=self.n_shards):
             replies = self._round(per_shard, what="checkpoint")
         return [replies[k][0].get("saved") for k in range(self.n_shards)]
 

@@ -56,8 +56,10 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..faults import EXIT_PREEMPTED, TransientError, classify
-from ..observability import (emit_event, inc_counter, observe_hist,
-                             set_gauge)
+from ..observability import (emit_event, inc_counter, metrics_snapshot,
+                             observe_hist, set_gauge,
+                             set_process_identity)
+from ..observability import tracing as _tracing
 from ..testing import faultinject
 from . import wire
 from .table import SparseTable, _STATE_PREFIX
@@ -155,10 +157,17 @@ class PServer:
         self._sel: Optional[selectors.DefaultSelector] = None
         self._stop = False
         self._sigterm = False
+        self._final_snapshot = False
         # client pushes read while awaiting our own backup ack (see
         # _await_backup_ack): finished at the top of serve_forever so
         # forwards never nest
         self._deferred: "collections.deque" = collections.deque()
+        # kernel time of the last dispatched op (pull/push table work),
+        # exported into the reply's srv piggyback when the request
+        # carried a trace context.  Single-threaded event loop: one
+        # request is in _dispatch at a time, so a plain attribute is
+        # race-free.
+        self._last_kernel_ms = 0.0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
@@ -211,6 +220,12 @@ class PServer:
         sys.exit(EXIT_PREEMPTED)
 
     def _close_all(self):
+        # final metrics snapshot so a dead shard's JSONL log still feeds
+        # fleet-stats post-mortem (emit_event no-ops without a sink);
+        # both exit paths funnel through here, the flag keeps it to one
+        if not self._final_snapshot:
+            self._final_snapshot = True
+            emit_event("snapshot", **metrics_snapshot())
         if self._sel is not None:
             for key in list(self._sel.get_map().values()):
                 try:
@@ -262,6 +277,10 @@ class PServer:
             self._drop_conn(conn)
             return
         header, arrays = got
+        # receipt stamp: queue wait = dispatch start - this instant.
+        # Survives _deferred parking, so a push parked during a backup-
+        # ack wait reports the wait it actually suffered.
+        header["_t_recv"] = time.perf_counter()
         self.requests += 1
         self._totals["wire_bytes_in"] += header.get("_wire_nbytes", 0)
         inc_counter("pserver/requests")
@@ -290,15 +309,39 @@ class PServer:
 
     def _finish_request(self, conn, header, arrays):
         t0 = time.perf_counter()
+        # ctx presence IS the propagated observe signal: no ctx -> no
+        # server span, no srv piggyback, reply byte-identical to the
+        # pre-tracing wire.  A malformed ctx is rejected-and-counted
+        # inside extract() and degrades to the no-ctx path — the
+        # request still serves.
+        parent = _tracing.extract(header.get("ctx")) \
+            if "ctx" in header else None
+        sp = None
+        queue_ms = 0.0
+        if parent is not None:
+            queue_ms = (t0 - header.get("_t_recv", t0)) * 1e3
+            sp = _tracing.start_span(
+                "pserver/rpc", parent=parent, side="server",
+                op=header.get("op"), shard=self.shard)
+        self._last_kernel_ms = 0.0
         try:
             reply, reply_arrays = self._dispatch(header, arrays)
         except Exception as e:           # typed reply, never a dead air
+            if sp is not None:
+                sp.end(queue_ms=round(queue_ms, 3),
+                       kernel_ms=round(self._last_kernel_ms, 3),
+                       error=type(e).__name__)
             self._reply_error(conn, header, e,
                               retryable=classify(e) == "retryable")
             return
         dt_ms = (time.perf_counter() - t0) * 1e3
         observe_hist("pserver/frame_ms", dt_ms)
         reply["ok"] = True
+        if sp is not None:
+            srv = {"queue_ms": round(queue_ms, 3),
+                   "kernel_ms": round(self._last_kernel_ms, 3)}
+            reply["srv"] = srv
+            sp.end(**srv)
         self._reply(conn, header, reply, reply_arrays)
 
     def _reply(self, conn, req_header, reply, arrays):
@@ -370,6 +413,7 @@ class PServer:
         t0 = time.perf_counter()
         rows = t.pull(np.asarray(ids, np.int64))
         dt = time.perf_counter() - t0
+        self._last_kernel_ms = dt * 1e3
         self._totals["pulls"] += 1
         self._totals["pull_rows"] += len(rows)
         inc_counter("pserver/pull_rows", len(rows))
@@ -407,6 +451,7 @@ class PServer:
         t0 = time.perf_counter()
         updated = t.push(ids, grads, learning_rate=lr)
         dt = time.perf_counter() - t0
+        self._last_kernel_ms = dt * 1e3
         if cid is not None and seq is not None:
             self._applied_seq[key] = int(seq)
         self.pushes_applied += 1
@@ -598,12 +643,19 @@ class PServer:
                 "stats": self._stats_of(t)}, ()
 
     def _op_stats(self, header, arrays):
-        return {"tables": {n: {**self._stats_of(t),
-                               "host_bytes": t.host_bytes()}
-                           for n, t in self._tables.items()},
-                "requests": self.requests,
-                "pushes_applied": self.pushes_applied,
-                "totals": dict(self._totals)}, ()
+        out = {"tables": {n: {**self._stats_of(t),
+                              "host_bytes": t.host_bytes()}
+                          for n, t in self._tables.items()},
+               "requests": self.requests,
+               "pushes_applied": self.pushes_applied,
+               "totals": dict(self._totals)}
+        if header.get("metrics"):
+            # opt-in fleet-metrics piggyback for the collector: the
+            # default stats reply stays byte-stable
+            out["metrics"] = metrics_snapshot()
+            out["identity"] = {"role": "pserver", "index": self.shard,
+                               "pid": os.getpid()}
+        return out, ()
 
     def _op_checkpoint(self, header, arrays):
         path = self.checkpoint()
@@ -858,6 +910,7 @@ def pserver_main(argv: Optional[List[str]] = None) -> int:
                          "the ack")
     args = ap.parse_args(argv)
     shard, n_shards = _parse_shard(args.shard)
+    set_process_identity("pserver", shard)
     srv = PServer(shard, n_shards, host=args.host, port=args.port,
                   dir=args.dir,
                   backup_addr=_parse_addr(args.backup)

@@ -1,0 +1,469 @@
+"""Fleet-wide distributed tracing + metrics aggregation (ISSUE 20).
+
+In-process loopback, real sockets (the test_pserver.py discipline —
+shard servers on daemon threads in THIS interpreter).  What these pin:
+
+* **one trace across processes**: a training step against a 2-shard
+  pserver fleet produces — after merging the trainer's and the shards'
+  JSONL logs — a single trace per step in which every shard's
+  server-side ``pserver/rpc`` span parents under the trainer's client
+  span, which parents under ``sparse/pull``/``sparse/push``;
+* **remote attribution**: the doctor splits remote sparse wall into
+  client-wire / server-queue / server-kernel from the reply-piggybacked
+  server timings, summing to the measured wall within tolerance;
+* **malformed context is ignored-and-counted, never fatal** — fuzzed at
+  every rim (W3C traceparent, sparse wire header, master RPC envelope):
+  the request still serves, ``trace/context_rejected`` increments;
+* **zero overhead when off**: with ``observe`` off no wire frame grows
+  a byte (no ``ctx`` in any request header, no ``srv`` in any reply),
+  no span events are written even with a metrics_log sink set, and the
+  reject counter stays at zero;
+* **fleet metrics**: ``merge_snapshots`` semantics (counters sum,
+  gauges stay per-source, skewed histograms are skipped-and-named) and
+  the ``fleet-stats`` CLI end to end over logs + live shard endpoints
+  + a master heartbeat piggyback.
+"""
+import json
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import flags, layers
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import attribution
+from paddle_tpu.observability import export as obs_export
+from paddle_tpu.observability import tracing
+from paddle_tpu.sparse import SparseSession
+from paddle_tpu.sparse import wire
+from paddle_tpu.sparse.client import RemoteSparseTable
+from paddle_tpu.sparse.pserver import PServer
+
+HOST = "127.0.0.1"
+IO_TO = 10.0
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    obs.registry().reset()
+    prev = {n: flags.get_flag(n) for n in ("observe", "metrics_log")}
+    yield
+    for n, v in prev.items():
+        flags.set_flag(n, v if v is not None else "")
+    obs_export._reset_writer()
+    obs_export.set_process_identity(None)
+    obs.registry().reset()
+
+
+@pytest.fixture
+def fleet2():
+    """A 2-shard in-thread fleet (test_pserver.py pattern)."""
+    servers, threads = [], []
+    for k in range(2):
+        s = PServer(k, 2, host=HOST, io_timeout_s=IO_TO)
+        s.start()
+        servers.append(s)
+    for s in servers:
+        t = threading.Thread(target=s.serve_forever, daemon=True)
+        t.start()
+        threads.append(t)
+    try:
+        yield servers
+    finally:
+        for s in servers:
+            s.stop()
+        for t in threads:
+            t.join(timeout=5.0)
+
+
+def _addrs(servers):
+    return [(HOST, s.port) for s in servers]
+
+
+def _sparse_program(vocab=32, dim=4, name="tbl"):
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="float32")
+    emb = layers.embedding(ids, size=[vocab, dim], sparse=True, name=name)
+    fc = layers.fc(emb, size=1)
+    loss = layers.mean(layers.square(fc - label))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _rejected():
+    m = obs.registry().snapshot().get("trace/context_rejected") or {}
+    return m.get("value", 0.0)
+
+
+def _spans(events):
+    return [e for e in events if e.get("kind") == "span"]
+
+
+def _write_split_log(path, role, index, events):
+    """Re-write a slice of span events as the JSONL log that process
+    WOULD have produced: identity header first, then the events."""
+    ident = {"ts": min(e.get("ts", 0.0) for e in events) - 1e-6,
+             "kind": "identity", "role": role, "pid": 1000 + (index or 0)}
+    if index is not None:
+        ident["index"] = index
+    with open(path, "w") as fh:
+        fh.write(json.dumps(ident) + "\n")
+        for e in events:
+            fh.write(json.dumps(
+                {k: v for k, v in e.items()
+                 if not k.startswith("_")}) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# the e2e acceptance: one trace across trainer + 2 shards, doctor split
+# ---------------------------------------------------------------------------
+def test_e2e_fleet_trace_single_trace_and_doctor_split(
+        fleet2, tmp_path, capsys):
+    flags.set_flag("observe", True)
+    log = tmp_path / "all.jsonl"
+    flags.set_flag("metrics_log", str(log))
+    _sparse_program(vocab=32, dim=4)
+    kw = dict(vocab_size=32, dim=4, learning_rate=1.0, seed=13)
+    with RemoteSparseTable("tbl", addrs=_addrs(fleet2), io_timeout_s=IO_TO,
+                           **kw) as rt:
+        sess = SparseSession(rt)
+        sess.bind(pt.default_main_program())
+        # ids land on BOTH shards (id % 2): one step = one trace
+        ids = np.array([[5], [9], [6], [30]], np.int64)
+        feed = {"ids": ids, "label": np.zeros((4, 1), np.float32)}
+        for _step in range(2):
+            with tracing.span("executor/step", path="fleet-e2e"):
+                fr = sess.prepare_feed(dict(feed))
+                sess.complete([np.ones_like(fr["tbl@ROWS"])])
+
+    events, _files = obs_export.iter_log_events([str(log)])
+    spans = _spans(events)
+    server = [e for e in spans
+              if (e.get("labels") or {}).get("side") == "server"]
+    trainer = [e for e in spans if e not in server]
+    assert server, "no server-side pserver/rpc spans were emitted"
+
+    # split by emitting process + merge back — the multi-file path the
+    # real fleet (one JSONL per process) exercises
+    tlog = tmp_path / "trainer.jsonl"
+    slogs = []
+    _write_split_log(tlog, "trainer", None, trainer)
+    for k in range(2):
+        mine = [e for e in server if e["labels"].get("shard") == k]
+        assert mine, f"shard {k} emitted no server spans"
+        p = tmp_path / f"pserver{k}.jsonl"
+        _write_split_log(p, "pserver", k, mine)
+        slogs.append(p)
+    merged, files = obs_export.iter_log_events(
+        [str(tlog)] + [str(p) for p in slogs])
+    mspans = _spans(merged)
+    assert len(mspans) == len(spans)
+    assert [f["role"] for f in sorted(files, key=lambda f: f["index"])] \
+        == ["trainer", "pserver", "pserver"]
+
+    # ONE trace per step; every parent exists, trace ids agree, no cycles
+    by_trace = {}
+    for e in mspans:
+        by_trace.setdefault(e["trace"], []).append(e)
+    assert len(by_trace) == 2
+    for tid, tspans in by_trace.items():
+        by_id = {e["span"]: e for e in tspans}
+        for e in tspans:
+            p = e.get("parent")
+            if p is None:
+                assert e["name"] == "executor/step"
+                continue
+            assert p in by_id, \
+                f"span {e['span']} ({e['name']}) has unknown parent {p}"
+            assert by_id[p]["trace"] == tid
+            seen, cur = set(), e
+            while cur.get("parent"):
+                assert cur["span"] not in seen
+                seen.add(cur["span"])
+                cur = by_id[cur["parent"]]
+        # each shard's server span parents under the trainer's client
+        # pserver/rpc span, which parents under sparse/pull or push
+        srv = [e for e in tspans
+               if (e.get("labels") or {}).get("side") == "server"]
+        assert {e["labels"]["shard"]
+                for e in srv if e["labels"].get("op") == "pull"} == {0, 1}
+        for e in srv:
+            parent = by_id[e["parent"]]
+            assert parent["name"] == "pserver/rpc"
+            assert (parent.get("labels") or {}).get("side") != "server"
+            assert by_id[parent["parent"]]["name"] in ("sparse/pull",
+                                                       "sparse/push")
+            assert e["labels"].get("queue_ms") is not None
+            assert e["labels"].get("kernel_ms") is not None
+        pulls = [e for e in tspans if e["name"] == "sparse/pull"]
+        assert pulls and all(by_id[e["parent"]]["name"] == "executor/step"
+                             for e in pulls)
+
+    # the doctor's remote split: components sum to the measured client
+    # wall within the pinned tolerance, every round attributed
+    rb = attribution.remote_budget(merged)
+    assert rb is not None
+    assert rb["rounds"] == 4 and rb["attributed_rounds"] == 4
+    assert rb["by_op"] == {"pull": 2, "push": 2}
+    assert set(rb["budget"]) == {"client_wire_ms", "server_queue_ms",
+                                 "server_kernel_ms"}
+    assert rb["within_tolerance"] and rb["budget_gap_frac"] <= 0.15
+    assert abs(rb["budget_sum_ms"] - rb["measured_wall_ms"]) \
+        <= 0.15 * rb["measured_wall_ms"]
+    assert attribution.doctor_report(
+        [str(tlog)] + [str(p) for p in slogs])["remote"] == rb
+
+    # CLI form over the merged files: sources labeled by role, the
+    # remote budget rendered
+    from paddle_tpu.cli import main as cli_main
+    assert cli_main(["doctor", str(tlog)] + [str(p) for p in slogs]) == 0
+    out = capsys.readouterr().out
+    assert "source [trainer]" in out
+    assert "source [pserver:0]" in out and "source [pserver:1]" in out
+    assert "remote sparse:" in out and "client_wire" in out
+
+
+# ---------------------------------------------------------------------------
+# malformed context: ignored-and-counted at every rim, never fatal
+# ---------------------------------------------------------------------------
+def test_traceparent_rim_fuzz_rejected_and_counted():
+    base = _rejected()
+    assert tracing.extract_traceparent(None) is None    # absent: silent
+    assert _rejected() == base
+    bad = ["nonsense", "00-abc-def-01",
+           "zz-" + "a" * 32 + "-" + "b" * 16 + "-01",   # non-hex version
+           "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",   # forbidden version
+           "00-" + "0" * 32 + "-" + "b" * 16 + "-01",   # all-zero trace
+           "00-" + "a" * 32 + "-" + "0" * 16 + "-01",   # all-zero parent
+           "00-" + "a" * 31 + "-" + "b" * 16 + "-01",   # short trace
+           123]                                          # not a string
+    for tp in bad:
+        assert tracing.extract_traceparent(tp) is None
+    assert _rejected() == base + len(bad)
+    good = tracing.extract_traceparent(
+        "00-" + "a" * 32 + "-" + "b" * 16 + "-01")
+    assert good.trace_id == "t" + "a" * 32 and good.span_id == "b" * 16
+    assert _rejected() == base + len(bad)
+
+
+def test_wire_ctx_rim_fuzz_rejected_and_counted():
+    base = _rejected()
+    assert tracing.extract(None) is None                # absent: silent
+    bad = [123, ["1", "t", "s"], "2:tabc-1:abc-2",      # unknown version
+           "1:only-two", "1::abc-2", "1:tabc-1:", "::", ""]
+    for ctx in bad:
+        assert tracing.extract(ctx) is None
+    assert _rejected() == base + len(bad)
+    rp = tracing.extract("1:tdead-1:beef-2")
+    assert rp.trace_id == "tdead-1" and rp.span_id == "beef-2"
+
+
+def test_wire_header_malformed_ctx_still_serves(fleet2):
+    base = _rejected()
+    for garbage in ({"v": 1}, "not-a-ctx"):
+        with socket.create_connection((HOST, fleet2[0].port),
+                                      timeout=IO_TO) as s:
+            s.settimeout(IO_TO)
+            wire.write_frame(s, {"op": "hello"})
+            wire.read_frame(s)
+            wire.write_frame(s, {"op": "stats", "ctx": garbage})
+            reply, _ = wire.read_frame(s)
+        assert reply["ok"] is True          # the request still served
+        assert "srv" not in reply           # and attributed nothing
+    assert _rejected() == base + 2
+    # a WELL-formed ctx on the same op gets the server piggyback
+    with socket.create_connection((HOST, fleet2[0].port),
+                                  timeout=IO_TO) as s:
+        s.settimeout(IO_TO)
+        wire.write_frame(s, {"op": "hello"})
+        wire.read_frame(s)
+        wire.write_frame(s, {"op": "stats", "ctx": "1:tdead-1:beef-2"})
+        reply, _ = wire.read_frame(s)
+    assert reply["ok"] is True
+    assert set(reply["srv"]) == {"queue_ms", "kernel_ms"}
+    assert _rejected() == base + 2
+
+
+def test_master_envelope_malformed_ctx_still_serves(tmp_path):
+    from paddle_tpu.distributed.master import Master, MasterServer
+    srv = MasterServer(Master()).start()
+    try:
+        base = _rejected()
+        with socket.create_connection((srv.host, srv.port),
+                                      timeout=IO_TO) as s:
+            f = s.makefile("rw")
+            f.write(json.dumps({"method": "ping",
+                                "ctx": {"bogus": 1}}) + "\n")
+            f.flush()
+            assert json.loads(f.readline()) == {"result": "pong"}
+            assert _rejected() == base + 1
+            # well-formed ctx -> a master/rpc span parented on the
+            # remote caller lands in the JSONL log
+            log = tmp_path / "master.jsonl"
+            flags.set_flag("metrics_log", str(log))
+            f.write(json.dumps({"method": "ping",
+                                "ctx": "1:tdead-1:beef-2"}) + "\n")
+            f.flush()
+            assert json.loads(f.readline()) == {"result": "pong"}
+        spans = _spans(obs_export.iter_log_events([str(log)])[0])
+        assert [e["name"] for e in spans] == ["master/rpc"]
+        assert spans[0]["trace"] == "tdead-1"
+        assert spans[0]["parent"] == "beef-2"
+        assert spans[0]["labels"]["method"] == "ping"
+        assert _rejected() == base + 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# zero overhead when off: no wire frame grows a byte
+# ---------------------------------------------------------------------------
+def test_observe_off_adds_zero_wire_bytes_and_zero_events(
+        fleet2, tmp_path, monkeypatch):
+    captured = []
+    real = wire.write_frame
+
+    def spy(sock, header, arrays=()):
+        captured.append(dict(header))
+        return real(sock, header, arrays)
+
+    # both the client and the in-thread servers resolve wire.write_frame
+    # at call time, so every request AND reply header is captured
+    monkeypatch.setattr(wire, "write_frame", spy)
+
+    flags.set_flag("observe", False)
+    log = tmp_path / "off.jsonl"
+    flags.set_flag("metrics_log", str(log))
+    base = _rejected()
+    kw = dict(vocab_size=32, dim=4, seed=3)
+    ids = np.arange(8, dtype=np.int64)
+    g = np.ones((8, 4), np.float32)
+    with RemoteSparseTable("t", addrs=_addrs(fleet2), io_timeout_s=IO_TO,
+                           **kw) as rt:
+        rt.pull(ids)
+        rt.push(ids, g)
+    off = list(captured)
+    off_req = [h for h in off if "op" in h]
+    off_pull = [h for h in off_req if h.get("op") == "pull"]
+    off_rep = [h for h in off if "ok" in h]
+    assert off_pull and off_rep
+    assert all("ctx" not in h for h in off_req)     # no request grew
+    assert all("srv" not in h for h in off_rep)     # no reply grew
+    assert not log.exists() or log.read_text() == ""  # zero span events
+    assert _rejected() == base
+
+    # the SAME rounds with observe on differ by exactly the ctx field
+    captured.clear()
+    flags.set_flag("observe", True)
+    with RemoteSparseTable("t", addrs=_addrs(fleet2), io_timeout_s=IO_TO,
+                           **kw) as rt:
+        rt.pull(ids)
+        rt.push(ids, g)
+    on_pull = [h for h in captured if h.get("op") == "pull"]
+    assert len(on_pull) == len(off_pull)
+    for on_h, off_h in zip(on_pull, off_pull):
+        assert "ctx" in on_h
+        assert {k: v for k, v in on_h.items() if k != "ctx"} == off_h
+    on_rep = [h for h in captured if "ok" in h and "srv" in h]
+    assert on_rep                                    # piggyback is back
+    assert _spans(obs_export.iter_log_events([str(log)])[0])
+
+
+# ---------------------------------------------------------------------------
+# fleet metrics: merge semantics + the fleet-stats CLI
+# ---------------------------------------------------------------------------
+def test_merge_snapshots_semantics():
+    from paddle_tpu.observability import collector
+    h = {"kind": "histogram", "count": 2, "sum": 3.0, "min": 1.0,
+         "max": 2.0, "boundaries": [1.0, 10.0], "counts": [1, 1]}
+    h_skew = dict(h, boundaries=[5.0], counts=[2])
+    src = {
+        "a": {"metrics": {"metrics": {
+                  "fault/retries": {"kind": "counter", "value": 2.0},
+                  "device/bytes_in_use": {"kind": "gauge",
+                                          "values": {"cpu:0": 5.0}},
+                  "pserver/frame_ms": h},
+              "compile": {"compile/traces": 1},
+              "device_memory": {"cpu:0": {"bytes_in_use": 5}}},
+              "identity": {"role": "trainer", "pid": 1}},
+        "b": {"metrics": {"metrics": {
+                  "fault/retries": {"kind": "counter", "value": 3.0},
+                  "device/bytes_in_use": {"kind": "gauge",
+                                          "values": {"cpu:0": 7.0}},
+                  "pserver/frame_ms": h_skew},
+              "compile": {"compile/traces": 2}},
+              "identity": {"role": "pserver", "index": 1, "pid": 2}},
+    }
+    merged = collector.merge_snapshots(src)
+    m = merged["metrics"]
+    assert m["fault/retries"] == {"kind": "counter", "value": 5.0}
+    # gauges are per-process levels: one sample per source, never summed
+    assert m["device/bytes_in_use"]["values"] == {"a:cpu:0": 5.0,
+                                                  "b:cpu:0": 7.0}
+    # the bucket-skewed source is skipped AND named, not averaged in
+    assert m["pserver/frame_ms"]["count"] == 2
+    assert m["pserver/frame_ms"]["counts"] == [1, 1]
+    assert merged["skipped"] == ["b:pserver/frame_ms (bucket mismatch)"]
+    assert merged["compile"]["compile/traces"] == 3.0
+    assert merged["device_memory"] == {"a:cpu:0": {"bytes_in_use": 5}}
+    assert set(merged["sources"]) == {"a", "b"}
+    text = collector.render_fleet(merged)
+    assert "fleet snapshot: 2 source(s)" in text
+    assert "fault/retries: 5" in text
+    assert "skipped: b:pserver/frame_ms (bucket mismatch)" in text
+    # merging is itself observable
+    snap = obs.registry().snapshot()
+    assert snap["collector/merges"]["value"] >= 1.0
+    assert snap["collector/sources"]["values"][""] == 2.0
+
+
+def test_fleet_stats_cli_merges_logs_endpoints_and_master(
+        fleet2, tmp_path, capsys):
+    # two per-process logs, each stamped with its writer's identity
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    obs_export.set_process_identity("trainer")
+    flags.set_flag("metrics_log", str(logs / "trainer.jsonl"))
+    obs_export.periodic_report(1)
+    obs_export._reset_writer()
+    obs_export.set_process_identity("serve", 0)
+    flags.set_flag("metrics_log", str(logs / "serve.jsonl"))
+    obs_export.periodic_report(2)
+    obs_export._reset_writer()
+    obs_export.set_process_identity(None)
+    flags.set_flag("metrics_log", "")
+
+    from paddle_tpu.distributed.master import Master, MasterServer
+    msrv = MasterServer(Master()).start()
+    try:
+        from paddle_tpu.cli import main as cli_main
+        argv = ["fleet-stats", str(logs)] \
+            + [f"{HOST}:{s.port}" for s in fleet2] \
+            + ["--master", f"{msrv.host}:{msrv.port}"]
+        assert cli_main(argv + ["--json"]) == 0
+        merged = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert set(merged["sources"]) == {"trainer", "serve:0",
+                                          "pserver:0", "pserver:1",
+                                          "master"}
+        assert merged["sources"]["pserver:1"]["role"] == "pserver"
+        assert merged["metrics"]["pserver/requests"]["kind"] == "counter"
+        assert merged["metrics"]["pserver/requests"]["value"] > 0
+        # Prometheus exposition of the SAME merge
+        assert cli_main(argv + ["--prom"]) == 0
+        prom = capsys.readouterr().out
+        assert "# TYPE" in prom and "pserver" in prom
+    finally:
+        msrv.stop()
+
+
+def test_fleet_stats_cli_refuses_nonsense_source(tmp_path):
+    from paddle_tpu.cli import main as cli_main
+    with pytest.raises(SystemExit):
+        cli_main(["fleet-stats", "definitely/not/a/thing"])
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(SystemExit):
+        cli_main(["fleet-stats", str(empty)])

@@ -636,6 +636,38 @@ def test_lint_gate_covers_opprof_module():
     assert "opprof/op" in set(_span_names_table())
 
 
+def test_collector_module_only_imported_lazily():
+    """The fleet metrics collector (observability/collector.py) can dial
+    sockets and pull the sparse wire stack — only the opted-in surfaces
+    (the fleet-stats CLI branch, library callers inside a function) may
+    import it.  No top-level import anywhere else, and the observability
+    package __init__ must not import it (importing
+    paddle_tpu.observability stays cheap and socket-free)."""
+    toplevel = _top_level_obs_submodule_imports("collector")
+    assert not toplevel, (
+        "top-level import of observability.collector — must be lazy "
+        "(inside a function) so importing the observability package "
+        "never pays for the collector's socket/wire stack: "
+        + ", ".join(toplevel))
+    # and the sanctioned lazy site exists (the fleet-stats CLI branch)
+    with open(os.path.join(ROOT, "cli.py")) as fh:
+        assert "from paddle_tpu.observability import collector" \
+            in fh.read()
+
+
+def test_lint_gate_covers_collector_module():
+    """observability/collector.py is inside every lint's scan set and
+    its collector/* metric names are frozen in METRIC_NAMES, so its
+    helper calls ride the literal-name typo gate."""
+    rels = {rel for rel, _ in _iter_sources()}
+    assert "paddle_tpu/observability/collector.py" in rels
+    registered = {n for n, _ in _metric_names_table()}
+    assert {n for n in registered if n.startswith("collector/")} >= {
+        "collector/merges", "collector/sources"}
+    assert {n for n in registered if n.startswith("trace/")} >= {
+        "trace/context_rejected"}
+
+
 # ---------------------------------------------------------------------------
 # Tier-1 time-budget guard: subprocess rounds must be @slow.  Each
 # jax-importing subprocess costs ~10-30s of the 870s tier-1 cap (the
